@@ -1,0 +1,85 @@
+"""byteps_tpu — a TPU-native distributed training framework with the
+capabilities of BytePS.
+
+Public API mirrors the reference's Horovod-compatible surface
+(reference: byteps/common/__init__.py, byteps/torch/__init__.py):
+
+    import byteps_tpu as bps
+    bps.init()
+    out = bps.push_pull(tensor, name="grad0")
+    bps.rank(), bps.size(), bps.local_rank(), bps.local_size()
+    bps.suspend(); bps.resume(num_workers, num_servers)
+    bps.shutdown()
+
+plus the JAX adapter in ``byteps_tpu.jax`` (DistributedOptimizer,
+broadcast_parameters), Pallas compression codecs in
+``byteps_tpu.ops.compression``, model zoo in ``byteps_tpu.models``, the DCN
+parameter server in ``byteps_tpu.server``, and parallelism utilities
+(mesh/ring attention/pipeline) in ``byteps_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .config import Config
+from .core.state import get_state
+from .core.types import DataType, QueueType, Status
+from .ops.push_pull import push_pull, broadcast
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "push_pull", "broadcast", "declare_tensor",
+    "get_pushpull_speed", "Config", "DataType", "QueueType", "Status",
+]
+
+
+def init(config: Optional[Config] = None, mesh=None, lazy: bool = False) -> None:
+    """Initialize the framework (reference: byteps_init / byteps_lazy_init,
+    operations.cc:34-94). Reads env config, builds the device mesh, and (when
+    DMLC_NUM_SERVER > 0 and role is worker) connects the DCN PS client."""
+    get_state().init(config, mesh=mesh, lazy=lazy)
+
+
+def shutdown() -> None:
+    get_state().shutdown()
+
+
+def suspend() -> None:
+    get_state().suspend()
+
+
+def resume(num_workers: int, num_servers: int,
+           global_rank: Optional[int] = None) -> None:
+    get_state().resume(num_workers, num_servers, global_rank)
+
+
+def rank() -> int:
+    return get_state().rank()
+
+
+def size() -> int:
+    return get_state().size()
+
+
+def local_rank() -> int:
+    return get_state().local_rank()
+
+
+def local_size() -> int:
+    return get_state().local_size()
+
+
+def declare_tensor(name: str, dtype: DataType = DataType.FLOAT32):
+    """Pre-declare a tensor name so its key is assigned deterministically
+    (reference: byteps_declare_tensor, operations.cc:420-427)."""
+    return get_state().registry.declare(name, dtype)
+
+
+def get_pushpull_speed() -> tuple:
+    """(timestamp, MB/s) of recent push_pull traffic
+    (reference: operations.cc:131-136, global.cc:697-752)."""
+    return get_state().telemetry.speed()

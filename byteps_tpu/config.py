@@ -1,0 +1,136 @@
+"""Typed configuration for byteps_tpu, sourced from environment variables.
+
+The reference framework is configured purely through environment variables
+(reference: docs/env.md; byteps/common/global.cc:134-176). We keep env-var
+compatibility for every knob that still has meaning on TPU, and expose them
+through one frozen dataclass so the rest of the framework never touches
+``os.environ`` directly.
+
+Identity/topology vars (DMLC_*, BYTEPS_LOCAL_RANK, ...) keep their reference
+names (reference: byteps/common/communicator.cc:60-96) so existing launch
+tooling carries over. GPU/PCIe-only knobs (BYTEPS_PCIE_SWITCH_SIZE, NCCL
+rings, NUMA pinning of GPU workers) are intentionally absent — on TPU one
+process owns all local chips and intra-slice reduction is an XLA collective,
+so that whole axis of configuration disappears.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v not in ("0", "false", "False", "FALSE", "off")
+
+
+def _env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+# Default partition size: 4 MB, same as the reference
+# (byteps/common/global.cc:42,134-144).
+DEFAULT_PARTITION_BYTES = 4096000
+# Page size used to round partition lengths (global.cc:140-144).
+PAGE_SIZE = 4096
+# Minimum tensor size eligible for compression (global.cc:43).
+DEFAULT_MIN_COMPRESS_BYTES = 1024000
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Snapshot of all byteps_tpu configuration, read once at init()."""
+
+    # --- identity / topology (reference: communicator.cc:60-96) ---
+    role: str = "worker"                  # DMLC_ROLE: worker | server | scheduler
+    worker_id: int = 0                    # DMLC_WORKER_ID
+    num_workers: int = 1                  # DMLC_NUM_WORKER
+    num_servers: int = 0                  # DMLC_NUM_SERVER
+    scheduler_uri: str = "127.0.0.1"      # DMLC_PS_ROOT_URI
+    scheduler_port: int = 9000            # DMLC_PS_ROOT_PORT
+    local_rank: int = 0                   # BYTEPS_LOCAL_RANK (process on host)
+    local_size: int = 1                   # BYTEPS_LOCAL_SIZE
+    global_rank: Optional[int] = None     # BYTEPS_GLOBAL_RANK override
+    force_distributed: bool = False       # BYTEPS_FORCE_DISTRIBUTED
+
+    # --- partitioning / scheduling (global.cc:134-176, scheduled_queue.cc) ---
+    partition_bytes: int = DEFAULT_PARTITION_BYTES
+    scheduling_credit: int = 0            # BYTEPS_SCHEDULING_CREDIT (0 = off)
+    server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
+    key_hash_fn: str = "djb2"             # BYTEPS_KEY_HASH_FN
+    enable_mixed_mode: bool = False       # BYTEPS_ENABLE_MIXED_MODE
+
+    # --- compression ---
+    min_compress_bytes: int = DEFAULT_MIN_COMPRESS_BYTES
+
+    # --- async / elastic (server.cc:434-436) ---
+    enable_async: bool = False            # BYTEPS_ENABLE_ASYNC
+
+    # --- server (server.cc:412-456) ---
+    server_engine_threads: int = 4        # BYTEPS_SERVER_ENGINE_THREAD
+
+    # --- debug / trace (global.cc:113-124,703-704) ---
+    trace_on: bool = False                # BYTEPS_TRACE_ON
+    trace_start_step: int = 10            # BYTEPS_TRACE_START_STEP
+    trace_end_step: int = 20              # BYTEPS_TRACE_END_STEP
+    trace_dir: str = "./traces"           # BYTEPS_TRACE_DIR
+    telemetry_on: bool = True             # BYTEPS_TELEMETRY_ON
+    debug_sample_tensor: str = ""         # BYTEPS_DEBUG_SAMPLE_TENSOR
+
+    # --- TPU-specific (new) ---
+    mesh_shape: str = ""                  # BYTEPS_TPU_MESH e.g. "dp=8" or "dp=4,tp=2"
+    use_psum_scatter: bool = True         # hierarchical RS+AG instead of one psum
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            role=_env_str("DMLC_ROLE", "worker"),
+            worker_id=_env_int("DMLC_WORKER_ID", 0),
+            num_workers=_env_int("DMLC_NUM_WORKER", 1),
+            num_servers=_env_int("DMLC_NUM_SERVER", 0),
+            scheduler_uri=_env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            scheduler_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            global_rank=(int(os.environ["BYTEPS_GLOBAL_RANK"])
+                         if os.environ.get("BYTEPS_GLOBAL_RANK") else None),
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES",
+                                     DEFAULT_PARTITION_BYTES),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
+            key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
+            enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE"),
+            min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES",
+                                        DEFAULT_MIN_COMPRESS_BYTES),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
+            trace_on=_env_bool("BYTEPS_TRACE_ON"),
+            trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
+            trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
+            trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+            telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
+            debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            mesh_shape=_env_str("BYTEPS_TPU_MESH", ""),
+            use_psum_scatter=_env_bool("BYTEPS_USE_PSUM_SCATTER", True),
+        )
+
+    def parsed_mesh(self) -> dict:
+        """Parse BYTEPS_TPU_MESH ("dp=4,tp=2") into an ordered axis dict."""
+        if not self.mesh_shape:
+            return {}
+        out = {}
+        for part in self.mesh_shape.split(","):
+            k, _, v = part.partition("=")
+            out[k.strip()] = int(v)
+        return out

@@ -1,0 +1,204 @@
+"""Tensor declaration registry, PS key encoding, partitioning and server
+assignment.
+
+TPU-native re-implementation of the reference's declaration/key machinery:
+
+- declaration -> monotonically increasing ``declared_key`` per tensor name
+  (reference: byteps/common/global.cc:412-429);
+- PS key space: ``declared_key << 16 | partition_index``
+  (reference: byteps/common/operations.cc:306-311);
+- partitioning into <= partition_bytes chunks, page-rounded
+  (reference: operations.cc:140-180; global.cc:134-144);
+- server choice via hash knob BYTEPS_KEY_HASH_FN in
+  {naive, built_in, djb2, sdbm, mixed} with per-server accumulated-byte load
+  accounting (reference: global.cc:566-677);
+- ``redeclare`` for elastic resume: names re-register in original order so
+  declared keys match across a new worker set (reference: global.cc:431-436).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..config import Config, PAGE_SIZE
+from ..utils.logging import log, bps_check
+from .types import DataType, Partition, TensorContext
+
+# Partition index fits in the low 16 bits of a key (operations.cc:306-311).
+KEY_SHIFT = 16
+MAX_PARTITIONS = 1 << KEY_SHIFT
+
+
+def _hash_naive(s: str) -> int:
+    # Sum of the decimal digits of the key string (global.cc:600-607 analog:
+    # the reference hashes the stringified key; naive = atoi-style fold).
+    h = 0
+    for ch in s:
+        h = (h * 31 + ord(ch)) & 0xFFFFFFFF
+    return h
+
+
+def _hash_builtin(s: str) -> int:
+    # Python's own string hash is salted per-process; use FNV-1a instead so
+    # worker and server processes agree (the reference relies on identical
+    # std::hash across processes of one binary, global.cc:609-611).
+    h = 0x811C9DC5
+    for ch in s:
+        h ^= ord(ch)
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _hash_djb2(s: str) -> int:
+    h = 5381
+    for ch in s:
+        h = ((h << 5) + h + ord(ch)) & 0xFFFFFFFF  # h*33 + c (global.cc:613-618)
+    return h
+
+
+def _hash_sdbm(s: str) -> int:
+    h = 0
+    for ch in s:
+        h = (ord(ch) + (h << 6) + (h << 16) - h) & 0xFFFFFFFF  # global.cc:620-626
+    return h
+
+
+_HASH_FNS = {
+    "naive": _hash_naive,
+    "built_in": _hash_builtin,
+    "djb2": _hash_djb2,
+    "sdbm": _hash_sdbm,
+}
+
+
+class TensorRegistry:
+    """Thread-safe name -> TensorContext table with stable key assignment."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        self._lock = threading.Lock()
+        self._contexts: Dict[str, TensorContext] = {}
+        self._next_key = 0
+        # Per-server accumulated bytes, for load-balanced assignment
+        # (global.cc:628-677).
+        self._server_load: List[int] = [0] * max(1, config.num_servers)
+        self._declaration_order: List[str] = []
+
+    # ------------------------------------------------------------------ #
+    # declaration
+    # ------------------------------------------------------------------ #
+
+    def declare(self, name: str, dtype: DataType = DataType.FLOAT32) -> TensorContext:
+        """Declare (or fetch) a tensor by name; first call assigns the next
+        monotonic declared_key (global.cc:412-429)."""
+        with self._lock:
+            ctx = self._contexts.get(name)
+            if ctx is not None:
+                return ctx
+            ctx = TensorContext(name=name, declared_key=self._next_key, dtype=dtype)
+            self._next_key += 1
+            self._contexts[name] = ctx
+            self._declaration_order.append(name)
+            log.debug("declared tensor %s -> key %d", name, ctx.declared_key)
+            return ctx
+
+    def is_declared(self, name: str) -> bool:
+        with self._lock:
+            return name in self._contexts
+
+    def get(self, name: str) -> Optional[TensorContext]:
+        with self._lock:
+            return self._contexts.get(name)
+
+    def contexts_in_order(self) -> List[TensorContext]:
+        with self._lock:
+            return [self._contexts[n] for n in self._declaration_order]
+
+    def redeclare_all(self, new_config: Config) -> None:
+        """Elastic resume: re-register every name in original order against a
+        new topology so keys keep matching (global.cc:431-436)."""
+        with self._lock:
+            self._config = new_config
+            self._server_load = [0] * max(1, new_config.num_servers)
+            for name in self._declaration_order:
+                ctx = self._contexts[name]
+                ctx.initialized = False
+                if ctx.nbytes:
+                    self._partition_locked(ctx, ctx.nbytes)
+
+    # ------------------------------------------------------------------ #
+    # partitioning + server assignment
+    # ------------------------------------------------------------------ #
+
+    def init_tensor(self, name: str, nbytes: int,
+                    dtype: Optional[DataType] = None) -> TensorContext:
+        """Size-aware init: partition into <= partition_bytes keys and assign
+        each partition to a server (operations.cc:283-414 minus the shm/ZPush
+        plumbing, which is owned by the transport layer here)."""
+        ctx = self.declare(name, dtype or DataType.FLOAT32)
+        if dtype is not None:
+            ctx.dtype = dtype
+        with self._lock:
+            if ctx.initialized and ctx.nbytes == nbytes:
+                return ctx
+            self._partition_locked(ctx, nbytes)
+            ctx.initialized = True
+            return ctx
+
+    def _partition_locked(self, ctx: TensorContext, nbytes: int) -> None:
+        bps_check(nbytes > 0, f"tensor {ctx.name} has zero size")
+        part_bytes = self._aligned_partition_bytes()
+        # Re-init: retire the old partitions' load accounting first.
+        for p in ctx.partitions:
+            if p.server < len(self._server_load):
+                self._server_load[p.server] -= p.length
+        ctx.nbytes = nbytes
+        ctx.partitions = []
+        num_parts = (nbytes + part_bytes - 1) // part_bytes
+        bps_check(num_parts <= MAX_PARTITIONS,
+                  f"{ctx.name}: {num_parts} partitions exceed key space")
+        offset = 0
+        for i in range(num_parts):
+            length = min(part_bytes, nbytes - offset)
+            key = (ctx.declared_key << KEY_SHIFT) | i
+            server = self._assign_server_locked(key, length)
+            ctx.partitions.append(
+                Partition(key=key, index=i, offset=offset, length=length,
+                          server=server))
+            offset += length
+        bps_check(offset == nbytes, "partitioning did not cover tensor")
+
+    def _aligned_partition_bytes(self) -> int:
+        """Partition size rounded to a page multiple (global.cc:140-144).
+
+        The reference also multiplies by local_size so each local GPU's shard
+        of a partition stays page-aligned; on TPU the ICI shard never touches
+        a shared-memory file, so plain page rounding suffices.
+        """
+        pb = self._config.partition_bytes
+        return max(PAGE_SIZE, (pb + PAGE_SIZE - 1) // PAGE_SIZE * PAGE_SIZE)
+
+    def _assign_server_locked(self, key: int, length: int) -> int:
+        num_servers = max(1, self._config.num_servers)
+        if num_servers == 1:
+            return 0
+        fn_name = self._config.key_hash_fn
+        if fn_name == "mixed":
+            # mixed: pick the least-loaded server (global.cc:566-596's
+            # load-aware variant).
+            server = min(range(num_servers), key=lambda s: self._server_load[s])
+        else:
+            fn = _HASH_FNS.get(fn_name, _hash_djb2)
+            server = fn(str(key)) % num_servers
+        self._server_load[server] += length
+        return server
+
+    def server_loads(self) -> List[int]:
+        with self._lock:
+            return list(self._server_load)
+
+
+def decode_key(key: int) -> tuple:
+    """Split a PS key into (declared_key, partition_index)."""
+    return key >> KEY_SHIFT, key & (MAX_PARTITIONS - 1)

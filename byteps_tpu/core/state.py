@@ -1,0 +1,187 @@
+"""Process-wide byteps_tpu state: the TPU analogue of BytePSGlobal.
+
+The reference's global singleton (byteps/common/global.{h,cc}) owns rank/size,
+the NCCL manager, 12 scheduled queues, ready tables, shm and the PS
+connection. Here the same role shrinks to: config snapshot, tensor registry,
+the device mesh, the (optional) DCN PS client, telemetry, and the trace
+recorder — because XLA's compiled dataflow replaces the hand-built pipeline
+for everything that stays on-device.
+
+Lifecycle mirrors the reference C ABI (operations.cc:34-129):
+``init -> [declare/push_pull]* -> suspend -> resume -> shutdown``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+from ..config import Config
+from ..parallel import mesh as mesh_lib
+from ..utils.logging import log, refresh_level, bps_check
+from .registry import TensorRegistry
+
+
+class _Telemetry:
+    """push_pull byte-rate telemetry (reference: global.cc:697-752).
+
+    Aggregates bytes of finished push_pulls into ~10-second MB/s samples,
+    surfaced by ``bps.get_pushpull_speed()``.
+    """
+
+    WINDOW_SEC = 10.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._window_start = time.monotonic()
+        self._window_bytes = 0
+        self._last_sample = (0.0, 0.0)  # (timestamp, MB/s)
+
+    def record(self, nbytes: int) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._window_bytes += nbytes
+            elapsed = now - self._window_start
+            if elapsed >= self.WINDOW_SEC:
+                mbps = self._window_bytes / elapsed / 1e6
+                self._last_sample = (now, mbps)
+                self._window_start = now
+                self._window_bytes = 0
+
+    def speed(self) -> tuple:
+        with self._lock:
+            return self._last_sample
+
+
+class GlobalState:
+    """Singleton holding all process-wide framework state."""
+
+    _instance: Optional["GlobalState"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.config: Config = Config()
+        self.registry: Optional[TensorRegistry] = None
+        self.mesh = None
+        self.initialized = False
+        self.suspended = False
+        self.telemetry = _Telemetry()
+        self.tracer = None           # set lazily by utils.tracing
+        self.ps_client = None        # set by server.client when PS configured
+        self._version: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def get(cls) -> "GlobalState":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = GlobalState()
+            return cls._instance
+
+    def init(self, config: Optional[Config] = None, mesh=None,
+             lazy: bool = False) -> None:
+        with self._lock:
+            if self.initialized and not self.suspended:
+                return
+            refresh_level()
+            self.config = config or Config.from_env()
+            if self.registry is None:
+                self.registry = TensorRegistry(self.config)
+            else:
+                # re-init (elastic resume or shutdown->init with new env):
+                # keep declaration order so keys stay stable
+                # (global.cc:431-436), but rebind the new config.
+                self.registry.redeclare_all(self.config)
+            self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+                self.config.parsed_mesh() or None)
+            if self.config.trace_on and self.tracer is None:
+                from ..utils.tracing import Tracer
+                self.tracer = Tracer(self.config)
+            if (not lazy and self.ps_client is None
+                    and self.config.num_servers > 0
+                    and self.config.role == "worker"):
+                from ..server.client import connect_from_config
+                self.ps_client = connect_from_config(self.config)
+            self.initialized = True
+            self.suspended = False
+            log.info("byteps_tpu initialized: rank=%d size=%d devices=%d mesh=%s",
+                     self.rank(), self.size(), len(jax.devices()),
+                     dict(self.mesh.shape))
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self.ps_client is not None:
+                try:
+                    self.ps_client.close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+                self.ps_client = None
+            if self.tracer is not None:
+                self.tracer.flush()
+            self.initialized = False
+            self.suspended = False
+
+    def suspend(self) -> None:
+        """Elastic suspend (operations.cc:114-119): tear down comm state but
+        keep the declared-tensor table so resume re-assigns identical keys."""
+        with self._lock:
+            bps_check(self.initialized, "suspend() before init()")
+            if self.ps_client is not None:
+                try:
+                    self.ps_client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self.ps_client = None
+            self.initialized = False
+            self.suspended = True
+
+    def resume(self, num_workers: int, num_servers: int,
+               global_rank: Optional[int] = None) -> None:
+        """Elastic resume with a new topology (common/__init__.py:75-81)."""
+        import os
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+        if global_rank is not None:
+            os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
+        # init() re-establishes the PS client that suspend() closed.
+        self.init(Config.from_env())
+
+    # ------------------------------------------------------------------ #
+    # identity (communicator.cc:60-96)
+    # ------------------------------------------------------------------ #
+
+    def rank(self) -> int:
+        c = self.config
+        if c.global_rank is not None:
+            return c.global_rank
+        return c.worker_id * c.local_size + c.local_rank
+
+    def size(self) -> int:
+        c = self.config
+        return max(1, c.num_workers) * max(1, c.local_size)
+
+    def local_rank(self) -> int:
+        return self.config.local_rank
+
+    def local_size(self) -> int:
+        return self.config.local_size
+
+    def is_distributed(self) -> bool:
+        return self.config.num_workers > 1 or self.config.force_distributed
+
+    # ------------------------------------------------------------------ #
+
+    def next_version(self, name: str) -> int:
+        with self._lock:
+            v = self._version.get(name, 0)
+            self._version[name] = v + 1
+            return v
+
+
+def get_state() -> GlobalState:
+    return GlobalState.get()

@@ -1,0 +1,215 @@
+"""Core shared types for byteps_tpu.
+
+TPU-native analogue of the reference's byteps/common/common.h: DataType enum,
+pipeline-stage (QueueType) enum, Status, and the per-tensor context /
+per-partition task records. The pipeline stages are re-grounded for TPU: the
+reference's 12 GPU/PCIe stages (common.h:88-102) collapse to the stages that
+still exist when one process owns every local chip and intra-slice reduction
+is an XLA collective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Wire dtypes, in the reference's (mshadow) order (common.h:59-72)."""
+
+    FLOAT32 = 0
+    FLOAT64 = 1
+    FLOAT16 = 2
+    UINT8 = 3
+    INT32 = 4
+    INT8 = 5
+    INT64 = 6
+    # TPU-native additions (no mshadow equivalent):
+    BFLOAT16 = 7
+    UINT16 = 8
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self]
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self]
+
+    @staticmethod
+    def from_np(dtype) -> "DataType":
+        key = np.dtype(dtype).name
+        try:
+            return _FROM_NP[key]
+        except KeyError:
+            raise ValueError(f"unsupported dtype {dtype}") from None
+
+
+_NP_DTYPES = {
+    DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.FLOAT16: np.dtype(np.float16),
+    DataType.UINT8: np.dtype(np.uint8),
+    DataType.INT32: np.dtype(np.int32),
+    DataType.INT8: np.dtype(np.int8),
+    DataType.INT64: np.dtype(np.int64),
+    # bfloat16 has no numpy dtype; travels as uint16 on the wire.
+    DataType.BFLOAT16: np.dtype(np.uint16),
+    DataType.UINT16: np.dtype(np.uint16),
+}
+
+_ITEMSIZE = {
+    DataType.FLOAT32: 4, DataType.FLOAT64: 8, DataType.FLOAT16: 2,
+    DataType.UINT8: 1, DataType.INT32: 4, DataType.INT8: 1,
+    DataType.INT64: 8, DataType.BFLOAT16: 2, DataType.UINT16: 2,
+}
+
+_FROM_NP = {
+    "float32": DataType.FLOAT32, "float64": DataType.FLOAT64,
+    "float16": DataType.FLOAT16, "uint8": DataType.UINT8,
+    "int32": DataType.INT32, "int8": DataType.INT8,
+    "int64": DataType.INT64, "bfloat16": DataType.BFLOAT16,
+    "uint16": DataType.UINT16,
+}
+
+
+class QueueType(enum.IntEnum):
+    """Pipeline stages for a push_pull, in execution order.
+
+    TPU mapping of the reference's 12-stage pipeline (common.h:88-102):
+    COORDINATE_* and PCIE_REDUCE vanish (single process per host, no PCIe
+    switches); REDUCE/BROADCAST become ICI collectives; COPYD2H/COPYH2D
+    become the device<->host transfers at the jit boundary.
+    """
+
+    ICI_REDUCE = 0     # psum_scatter over the slice mesh (was REDUCE)
+    COPYD2H = 1        # device -> host staging of this host's shard
+    COMPRESS = 2       # codec Compress (Pallas on-device, or host)
+    PUSH = 3           # ZPush to DCN PS
+    PULL = 4           # ZPull from DCN PS
+    DECOMPRESS = 5     # codec Decompress
+    COPYH2D = 6        # host -> device
+    ICI_BCAST = 7      # all_gather over the slice mesh (was BROADCAST)
+
+    @staticmethod
+    def count() -> int:
+        return len(QueueType)
+
+
+class StatusCode(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass
+class Status:
+    """Mirror of common.h Status — OK / error-with-reason."""
+
+    code: StatusCode = StatusCode.OK
+    reason: str = ""
+
+    def ok(self) -> bool:
+        return self.code == StatusCode.OK
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status()
+
+    @staticmethod
+    def Error(reason: str, code: StatusCode = StatusCode.UNKNOWN_ERROR) -> "Status":
+        return Status(code, reason)
+
+
+class RequestType(enum.IntEnum):
+    """PS request types (reference: common.h:267-271)."""
+
+    DEFAULT_PUSH_PULL = 0
+    ROW_SPARSE_PUSH_PULL = 1
+    COMPRESSED_PUSH_PULL = 2
+
+
+def get_command_type(req: RequestType, dtype: DataType) -> int:
+    """Cantor pairing of (request type, dtype) into one wire int
+    (reference: common.cc:98-101)."""
+    a, b = int(req), int(dtype)
+    return (a + b) * (a + b + 1) // 2 + b
+
+
+def decode_command_type(cmd: int) -> tuple:
+    """Inverse Cantor pairing."""
+    w = int(((8 * cmd + 1) ** 0.5 - 1) // 2)
+    t = w * (w + 1) // 2
+    b = cmd - t
+    a = w - b
+    return RequestType(a), DataType(b)
+
+
+def align(size: int, alignment: int = 16) -> int:
+    """Round ``size`` up to a multiple of ``alignment`` (common.h:281-285)."""
+    return (size + alignment - 1) // alignment * alignment
+
+
+@dataclasses.dataclass
+class Partition:
+    """One <=partition_bytes slice of a declared tensor.
+
+    Mirrors the (key, offset, len) triple carried by TensorTableEntry
+    (common.h:221-264).
+    """
+
+    key: int          # full PS key: declared_key << 16 | index
+    index: int        # partition index within the tensor
+    offset: int       # byte offset into the flat tensor
+    length: int       # byte length
+    server: int = 0   # assigned PS shard
+
+
+@dataclasses.dataclass
+class TensorContext:
+    """Per-declared-tensor state (reference BPSContext, common.h:177-205)."""
+
+    name: str
+    declared_key: int
+    dtype: DataType
+    nbytes: int = 0
+    partitions: List[Partition] = dataclasses.field(default_factory=list)
+    priority: int = 0
+    compressor_kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    initialized: bool = False
+
+    @property
+    def key_list(self) -> List[int]:
+        return [p.key for p in self.partitions]
+
+
+@dataclasses.dataclass
+class TensorTask:
+    """Unit of scheduled work: one partition of one push_pull
+    (reference TensorTableEntry, common.h:221-264)."""
+
+    context: TensorContext
+    partition: Partition
+    priority: int
+    version: int
+    queue_list: List[QueueType]
+    queue_idx: int = 0
+    data: Optional[Any] = None           # host buffer (numpy view) for this partition
+    total_partnum: int = 1
+    counter: Optional[Any] = None        # shared per-tensor completion counter
+    callback: Optional[Callable[[Status], None]] = None
+
+    @property
+    def key(self) -> int:
+        return self.partition.key
+
+    def current_queue(self) -> Optional[QueueType]:
+        if self.queue_idx < len(self.queue_list):
+            return self.queue_list[self.queue_idx]
+        return None

@@ -1,0 +1,181 @@
+"""push_pull: gradient summation over the device mesh.
+
+This is the TPU-native core of the framework. The reference implements
+push_pull as a 12-stage host-thread pipeline: NCCL ReduceScatter inside the
+machine, ZPush/ZPull to parameter servers between machines, NCCL AllGather
+back out (reference: byteps/common/core_loops.cc:190-268,538-618). On TPU the
+intra-slice part compiles into the XLA program:
+
+- ``psum_tree``            — one-shot allreduce (lax.psum over the dp axis)
+- ``reduce_scatter_tree``  — each device ends up owning 1/N of every gradient
+  (the analogue of the reference's "each GPU owns 1/local_size of every
+  partition" layout, core_loops.cc:216-268)
+- ``all_gather_tree``      — rebuild full params from shards (BROADCAST stage)
+
+These are meant to be called *inside* ``shard_map`` / ``pjit`` where the mesh
+axis name is bound; XLA then schedules the collectives asynchronously and
+overlaps them with compute — which is exactly the pipelining the reference
+builds by hand with priority queues and stage threads.
+
+The eager, Horovod-style ``push_pull(x)`` entry point (one call per tensor,
+used by the adapter API and tests) wraps the same collectives in a cached
+jitted shard_map over the global mesh.
+
+Cross-slice (DCN) aggregation goes through byteps_tpu.server instead — see
+that module; this one is pure ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.state import get_state
+from ..core.types import DataType
+from ..parallel.mesh import DP_AXIS
+
+
+# ---------------------------------------------------------------------- #
+# in-jit collectives (call inside shard_map/pjit)
+# ---------------------------------------------------------------------- #
+
+def psum_tree(tree: Any, axis: str = DP_AXIS, average: bool = True) -> Any:
+    """Sum (or mean) every leaf across ``axis``. The REDUCE+PUSH+PULL+
+    BROADCAST pipeline collapsed into one XLA allreduce."""
+    summed = jax.lax.psum(tree, axis_name=axis)
+    if average:
+        n = jax.lax.axis_size(axis)
+        summed = jax.tree.map(lambda g: g / n, summed)
+    return summed
+
+
+def pmean_tree(tree: Any, axis: str = DP_AXIS) -> Any:
+    return psum_tree(tree, axis, average=True)
+
+
+def _scatter_leaf(g: jnp.ndarray, axis: str, average: bool) -> jnp.ndarray:
+    """ReduceScatter one leaf along its leading dim; pads to make the leading
+    dim divisible by the axis size (the reference pads partitions to page
+    multiples for the same reason, global.cc:140-144)."""
+    n = jax.lax.axis_size(axis)
+    flat = g.reshape(-1)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    out = jax.lax.psum_scatter(flat.reshape(n, -1), axis_name=axis,
+                               scatter_dimension=0, tiled=False)
+    if average:
+        out = out / n
+    return out
+
+
+def reduce_scatter_tree(tree: Any, axis: str = DP_AXIS,
+                        average: bool = True) -> Any:
+    """ReduceScatter every leaf: afterwards each device holds a flat 1/N shard
+    of the summed gradient. Pairs with ``all_gather_tree`` and enables
+    sharded (ZeRO-1 style) optimizer updates, the TPU upgrade of the
+    reference's owns-1/N-of-each-partition layout."""
+    return jax.tree.map(lambda g: _scatter_leaf(g, axis, average), tree)
+
+
+def all_gather_tree(shard_tree: Any, shapes: Any, axis: str = DP_AXIS) -> Any:
+    """Inverse of reduce_scatter_tree: gather flat shards and restore original
+    leaf shapes (the ICI_BCAST stage)."""
+
+    def gather(shard, orig):
+        full = jax.lax.all_gather(shard, axis_name=axis, axis=0, tiled=False)
+        size = int(np.prod(orig.shape)) if orig.shape else 1
+        return full.reshape(-1)[:size].reshape(orig.shape).astype(orig.dtype)
+
+    return jax.tree.map(gather, shard_tree, shapes)
+
+
+# ---------------------------------------------------------------------- #
+# eager Horovod-style API
+# ---------------------------------------------------------------------- #
+
+@functools.lru_cache(maxsize=512)
+def _cached_push_pull(mesh: Mesh, shape, dtype, average: bool, axis: str):
+    """Build and cache a jitted shard_map that sums a (n_dev, *shape) stacked
+    input over ``axis`` and returns the replicated (*shape) result."""
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P())
+    def _pp(x):
+        # in_specs=P(axis) with leading dim == axis size -> local block (1, *s)
+        return psum_tree(x.reshape(x.shape[1:]), axis=axis, average=average)
+
+    return jax.jit(_pp)
+
+
+def push_pull(tensor, name: Optional[str] = None, average: bool = True,
+              axis: str = DP_AXIS, priority: int = 0):
+    """Horovod-compatible eager push_pull.
+
+    ``tensor`` carries one slice per mesh device stacked on the leading dim
+    (shape ``(n_devices, *s)``), or a plain ``(*s)`` array meaning every
+    device contributes the same value. Returns the sum (mean when
+    ``average``) of shape ``(*s)``, replicated over the mesh — the same
+    contract as the reference's framework-level ``byteps.push_pull``
+    (reference: byteps/torch/__init__.py:139, ops.py:157-174).
+    """
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError("byteps_tpu.init() must be called before push_pull")
+    mesh = state.mesh
+    n = mesh.shape.get(axis, 1)
+
+    x = jnp.asarray(tensor)
+    if x.ndim == 0 or x.shape[0] != n:
+        x = jnp.broadcast_to(x, (n,) + x.shape)
+
+    if name is not None:
+        ctx = state.registry.init_tensor(
+            name, int(np.prod(x.shape[1:]) or 1) * x.dtype.itemsize,
+            DataType.from_np(x.dtype))
+        ctx.priority = priority
+
+    fn = _cached_push_pull(mesh, tuple(x.shape[1:]), str(x.dtype), average, axis)
+    out = fn(x)
+    state.telemetry.record(out.nbytes * n)
+    if state.tracer is not None and name is not None:
+        state.tracer.instant(name, "push_pull")
+    return out
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              axis: str = DP_AXIS):
+    """Broadcast the root device's slice to all devices.
+
+    Implemented the way the reference implements broadcast_parameters —
+    zero the non-root contributions, then push_pull(sum) (reference:
+    byteps/torch/__init__.py:261-293) — which XLA lowers to a broadcast and
+    whose replicated output shard_map can infer statically.
+    """
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError("byteps_tpu.init() must be called before broadcast")
+    mesh = state.mesh
+    n = mesh.shape.get(axis, 1)
+    x = jnp.asarray(tensor)
+    if x.ndim == 0 or x.shape[0] != n:
+        x = jnp.broadcast_to(x, (n,) + x.shape)
+    return _cached_broadcast(mesh, root_rank, axis)(x)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_broadcast(mesh: Mesh, root_rank: int, axis: str):
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def _bcast(v):
+        local = v.reshape(v.shape[1:])
+        idx = jax.lax.axis_index(axis)
+        contrib = jnp.where(idx == root_rank, local, jnp.zeros_like(local))
+        return jax.lax.psum(contrib, axis_name=axis)
+
+    return jax.jit(_bcast)

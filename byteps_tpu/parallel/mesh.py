@@ -1,0 +1,74 @@
+"""Device-mesh construction for byteps_tpu.
+
+The reference bootstraps NCCL communicators per PCIe switch and per ring
+(reference: byteps/common/nccl_manager.cc:95-163). On TPU the equivalent
+object is a static ``jax.sharding.Mesh`` over the slice: collectives are
+compiled into the program, so there is no id-exchange bootstrap and no
+root/non-root process choreography — one process owns all local chips.
+
+Axis conventions (used across the framework):
+
+- ``dp``: data parallel (gradient push_pull axis; the BytePS axis)
+- ``tp``: tensor parallel (megatron-style within attention/mlp)
+- ``sp``: sequence/context parallel (ring attention)
+- ``pp``: pipeline parallel stages
+- ``ep``: expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+SP_AXIS = "sp"
+PP_AXIS = "pp"
+EP_AXIS = "ep"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh. Default: every device on the ``dp`` axis.
+
+    ``axes`` maps axis name -> size, in major-to-minor order, e.g.
+    ``{"dp": 4, "tp": 2}``. One axis may be -1 to absorb the remainder.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if not axes:
+        axes = {DP_AXIS: n}
+    axes = dict(axes)
+    # Resolve a single -1.
+    known = 1
+    wild = None
+    for name, size in axes.items():
+        if size == -1:
+            wild = name
+        else:
+            known *= size
+    if wild is not None:
+        axes[wild] = n // known
+        known *= axes[wild]
+    if known != n:
+        raise ValueError(f"mesh axes {axes} do not multiply to {n} devices")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def dp_sharded(mesh: Mesh, axis: str = DP_AXIS) -> NamedSharding:
+    """Batch-dim sharding over the data-parallel axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)

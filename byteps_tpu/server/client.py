@@ -1,0 +1,16 @@
+"""DCN parameter-server worker client (placeholder — native transport lands
+with byteps_tpu.server).
+
+Reference equivalent: ps::KVWorker<char>::ZPush/ZPull over ps-lite
+(3rdparty/ps-lite; used from byteps/common/core_loops.cc:571,609).
+"""
+
+from __future__ import annotations
+
+from ..config import Config
+
+
+def connect_from_config(config: Config):
+    raise RuntimeError(
+        "byteps_tpu DCN PS transport is not available yet in this build; "
+        "set DMLC_NUM_SERVER=0 (pure ICI mode) or use init(lazy=True)")

@@ -1,0 +1,55 @@
+"""Leveled logging for byteps_tpu.
+
+TPU-native counterpart of the reference's BPS_LOG / BPS_CHECK macros
+(reference: byteps/common/logging.h:26,90-94). Level is taken from
+``BYTEPS_LOG_LEVEL`` (TRACE, DEBUG, INFO, WARNING, ERROR, FATAL); default
+WARNING, matching the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "TRACE": TRACE,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "FATAL": logging.CRITICAL,
+}
+
+
+def _make_logger() -> logging.Logger:
+    logger = logging.getLogger("byteps_tpu")
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s] BYTEPS %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+    level_name = os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper()
+    logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
+    logger.propagate = False
+    return logger
+
+
+log = _make_logger()
+
+
+def bps_check(cond: bool, msg: str = "") -> None:
+    """Equivalent of BPS_CHECK: raise on failed invariant."""
+    if not cond:
+        log.critical("check failed: %s", msg)
+        raise AssertionError(f"BPS_CHECK failed: {msg}")
+
+
+def refresh_level() -> None:
+    """Re-read BYTEPS_LOG_LEVEL (used by init() so env set after import works)."""
+    level_name = os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper()
+    log.setLevel(_LEVELS.get(level_name, logging.WARNING))

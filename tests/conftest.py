@@ -1,0 +1,46 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests all roles on one machine over loopback with
+BYTEPS_FORCE_DISTRIBUTED (reference: tests/meta_test.py:27-58). The JAX
+analogue: force the CPU platform with 8 virtual devices so every mesh/
+collective path is exercised without TPU hardware. Env must be set before
+jax initializes its backends, hence module scope here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("BYTEPS_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Force CPU even when the outer environment pre-imported jax against a TPU
+# platform (env vars are latched at jax import time, so config.update is the
+# only reliable override).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def bps():
+    """Fresh byteps_tpu init/shutdown around each test."""
+    import byteps_tpu as bps_mod
+    from byteps_tpu.core.state import GlobalState
+
+    GlobalState._instance = None  # reset singleton between tests
+    bps_mod.init()
+    yield bps_mod
+    bps_mod.shutdown()
+    GlobalState._instance = None

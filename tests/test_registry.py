@@ -1,0 +1,105 @@
+"""Unit tests for tensor declaration, key encoding, partitioning, hashing.
+
+Covers the reference behaviors at byteps/common/global.cc:412-429 (monotonic
+declared keys), operations.cc:140-180,306-311 (partitioning and key space),
+global.cc:566-677 (server hashing + load accounting).
+"""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry, decode_key, KEY_SHIFT
+from byteps_tpu.core.types import (
+    DataType, RequestType, get_command_type, decode_command_type, align,
+)
+
+
+def make_registry(**kw):
+    defaults = dict(num_servers=4, partition_bytes=4096)
+    defaults.update(kw)
+    return TensorRegistry(Config(**defaults))
+
+
+def test_declared_keys_monotonic():
+    reg = make_registry()
+    keys = [reg.declare(f"t{i}").declared_key for i in range(10)]
+    assert keys == list(range(10))
+    # re-declaration returns the same context
+    assert reg.declare("t3").declared_key == 3
+
+
+def test_partitioning_covers_tensor():
+    reg = make_registry(partition_bytes=4096)
+    ctx = reg.init_tensor("grad", nbytes=10000, dtype=DataType.FLOAT32)
+    assert len(ctx.partitions) == 3
+    assert sum(p.length for p in ctx.partitions) == 10000
+    offsets = [p.offset for p in ctx.partitions]
+    assert offsets == [0, 4096, 8192]
+    # key encoding: declared_key << 16 | index
+    for i, p in enumerate(ctx.partitions):
+        dk, idx = decode_key(p.key)
+        assert dk == ctx.declared_key and idx == i
+
+
+def test_partition_bytes_page_rounded():
+    reg = make_registry(partition_bytes=5000)  # rounds up to 8192
+    ctx = reg.init_tensor("g", nbytes=9000)
+    assert ctx.partitions[0].length == 8192
+    assert ctx.partitions[1].length == 9000 - 8192
+
+
+def test_single_partition_small_tensor():
+    reg = make_registry()
+    ctx = reg.init_tensor("small", nbytes=100)
+    assert len(ctx.partitions) == 1
+    assert ctx.partitions[0].key == ctx.declared_key << KEY_SHIFT
+
+
+def test_server_assignment_deterministic_and_balanced():
+    rega = make_registry(key_hash_fn="djb2")
+    regb = make_registry(key_hash_fn="djb2")
+    for i in range(20):
+        ca = rega.init_tensor(f"t{i}", nbytes=4096 * 4)
+        cb = regb.init_tensor(f"t{i}", nbytes=4096 * 4)
+        assert [p.server for p in ca.partitions] == [p.server for p in cb.partitions]
+    assert all(0 <= p.server < 4 for c in rega.contexts_in_order()
+               for p in c.partitions)
+
+
+def test_mixed_hash_balances_load():
+    reg = make_registry(key_hash_fn="mixed", num_servers=4)
+    for i in range(16):
+        reg.init_tensor(f"t{i}", nbytes=4096)
+    loads = reg.server_loads()
+    assert max(loads) - min(loads) <= 4096  # near-perfect balance
+
+
+def test_redeclare_preserves_keys():
+    reg = make_registry(num_servers=2)
+    for i in range(5):
+        reg.init_tensor(f"t{i}", nbytes=8192)
+    old_keys = {c.name: c.key_list for c in reg.contexts_in_order()}
+    reg.redeclare_all(Config(num_servers=3, partition_bytes=4096))
+    new_keys = {c.name: c.key_list for c in reg.contexts_in_order()}
+    assert old_keys == new_keys  # elastic resume: identical key assignment
+
+
+def test_command_type_roundtrip():
+    for req in RequestType:
+        for dt in DataType:
+            cmd = get_command_type(req, dt)
+            assert decode_command_type(cmd) == (req, dt)
+
+
+def test_align():
+    assert align(0) == 0
+    assert align(1) == 16
+    assert align(16) == 16
+    assert align(17, 8) == 24
+
+
+def test_dtype_roundtrip():
+    for dt in [DataType.FLOAT32, DataType.FLOAT16, DataType.INT32]:
+        assert DataType.from_np(dt.np_dtype) == dt
+    assert DataType.from_np(np.float32) == DataType.FLOAT32
