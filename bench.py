@@ -1,0 +1,70 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Measures flagship-model (Llama ~125M) training throughput on the available
+device: full train step (fwd + bwd + adam), bf16 compute, remat, donated
+buffers. Mirrors the reference's synthetic-throughput vehicle
+(example/pytorch/benchmark_byteps.py:25-31,110-140: mean over repeated
+timed batches).
+
+``vs_baseline`` compares against a recorded naive-fp32 single-chip
+measurement on the same v5e hardware (53,553 tokens/s, 2026-07-29) — the
+"untuned implementation" anchor, since the reference's published numbers
+(README.md:9) are V100-cluster scaling efficiencies with no single-chip
+equivalent.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byteps_tpu.models import llama
+
+# Naive-fp32 anchor measured on v5e-1 (see module docstring).
+BASELINE_TOKENS_PER_SEC = 53553.0
+
+
+def measure(B: int = 8, S: int = 1024, steps: int = 10) -> float:
+    cfg = llama.LlamaConfig.small(vocab_size=32000)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),
+        jnp.int32)
+
+    def step(p, o, t):
+        loss, g = jax.value_and_grad(
+            lambda p_: llama.loss_fn(p_, {"tokens": t}, cfg))(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    stepj = jax.jit(step, donate_argnums=(0, 1))
+    for _ in range(3):
+        params, opt, loss = stepj(params, opt, tokens)
+    float(loss)  # host readback: the only reliable sync on this platform
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = stepj(params, opt, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return B * S * steps / dt
+
+
+def main() -> None:
+    tps = measure()
+    print(json.dumps({
+        "metric": "llama125m_train_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,11 @@ def get_pushpull_speed() -> tuple:
     """(timestamp, MB/s) of recent push_pull traffic
     (reference: operations.cc:131-136, global.cc:697-752)."""
     return get_state().telemetry.speed()
+
+
+def profiler_step() -> None:
+    """Advance the Chrome-trace step counter (train steps built via
+    byteps_tpu.jax.train call this automatically)."""
+    tracer = get_state().tracer
+    if tracer is not None:
+        tracer.step()

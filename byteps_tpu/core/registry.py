@@ -124,6 +124,9 @@ class TensorRegistry:
             for name in self._declaration_order:
                 ctx = self._contexts[name]
                 ctx.initialized = False
+                # load table was just reset; drop stale partitions so
+                # _partition_locked's retire step doesn't go negative
+                ctx.partitions = []
                 if ctx.nbytes:
                     self._partition_locked(ctx, ctx.nbytes)
 

@@ -87,6 +87,11 @@ class GlobalState:
              lazy: bool = False) -> None:
         with self._lock:
             if self.initialized and not self.suspended:
+                if config is not None or mesh is not None:
+                    log.warning(
+                        "init() called with explicit config/mesh while "
+                        "already initialized — arguments ignored; call "
+                        "shutdown() first to re-initialize")
                 return
             refresh_level()
             self.config = config or Config.from_env()

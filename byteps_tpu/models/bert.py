@@ -1,0 +1,161 @@
+"""BERT encoder (MLM pretraining objective), TPU-first.
+
+BERT-large is the reference's headline benchmark vehicle (README.md:34-40:
+~90% scaling efficiency at 256 GPUs; BASELINE.json config 3 reproduces it on
+v5e-256). Functional params pytree, bf16 activations, layers stacked for
+lax.scan like models/llama.py. Post-LN residuals and learned positional
+embeddings follow the original BERT; GELU FFN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    ffn_dim: int = 4096
+    max_seq_len: int = 512
+    type_vocab: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def bert_large() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def bert_base() -> "BertConfig":
+        return BertConfig(dim=768, n_layers=12, n_heads=12, ffn_dim=3072)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, seq: int = 64) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, dim=64, n_layers=2,
+                          n_heads=4, ffn_dim=128, max_seq_len=seq,
+                          remat=False)
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Dict[str, Any]:
+    d, f, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    keys = jax.random.split(rng, 12)
+
+    def dense(key, shape, scale=0.02):
+        return jax.random.normal(key, shape, cfg.param_dtype) * scale
+
+    blocks = {
+        "wq": dense(keys[0], (L, d, d)), "bq": jnp.zeros((L, d), cfg.param_dtype),
+        "wk": dense(keys[1], (L, d, d)), "bk": jnp.zeros((L, d), cfg.param_dtype),
+        "wv": dense(keys[2], (L, d, d)), "bv": jnp.zeros((L, d), cfg.param_dtype),
+        "wo": dense(keys[3], (L, d, d)), "bo": jnp.zeros((L, d), cfg.param_dtype),
+        "ln1_g": jnp.ones((L, d), cfg.param_dtype),
+        "ln1_b": jnp.zeros((L, d), cfg.param_dtype),
+        "w_in": dense(keys[4], (L, d, f)), "b_in": jnp.zeros((L, f), cfg.param_dtype),
+        "w_out": dense(keys[5], (L, f, d)), "b_out": jnp.zeros((L, d), cfg.param_dtype),
+        "ln2_g": jnp.ones((L, d), cfg.param_dtype),
+        "ln2_b": jnp.zeros((L, d), cfg.param_dtype),
+    }
+    return {
+        "tok_embed": dense(keys[6], (cfg.vocab_size, d)),
+        "pos_embed": dense(keys[7], (cfg.max_seq_len, d)),
+        "type_embed": dense(keys[8], (cfg.type_vocab, d)),
+        "embed_ln_g": jnp.ones((d,), cfg.param_dtype),
+        "embed_ln_b": jnp.zeros((d,), cfg.param_dtype),
+        "blocks": blocks,
+        "mlm_dense": dense(keys[9], (d, d)),
+        "mlm_bias": jnp.zeros((d,), cfg.param_dtype),
+        "mlm_ln_g": jnp.ones((d,), cfg.param_dtype),
+        "mlm_ln_b": jnp.zeros((d,), cfg.param_dtype),
+        "mlm_out_bias": jnp.zeros((cfg.vocab_size,), cfg.param_dtype),
+    }
+
+
+def _layernorm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out.astype(x.dtype) * g.astype(x.dtype)) + b.astype(x.dtype)
+
+
+def _block(x, p, mask, cfg: BertConfig):
+    B, S, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    q = (x @ p["wq"].astype(dt) + p["bq"].astype(dt)).reshape(B, S, nh, hd)
+    k = (x @ p["wk"].astype(dt) + p["bk"].astype(dt)).reshape(B, S, nh, hd)
+    v = (x @ p["wv"].astype(dt) + p["bv"].astype(dt)).reshape(B, S, nh, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, d)
+    attn = attn @ p["wo"].astype(dt) + p["bo"].astype(dt)
+    x = _layernorm(x + attn, p["ln1_g"], p["ln1_b"], cfg.norm_eps)
+
+    h = jax.nn.gelu(x @ p["w_in"].astype(dt) + p["b_in"].astype(dt))
+    h = h @ p["w_out"].astype(dt) + p["b_out"].astype(dt)
+    return _layernorm(x + h, p["ln2_g"], p["ln2_b"], cfg.norm_eps)
+
+
+def forward(params, tokens: jnp.ndarray, cfg: BertConfig,
+            type_ids=None, attn_mask=None) -> jnp.ndarray:
+    """tokens [B,S] -> final hidden states [B,S,d] (compute dtype)."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["tok_embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[None, :S]
+    if type_ids is not None:
+        x = x + params["type_embed"].astype(dt)[type_ids]
+    x = _layernorm(x, params["embed_ln_g"], params["embed_ln_b"], cfg.norm_eps)
+
+    def body(carry, layer_params):
+        fn = _block
+        if cfg.remat:
+            fn = jax.checkpoint(_block, static_argnums=(3,))
+        return fn(carry, layer_params, attn_mask, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def mlm_logits(params, hidden: jnp.ndarray, cfg: BertConfig) -> jnp.ndarray:
+    dt = cfg.dtype
+    h = jax.nn.gelu(hidden @ params["mlm_dense"].astype(dt)
+                    + params["mlm_bias"].astype(dt))
+    h = _layernorm(h, params["mlm_ln_g"], params["mlm_ln_b"], cfg.norm_eps)
+    logits = h @ params["tok_embed"].astype(dt).T + params["mlm_out_bias"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch: Dict[str, jnp.ndarray], cfg: BertConfig) -> jnp.ndarray:
+    """Masked-LM loss. batch: tokens [B,S], labels [B,S] (-100 = unmasked),
+    optional attn_mask [B,S] bool."""
+    hidden = forward(params, batch["tokens"], cfg,
+                     attn_mask=batch.get("attn_mask"))
+    logits = mlm_logits(params, hidden, cfg)
+    labels = batch["labels"]
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, -1)
+    ll = jnp.take_along_axis(logp, safe_labels[..., None], -1)[..., 0]
+    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -1,0 +1,212 @@
+"""Llama-3-style decoder-only transformer, TPU-first.
+
+This is the framework's flagship model (BASELINE.json config 4: Llama-3-8B
+with compressed push_pull). The reference framework has no model zoo of its
+own — its models come from the example/ scripts — so this module is
+green-field TPU design: pure-functional params pytree (composes directly with
+shard_map/pjit and optax), bfloat16 activations for the MXU, RoPE, grouped-
+query attention, RMSNorm, SwiGLU, and optional ring attention over a
+sequence-parallel mesh axis (byteps_tpu.parallel.ring_attention).
+
+Tensor-parallel sharding rules (applied via NamedSharding in
+byteps_tpu.parallel.sharding): attention QKV/O and MLP in/out projections
+shard over the ``tp`` axis in the Megatron pattern (column- then row-
+parallel), embeddings shard over vocab.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336          # SwiGLU inner dim
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32   # master weights
+    remat: bool = True               # jax.checkpoint each block
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, seq: int = 128) -> "LlamaConfig":
+        """Test-scale config: same code path, toy sizes."""
+        return LlamaConfig(vocab_size=vocab_size, dim=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, hidden_dim=128,
+                           max_seq_len=seq, remat=False)
+
+    @staticmethod
+    def small(vocab_size: int = 32000) -> "LlamaConfig":
+        """~125M benchmark config that fits one chip comfortably."""
+        return LlamaConfig(vocab_size=vocab_size, dim=768, n_layers=12,
+                           n_heads=12, n_kv_heads=4, hidden_dim=2048,
+                           max_seq_len=2048)
+
+
+# --------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------- #
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree. Layer params are stacked on a leading
+    [n_layers] dim so the whole decoder runs as one lax.scan — one compiled
+    block instead of n_layers copies (XLA-friendly, fast compiles)."""
+    k_emb, k_blk, k_out = jax.random.split(rng, 3)
+    d, h = cfg.dim, cfg.hidden_dim
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    L = cfg.n_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, cfg.param_dtype)
+
+    def dense_init(key, shape, scale=None):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
+        scale = scale if scale is not None else 1.0 / np.sqrt(fan_in)
+        return (jax.random.normal(key, shape, cfg.param_dtype) * scale)
+
+    ks = jax.random.split(k_blk, 7)
+    block = {
+        "attn_norm": norm_init(L, d),
+        "wq": dense_init(ks[0], (L, d, nh * hd)),
+        "wk": dense_init(ks[1], (L, d, nkv * hd)),
+        "wv": dense_init(ks[2], (L, d, nkv * hd)),
+        "wo": dense_init(ks[3], (L, nh * hd, d)),
+        "mlp_norm": norm_init(L, d),
+        "w_gate": dense_init(ks[4], (L, d, h)),
+        "w_up": dense_init(ks[5], (L, d, h)),
+        "w_down": dense_init(ks[6], (L, h, d)),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, d), scale=0.02),
+        "blocks": block,
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(k_out, (d, cfg.vocab_size)),
+    }
+
+
+def param_count(params: Dict[str, Any]) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+# --------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------- #
+
+def _rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    # compute in fp32 for stability, cast back
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * w.astype(x.dtype)
+
+
+def rope_cache(cfg: LlamaConfig, seq_len: int,
+               offset: int = 0) -> tuple:
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (np.arange(0, hd, 2) / hd))
+    t = np.arange(offset, offset + seq_len)
+    freqs = np.outer(t, inv_freq)                      # [S, hd/2]
+    return (jnp.asarray(np.cos(freqs), jnp.float32),
+            jnp.asarray(np.sin(freqs), jnp.float32))
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, S, H, hd]; rotate pairs (even, odd interleave as halves)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, attn_impl=None):
+    """Causal GQA attention. q:[B,S,nh,hd] k,v:[B,S,nkv,hd].
+
+    ``attn_impl``: optional override, e.g. a ring-attention callable bound to
+    a sequence-parallel axis (parallel/ring_attention.py).
+    """
+    if attn_impl is not None:
+        return attn_impl(q, k, v)
+    B, S, nh, hd = q.shape
+    groups = nh // k.shape[2]
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    scale = 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
+    """One decoder block; p holds this layer's (unstacked) params."""
+    B, S, d = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = _rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, S, nh, hd)
+    k = (h @ p["wk"].astype(dt)).reshape(B, S, nkv, hd)
+    v = (h @ p["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attention(q, k, v, cfg, attn_impl)
+    x = x + attn.reshape(B, S, nh * hd) @ p["wo"].astype(dt)
+
+    h = _rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
+    up = h @ p["w_up"].astype(dt)
+    x = x + (gate * up) @ p["w_down"].astype(dt)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
+            attn_impl=None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cache(cfg, S)
+
+    blk = params["blocks"]
+
+    def body(x, layer_params):
+        fn = _block
+        if cfg.remat:
+            fn = jax.checkpoint(_block, static_argnums=(4, 5))
+        # attn_impl is closed over (static); layer params come from scan
+        return fn(x, layer_params, cos, sin, cfg, attn_impl), None
+
+    x, _ = jax.lax.scan(body, x, blk)
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+            cfg: LlamaConfig, attn_impl=None) -> jnp.ndarray:
+    """Next-token cross-entropy. batch: {"tokens": [B, S]} — predicts
+    tokens[:, 1:] from tokens[:, :-1]."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg, attn_impl)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

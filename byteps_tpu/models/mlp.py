@@ -1,0 +1,56 @@
+"""Small MLP classifier — the MNIST parity model.
+
+BASELINE.json config 1 mirrors the reference's
+example/pytorch/train_mnist_byteps.py (a 2-conv + 2-fc net); this MLP plus
+models/resnet's conv stack cover that surface. Pure-functional params pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (256, 128)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(rng: jax.Array, cfg: MLPConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim, *cfg.hidden, cfg.n_classes]
+    params = {}
+    keys = jax.random.split(rng, len(dims) - 1)
+    for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (din, dout),
+                                            cfg.dtype) / np.sqrt(din)
+        params[f"b{i}"] = jnp.zeros((dout,), cfg.dtype)
+    return params
+
+
+def forward(params: Dict[str, Any], x: jnp.ndarray, cfg: MLPConfig) -> jnp.ndarray:
+    n_layers = len(cfg.hidden) + 1
+    h = x.reshape(x.shape[0], -1).astype(cfg.dtype)
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def loss_fn(params, batch: Dict[str, jnp.ndarray], cfg: MLPConfig) -> jnp.ndarray:
+    logits = forward(params, batch["x"], cfg)
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(params, batch, cfg: MLPConfig) -> jnp.ndarray:
+    logits = forward(params, batch["x"], cfg)
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))

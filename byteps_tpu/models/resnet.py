@@ -1,0 +1,177 @@
+"""ResNet (v1.5) for image classification, TPU-first.
+
+ResNet-50 is the reference's standard throughput benchmark
+(docs/performance.md:5-29; BASELINE.json config 2 on v5e-8). Functional
+params; NHWC layout (TPU-native); bf16 compute; BatchNorm uses per-device
+batch statistics in training (the same local-BN semantics the reference gets
+from per-GPU torch BN), with EMA running stats kept in a separate state
+pytree for eval.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+    n_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @staticmethod
+    def resnet50() -> "ResNetConfig":
+        return ResNetConfig()
+
+    @staticmethod
+    def resnet18() -> "ResNetConfig":
+        # basic blocks approximated with bottlenecks at reduced width for
+        # test scale; exact resnet18 basic-block variant is not needed for
+        # the benchmark surface.
+        return ResNetConfig(stage_sizes=(2, 2, 2, 2))
+
+    @staticmethod
+    def tiny(n_classes: int = 10) -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(1, 1), width=16, n_classes=n_classes)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * np.sqrt(2.0 / fan_in)
+
+
+def _bn_params(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig) -> Tuple[Dict, Dict]:
+    """Returns (params, bn_state)."""
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 256))
+    params: Dict[str, Any] = {
+        "stem_conv": _conv_init(next(keys), 7, 7, 3, cfg.width, pd),
+        "stem_bn": _bn_params(cfg.width, pd),
+    }
+    state: Dict[str, Any] = {"stem_bn": _bn_state(cfg.width)}
+
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** s)
+        cout = cmid * 4
+        for b in range(n_blocks):
+            name = f"s{s}b{b}"
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cmid, pd),
+                "bn1": _bn_params(cmid, pd),
+                "conv2": _conv_init(next(keys), 3, 3, cmid, cmid, pd),
+                "bn2": _bn_params(cmid, pd),
+                "conv3": _conv_init(next(keys), 1, 1, cmid, cout, pd),
+                "bn3": _bn_params(cout, pd),
+            }
+            st = {"bn1": _bn_state(cmid), "bn2": _bn_state(cmid),
+                  "bn3": _bn_state(cout)}
+            if cin != cout or b == 0:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                blk["proj_bn"] = _bn_params(cout, pd)
+                st["proj_bn"] = _bn_state(cout)
+            params[name] = blk
+            state[name] = st
+            cin = cout
+    params["fc_w"] = jax.random.normal(next(keys), (cin, cfg.n_classes), pd) * 0.01
+    params["fc_b"] = jnp.zeros((cfg.n_classes,), pd)
+    return params, state
+
+
+def _batchnorm(x, p, st, cfg, train: bool):
+    """Returns (y, new_state). Batch stats in train mode (per device)."""
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new_st = {"mean": m * st["mean"] + (1 - m) * mean,
+                  "var": m * st["var"] + (1 - m) * var}
+    else:
+        mean, var = st["mean"], st["var"]
+        new_st = st
+    inv = jax.lax.rsqrt(var + cfg.bn_eps)
+    y = (xf - mean) * inv
+    y = y.astype(x.dtype) * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+    return y, new_st
+
+
+def _conv(x, w, stride=1, dtype=None):
+    w = w.astype(dtype or x.dtype)
+    pad = ((w.shape[0] - 1) // 2, (w.shape[0] - 1) // 2)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=(pad, (pad[0], pad[0])) if w.shape[0] > 1 else "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def forward(params, state, x: jnp.ndarray, cfg: ResNetConfig,
+            train: bool = True):
+    """x [B,H,W,3] -> (logits [B,n_classes] fp32, new_bn_state)."""
+    dt = cfg.dtype
+    x = x.astype(dt)
+    new_state: Dict[str, Any] = {}
+
+    h = _conv(x, params["stem_conv"], stride=2)
+    h, new_state["stem_bn"] = _batchnorm(h, params["stem_bn"],
+                                         state["stem_bn"], cfg, train)
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            name = f"s{s}b{b}"
+            blk, st = params[name], state[name]
+            nst = {}
+            stride = 2 if (s > 0 and b == 0) else 1
+            y = _conv(h, blk["conv1"])
+            y, nst["bn1"] = _batchnorm(y, blk["bn1"], st["bn1"], cfg, train)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv2"], stride=stride)
+            y, nst["bn2"] = _batchnorm(y, blk["bn2"], st["bn2"], cfg, train)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv3"])
+            y, nst["bn3"] = _batchnorm(y, blk["bn3"], st["bn3"], cfg, train)
+            if "proj" in blk:
+                sc = _conv(h, blk["proj"], stride=stride)
+                sc, nst["proj_bn"] = _batchnorm(sc, blk["proj_bn"],
+                                                st["proj_bn"], cfg, train)
+            else:
+                sc = h
+            h = jax.nn.relu(y + sc)
+            new_state[name] = nst
+
+    h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+    logits = h @ params["fc_w"].astype(jnp.float32) + params["fc_b"].astype(jnp.float32)
+    return logits, new_state
+
+
+def loss_fn(params, state, batch, cfg: ResNetConfig):
+    """Returns (loss, new_state) — use with jax.value_and_grad(has_aux=True)."""
+    logits, new_state = forward(params, state, batch["x"], cfg, train=True)
+    logp = jax.nn.log_softmax(logits, -1)
+    loss = -jnp.mean(jnp.take_along_axis(logp, batch["y"][:, None], -1))
+    return loss, new_state
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -47,11 +47,19 @@ from ..parallel.mesh import DP_AXIS
 
 def psum_tree(tree: Any, axis: str = DP_AXIS, average: bool = True) -> Any:
     """Sum (or mean) every leaf across ``axis``. The REDUCE+PUSH+PULL+
-    BROADCAST pipeline collapsed into one XLA allreduce."""
+    BROADCAST pipeline collapsed into one XLA allreduce. Integer leaves keep
+    their dtype under averaging (truncating, like the reference's post-hoc
+    ``div_(size)`` on int tensors, torch/ops.cc:78-90)."""
     summed = jax.lax.psum(tree, axis_name=axis)
     if average:
         n = jax.lax.axis_size(axis)
-        summed = jax.tree.map(lambda g: g / n, summed)
+
+        def avg(g):
+            if jnp.issubdtype(g.dtype, jnp.integer):
+                return g // n
+            return g / n
+
+        summed = jax.tree.map(avg, summed)
     return summed
 
 
@@ -115,15 +123,19 @@ def _cached_push_pull(mesh: Mesh, shape, dtype, average: bool, axis: str):
 
 
 def push_pull(tensor, name: Optional[str] = None, average: bool = True,
-              axis: str = DP_AXIS, priority: int = 0):
+              axis: str = DP_AXIS, priority: int = 0, stacked: bool = False):
     """Horovod-compatible eager push_pull.
 
-    ``tensor`` carries one slice per mesh device stacked on the leading dim
-    (shape ``(n_devices, *s)``), or a plain ``(*s)`` array meaning every
-    device contributes the same value. Returns the sum (mean when
-    ``average``) of shape ``(*s)``, replicated over the mesh — the same
-    contract as the reference's framework-level ``byteps.push_pull``
-    (reference: byteps/torch/__init__.py:139, ops.py:157-174).
+    With ``stacked=True``, ``tensor`` carries one slice per mesh device on
+    the leading dim (shape ``(n_devices, *s)``) — the single-controller
+    analogue of "each worker contributes its own value". With the default
+    ``stacked=False``, ``tensor`` (shape ``(*s)``) is the value every device
+    contributes. Either way returns the sum (mean when ``average``) of shape
+    ``(*s)``, replicated — the contract of the reference's framework-level
+    ``byteps.push_pull`` (reference: byteps/torch/__init__.py:139,
+    ops.py:157-174). The flag is explicit because shape inference here is a
+    silent-corruption hazard (a replicated tensor whose dim 0 happens to
+    equal the mesh size).
     """
     state = get_state()
     if not state.initialized:
@@ -132,7 +144,12 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
     n = mesh.shape.get(axis, 1)
 
     x = jnp.asarray(tensor)
-    if x.ndim == 0 or x.shape[0] != n:
+    if stacked:
+        if x.ndim == 0 or x.shape[0] != n:
+            raise ValueError(
+                f"stacked push_pull expects leading dim {n} (mesh '{axis}' "
+                f"size), got shape {x.shape}")
+    else:
         x = jnp.broadcast_to(x, (n,) + x.shape)
 
     if name is not None:
@@ -150,13 +167,16 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
-              axis: str = DP_AXIS):
-    """Broadcast the root device's slice to all devices.
+              axis: str = DP_AXIS, stacked: bool = False):
+    """Broadcast the root device's value to all devices.
 
-    Implemented the way the reference implements broadcast_parameters —
-    zero the non-root contributions, then push_pull(sum) (reference:
-    byteps/torch/__init__.py:261-293) — which XLA lowers to a broadcast and
-    whose replicated output shard_map can infer statically.
+    ``stacked=True``: ``tensor`` is ``(n_devices, *s)`` per-device values and
+    the root's slice wins. ``stacked=False`` (default): ``tensor`` is the
+    local value (already replicated under single-controller JAX); the
+    collective still runs, asserting device agreement and keeping parity
+    with the multi-process path. Implemented the way the reference
+    implements broadcast_parameters — zero the non-root contributions, then
+    push_pull(sum) (reference: byteps/torch/__init__.py:261-293).
     """
     state = get_state()
     if not state.initialized:
@@ -164,7 +184,12 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     mesh = state.mesh
     n = mesh.shape.get(axis, 1)
     x = jnp.asarray(tensor)
-    if x.ndim == 0 or x.shape[0] != n:
+    if stacked:
+        if x.ndim == 0 or x.shape[0] != n:
+            raise ValueError(
+                f"stacked broadcast expects leading dim {n} (mesh '{axis}' "
+                f"size), got shape {x.shape}")
+    else:
         x = jnp.broadcast_to(x, (n,) + x.shape)
     return _cached_broadcast(mesh, root_rank, axis)(x)
 

@@ -1,0 +1,83 @@
+"""Named-sharding rules: how model params/batches lay out over the mesh.
+
+This is the GSPMD tier of the framework: annotate shardings, jit, and XLA
+inserts the collectives (psum over dp for gradients, all-gathers/
+reduce-scatters for tp) — the compiler-native counterpart of the
+hand-scheduled pipeline in the reference's core_loops.cc. The shard_map tier
+(ops/push_pull.py) is used where we want explicit control (push_pull
+semantics, ring attention, the PS boundary); this tier is used for whole-
+model tensor parallelism where the Megatron pattern is expressed purely as
+weight layouts:
+
+- column-parallel (out-dim over tp):  QKV projections, MLP in/gate
+- row-parallel (in-dim over tp):      attention output, MLP down
+- vocab-parallel: embedding + lm head
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DP_AXIS, SP_AXIS, TP_AXIS
+
+
+def llama_param_specs(params_shape: Any) -> Any:
+    """PartitionSpec pytree for models/llama.py params (layers stacked on
+    leading dim L, which never shards)."""
+    rules = {
+        "embed": P(TP_AXIS, None),          # vocab-parallel
+        "final_norm": P(),
+        "lm_head": P(None, TP_AXIS),        # vocab-parallel out
+        "blocks": {
+            "attn_norm": P(),
+            "wq": P(None, None, TP_AXIS),   # column-parallel
+            "wk": P(None, None, TP_AXIS),
+            "wv": P(None, None, TP_AXIS),
+            "wo": P(None, TP_AXIS, None),   # row-parallel
+            "mlp_norm": P(),
+            "w_gate": P(None, None, TP_AXIS),
+            "w_up": P(None, None, TP_AXIS),
+            "w_down": P(None, TP_AXIS, None),
+        },
+    }
+    return rules
+
+
+def bert_param_specs(params_shape: Any) -> Any:
+    b = {
+        "wq": P(None, None, TP_AXIS), "bq": P(),
+        "wk": P(None, None, TP_AXIS), "bk": P(),
+        "wv": P(None, None, TP_AXIS), "bv": P(),
+        "wo": P(None, TP_AXIS, None), "bo": P(),
+        "ln1_g": P(), "ln1_b": P(),
+        "w_in": P(None, None, TP_AXIS), "b_in": P(),
+        "w_out": P(None, TP_AXIS, None), "b_out": P(),
+        "ln2_g": P(), "ln2_b": P(),
+    }
+    return {
+        "tok_embed": P(TP_AXIS, None), "pos_embed": P(), "type_embed": P(),
+        "embed_ln_g": P(), "embed_ln_b": P(),
+        "blocks": b,
+        "mlm_dense": P(None, TP_AXIS), "mlm_bias": P(),
+        "mlm_ln_g": P(), "mlm_ln_b": P(),
+        "mlm_out_bias": P(),
+    }
+
+
+def to_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec(shard_seq: bool = False) -> P:
+    """Batch tokens [B, S]: B over dp, optionally S over sp."""
+    return P(DP_AXIS, SP_AXIS) if shard_seq else P(DP_AXIS)
+
+
+def place_params(params: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """device_put the param pytree according to the spec tree."""
+    shardings = to_shardings(mesh, spec_tree)
+    return jax.tree.map(jax.device_put, params, shardings)

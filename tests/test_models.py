@@ -1,0 +1,82 @@
+"""Model-zoo smoke + correctness tests (tiny configs, CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byteps_tpu.core.state import get_state
+from byteps_tpu.jax import distributed_optimizer
+from byteps_tpu.jax.train import make_train_step
+from byteps_tpu.models import bert, resnet
+
+
+def test_bert_forward_and_mlm_loss(bps):
+    cfg = bert.BertConfig.tiny(vocab_size=100, seq=32)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.ones((2, 32), jnp.int32)
+    hidden = bert.forward(params, tokens, cfg)
+    assert hidden.shape == (2, 32, cfg.dim)
+    labels = jnp.where(jnp.arange(32)[None, :] % 7 == 0, tokens, -100)
+    loss = bert.loss_fn(params, {"tokens": tokens, "labels": labels}, cfg)
+    assert np.isfinite(float(loss))
+
+
+def test_bert_trains(bps):
+    mesh = get_state().mesh
+    cfg = bert.BertConfig.tiny(vocab_size=50, seq=16)
+    # fp32 at tiny scale for a stable loss-decrease signal
+    cfg = bert.BertConfig(vocab_size=50, dim=64, n_layers=2, n_heads=4,
+                          ffn_dim=128, max_seq_len=16, remat=False,
+                          dtype=jnp.float32)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    tx = distributed_optimizer(optax.adam(1e-3))
+    step = make_train_step(lambda p, b: bert.loss_fn(p, b, cfg), tx, mesh)
+    opt_state = tx.init(params)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 50, size=(16, 16)).astype(np.int32)
+    labels = np.where(rng.rand(16, 16) < 0.15, tokens, -100).astype(np.int32)
+    batch = {"tokens": tokens, "labels": labels}
+    losses = []
+    for _ in range(15):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet_forward_shapes(bps):
+    cfg = resnet.ResNetConfig.tiny()
+    params, state = resnet.init_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    logits, new_state = resnet.forward(params, state, x, cfg, train=True)
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    # eval mode uses running stats and leaves state alone
+    logits_eval, st2 = resnet.forward(params, state, x, cfg, train=False)
+    assert logits_eval.shape == (2, 10)
+
+
+def test_resnet_trains(bps):
+    mesh = get_state().mesh
+    cfg = resnet.ResNetConfig.tiny(n_classes=4)
+    params, bn_state = resnet.init_params(jax.random.PRNGKey(0), cfg)
+    tx = distributed_optimizer(optax.sgd(0.05))
+
+    def loss_with_aux(p, b):
+        # bn_state is threaded through as an aux output; for this test the
+        # batch-stat path suffices so we drop new_state in the loss
+        loss, _ = resnet.loss_fn(p, bn_state, b, cfg)
+        return loss
+
+    step = make_train_step(loss_with_aux, tx, mesh)
+    opt_state = tx.init(params)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,)).astype(np.int32)
+    batch = {"x": x, "y": y}
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

@@ -24,12 +24,12 @@ def test_push_pull_sums(bps, shape, dtype):
     if np.issubdtype(dtype, np.integer):
         x = rng.randint(-10, 10, size=(n,) + shape).astype(dtype)
         out = bps.push_pull(x, name=f"sum_{shape}_{np.dtype(dtype).name}",
-                            average=False)
+                            average=False, stacked=True)
         np.testing.assert_array_equal(np.asarray(out), x.sum(axis=0))
     else:
         x = rng.randn(n, *shape).astype(dtype)
         out = bps.push_pull(x, name=f"avg_{shape}_{np.dtype(dtype).name}",
-                            average=True)
+                            average=True, stacked=True)
         rtol = 1e-3 if dtype == np.float16 else 1e-5
         np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=rtol,
                                    atol=rtol)
@@ -45,7 +45,7 @@ def test_push_pull_replicated_input(bps):
 
 def test_broadcast_root_value(bps):
     x = np.arange(8 * 5, dtype=np.float32).reshape(8, 5)
-    out = bps.broadcast(x, root_rank=3)
+    out = bps.broadcast(x, root_rank=3, stacked=True)
     np.testing.assert_array_equal(np.asarray(out), x[3])
 
 
