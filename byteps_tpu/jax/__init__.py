@@ -33,13 +33,28 @@ __all__ = [
 ]
 
 
+def _psum_transform(axis: str, average: bool) -> optax.GradientTransformation:
+    """Stateless cross-replica gradient sum as an optax transformation."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(grads, state, params=None):
+        del params
+        return psum_tree(grads, axis=axis, average=average), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def distributed_optimizer(
     tx: optax.GradientTransformation,
     axis: str = DP_AXIS,
     average: bool = True,
     backward_passes_per_step: int = 1,
-    compression: Optional[Any] = None,
-    named_tensors: bool = True,
+    compression: Optional[dict] = None,
+    params_example: Optional[Any] = None,
+    min_compress_bytes: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so its gradients are push_pulled across
     ``axis`` before the update — the functional equivalent of the reference's
@@ -49,22 +64,28 @@ def distributed_optimizer(
     Must be used inside ``shard_map``/``pjit`` with ``axis`` bound (the train
     step is compiled over the mesh). ``backward_passes_per_step`` maps to
     optax.MultiSteps, mirroring the reference's gradient accumulation
-    (torch/__init__.py:85-115). ``compression`` is a codec from
-    byteps_tpu.ops.compression applied leaf-wise before the cross-replica
-    sum (the COMPRESS/DECOMPRESS pipeline stages).
+    (torch/__init__.py:85-115).
+
+    ``compression`` is a string-kwargs dict for the codec registry (e.g.
+    ``{"compressor": "onebit", "ef": "vanilla"}``, the reference's
+    byteps_compressor parameter surface); it requires ``params_example`` to
+    fix payload shapes, and swaps the plain psum for the compressed
+    all_gather reduction with EF/momentum state carried in the optimizer
+    state.
     """
+    if compression is not None:
+        if params_example is None:
+            raise ValueError(
+                "compression requires params_example (a pytree of arrays "
+                "or ShapeDtypeStructs matching the gradients)")
+        from ..ops.compression import compression_transform
+        comm = compression_transform(params_example, compression, axis=axis,
+                                     average=average,
+                                     min_compress_bytes=min_compress_bytes)
+    else:
+        comm = _psum_transform(axis, average)
 
-    def init_fn(params):
-        return tx.init(params)
-
-    def update_fn(grads, state, params=None):
-        if compression is not None:
-            grads = compression.forward_tree(grads, axis=axis, average=average)
-        else:
-            grads = psum_tree(grads, axis=axis, average=average)
-        return tx.update(grads, state, params)
-
-    wrapped = optax.GradientTransformation(init_fn, update_fn)
+    wrapped = optax.chain(comm, tx)
     if backward_passes_per_step > 1:
         wrapped = optax.MultiSteps(wrapped, every_k_schedule=backward_passes_per_step)
     return wrapped
@@ -72,6 +93,45 @@ def distributed_optimizer(
 
 # Horovod-style alias matching the reference's class name.
 DistributedOptimizer = distributed_optimizer
+
+
+def opt_state_specs(tx: optax.GradientTransformation, params: Any,
+                    axis: str = DP_AXIS) -> Any:
+    """PartitionSpec pytree for ``tx.init(params)``'s state.
+
+    Compression state (EF error, momentum residuals) is *per-replica* — each
+    device corrects its own local compression loss — so those leaves shard
+    over ``axis`` (each device owns its slice of the flat global array).
+    Everything else (adam moments, counts) is replicated. Use together with
+    ``init_opt_state`` and pass to ``make_train_step(opt_specs=...)``;
+    declaring the per-replica state replicated would be a silent-corruption
+    hazard on any reshard/checkpoint.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+
+    def spec_of(path, leaf):
+        keys = {getattr(k, "key", None) for k in path}
+        if "compress" in keys and getattr(leaf, "ndim", 0) > 0:
+            return P(axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_of, shapes)
+
+
+def init_opt_state(tx: optax.GradientTransformation, params: Any, mesh,
+                   axis: str = DP_AXIS):
+    """Initialize optimizer state with per-replica compression state laid
+    out sharded over ``axis`` (see opt_state_specs). Returns
+    (opt_state, opt_specs)."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = opt_state_specs(tx, params, axis)
+    init = jax.jit(jax.shard_map(
+        tx.init, mesh=mesh, in_specs=(P(),), out_specs=specs,
+        check_vma=False))
+    return init(params), specs
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0,

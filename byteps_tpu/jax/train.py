@@ -39,6 +39,7 @@ def make_train_step(
     grads_transform: Optional[Callable] = None,
     donate: bool = True,
     extra_batch_axes: Tuple[str, ...] = (),
+    opt_specs: Any = None,
 ):
     """Build a jitted SPMD train step.
 
@@ -50,9 +51,16 @@ def make_train_step(
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
     Batch leaves are sharded on their leading dim over ``axis`` (+
     ``extra_batch_axes``, e.g. ("sp",) to also shard sequence).
+
+    ``opt_specs``: PartitionSpec pytree for the optimizer state; REQUIRED
+    (via byteps_tpu.jax.init_opt_state) when ``tx`` carries per-replica
+    compression state (EF/momentum) — those leaves are device-varying and
+    must be declared sharded, not replicated.
     """
     batch_spec = P((axis,) + tuple(extra_batch_axes)) \
         if extra_batch_axes else P(axis)
+    if opt_specs is None:
+        opt_specs = P()
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -65,8 +73,8 @@ def make_train_step(
 
     smapped = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), batch_spec),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), opt_specs, batch_spec),
+        out_specs=(P(), opt_specs, P()),
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()

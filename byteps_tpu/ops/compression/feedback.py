@@ -1,0 +1,66 @@
+"""Error feedback and momentum wrappers for compression codecs.
+
+The reference stacks these decorator-style: Momentum wraps ErrorFeedback
+wraps a base Compressor (compressor.h:28-52). Here the stack is a pure
+function over (grad, state):
+
+- ErrorFeedback (error_feedback.cc:22-43):
+    corrected = grad + error
+    payload   = codec.compress(corrected)
+    error'    = corrected - codec.decompress(payload)
+- Nesterov momentum (momentum.h:25-45, nesterov_momentum.cc:39-50): the
+  velocity update runs *before* compression and must replace the framework
+  optimizer's own momentum:
+    m'   = mu * m + grad
+    out  = grad + mu * m'
+
+State lives in the optimizer state pytree (see compression_transform in
+__init__.py), keeping everything functional/jit-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .codecs import Codec
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressorStack:
+    """momentum -> error feedback -> base codec, any stage optional."""
+
+    codec: Codec
+    use_ef: bool = False
+    momentum_mu: Optional[float] = None   # None = no momentum stage
+
+    def init_state(self, size: int) -> Dict[str, Any]:
+        st: Dict[str, Any] = {}
+        if self.use_ef:
+            st["error"] = jnp.zeros((size,), jnp.float32)
+        if self.momentum_mu is not None:
+            st["momentum"] = jnp.zeros((size,), jnp.float32)
+        return st
+
+    def compress(self, grad: jnp.ndarray, state: Dict[str, Any],
+                 step: int = 0) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(payload, new_state). ``grad`` flat f32."""
+        new_state = dict(state)
+        x = grad
+        if self.momentum_mu is not None:
+            mu = self.momentum_mu
+            m = mu * state["momentum"] + x
+            new_state["momentum"] = m
+            x = x + mu * m
+        if self.use_ef:
+            x = x + state["error"]
+            payload = self.codec.compress(x, step)
+            new_state["error"] = x - self.codec.decompress(payload)
+        else:
+            payload = self.codec.compress(x, step)
+        return payload, new_state
+
+    def decompress(self, payload: Dict[str, Any]) -> jnp.ndarray:
+        return self.codec.decompress(payload)

@@ -1,0 +1,117 @@
+"""Pallas TPU kernels for the hot codec paths.
+
+The reference's codecs are CPU OpenMP loops over host shared memory
+(byteps/common/compressor/impl/*.cc); here the pack/unpack runs on the TPU's
+vector unit so compressed push_pull never leaves the device (SURVEY.md §2.2
+TPU note). The jnp implementations in codecs.py remain the reference
+semantics (and the CPU-test path); these kernels are drop-in replacements
+dispatched on TPU.
+
+Layout: Mosaic cannot reshape the lane (last, 128-wide) dimension, so onebit
+packs sign bits across the *sublane* dimension: input viewed as rows of 128
+lanes; 32 consecutive rows fold into one uint32 row. Element i lives at
+row i//128, lane i%128; its bit is bit (row % 32) of word
+[row//32, lane]. Pack and unpack share this layout, so decompressed values
+are identical to the jnp codec's (+/-scale per element) even though the
+word order on the wire differs; the C++ PS mirror must use this same layout
+when summing payloads natively.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_FOLD = 32                      # rows folded into one uint32 row
+_BLOCK_WORD_ROWS = 8            # uint32 rows per grid step
+_BLOCK_ROWS = _FOLD * _BLOCK_WORD_ROWS  # = 256 input rows per grid step
+
+
+def _onebit_pack_kernel(x_ref, bits_ref):
+    x = x_ref[:]                                    # (256, 128) f32
+    signs = (x >= 0).astype(jnp.int32)
+    grouped = signs.reshape(_BLOCK_WORD_ROWS, _FOLD, _LANES)
+    # Mosaic has no unsigned reductions: accumulate in int32 (distinct
+    # powers of two; the 1<<31 wraparound is benign) and bitcast after.
+    weights = (jnp.int32(1) << jax.lax.broadcasted_iota(
+        jnp.int32, (1, _FOLD, 1), 1))
+    packed = jnp.sum(grouped * weights, axis=1, dtype=jnp.int32)
+    bits_ref[:] = pltpu.bitcast(packed, jnp.uint32)
+
+
+def _onebit_unpack_kernel(bits_ref, scale_ref, out_ref):
+    bits = pltpu.bitcast(bits_ref[:], jnp.int32)    # (8, 128)
+    expanded = bits[:, None, :] >> jax.lax.broadcasted_iota(
+        jnp.int32, (1, _FOLD, 1), 1)
+    on = (expanded & 1).astype(jnp.float32)         # (8, 32, 128)
+    signs = on * 2.0 - 1.0
+    out_ref[:] = signs.reshape(_BLOCK_ROWS, _LANES) * scale_ref[0]
+
+
+def _padded_rows(n: int) -> int:
+    rows = (n + _LANES - 1) // _LANES
+    return (rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS * _BLOCK_ROWS
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def onebit_pack(x: jnp.ndarray, interpret: bool = False):
+    """Flat f32 [n] -> bits uint32[(rows//32) * 128] (scaling is the
+    caller's job — see OnebitCodec).
+
+    Sign convention matches OnebitCodec/onebit.cc:34-66; padding elements
+    are 0 -> bit 1, sliced away by unpack.
+    """
+    n = x.shape[0]
+    rows = _padded_rows(n)
+    padded = jnp.zeros((rows * _LANES,), jnp.float32).at[:n].set(x)
+    x2d = padded.reshape(rows, _LANES)
+
+    bits = pl.pallas_call(
+        _onebit_pack_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows // _FOLD, _LANES), jnp.uint32),
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((_BLOCK_WORD_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d)
+    return bits.reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def onebit_unpack(bits: jnp.ndarray, scale: jnp.ndarray, n: int,
+                  interpret: bool = False) -> jnp.ndarray:
+    """(bits, scale, n) -> flat f32 [n] of +/-scale (inverts onebit_pack)."""
+    word_rows = bits.shape[0] // _LANES
+    bits2d = bits.reshape(word_rows, _LANES)
+    rows = word_rows * _FOLD
+    scale_arr = jnp.full((1,), scale, jnp.float32)
+
+    out = pl.pallas_call(
+        _onebit_unpack_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+        grid=(word_rows // _BLOCK_WORD_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_WORD_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(bits2d, scale_arr)
+    return out.reshape(-1)[:n]
+
+
+def tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False

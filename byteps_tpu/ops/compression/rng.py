@@ -1,0 +1,160 @@
+"""xorshift128+ RNG, bit-exact across numpy (golden), JAX (on-device) and the
+C++ server.
+
+The reference uses xorshift128+ so worker and server draw identical random
+sequences for randomk and dithering (reference:
+byteps/common/compressor/utils.h:69-110), and its tests replicate the C++
+generator in numba-compiled Python (tests/utils.py:31-51). We keep the same
+scheme. The JAX implementation represents each 64-bit lane as a (hi, lo)
+uint32 pair — TPUs have no 64-bit integer units, and this also sidesteps
+jax's x64 flag — while producing draws identical to the numpy golden model.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def seed_state(seed: int) -> Tuple[int, int]:
+    """Derive the 128-bit state from a seed (splitmix64 twice, standard
+    xorshift seeding); shared by all implementations."""
+    state = []
+    z = np.uint64(seed) & _M64
+    with np.errstate(over="ignore"):
+        for _ in range(2):
+            z = (z + np.uint64(0x9E3779B97F4A7C15)) & _M64
+            x = z
+            x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & _M64
+            x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & _M64
+            x = x ^ (x >> np.uint64(31))
+            state.append(int(x))
+    return state[0], state[1]
+
+
+def np_xorshift128p(seed: int, n: int, mix: int = 0) -> np.ndarray:
+    """Golden model: n uint64 draws. ``mix`` (e.g. the training step) is
+    XORed into the low lane of s0 so per-step streams differ; the jnp
+    implementation applies the identical scheme, so the two stay bit-exact
+    even when the step is only known inside jit."""
+    s0, s1 = (np.uint64(v) for v in seed_state(seed))
+    s0 = s0 ^ np.uint64(mix & 0xFFFFFFFF)
+    out = np.empty(n, np.uint64)
+    with np.errstate(over="ignore"):
+        for i in range(n):
+            x, y = s0, s1
+            s0 = y
+            x = x ^ ((x << np.uint64(23)) & _M64)
+            s1 = (x ^ y ^ (x >> np.uint64(17)) ^ (y >> np.uint64(26))) & _M64
+            out[i] = (s1 + y) & _M64
+    return out
+
+
+# ------------------------------------------------------------------ #
+# 64-bit lanes as (hi, lo) uint32 pairs — jit/TPU friendly
+# ------------------------------------------------------------------ #
+
+def _shl(h, l, k: int):
+    k = np.uint32(k)
+    return ((h << k) | (l >> (np.uint32(32) - k))) , (l << k)
+
+
+def _shr(h, l, k: int):
+    k = np.uint32(k)
+    return (h >> k), ((l >> k) | (h << (np.uint32(32) - k)))
+
+
+def _add(h1, l1, h2, l2):
+    lo = l1 + l2
+    carry = (lo < l1).astype(jnp.uint32)
+    return h1 + h2 + carry, lo
+
+
+def jnp_xorshift128p(seed: int, n: int, mix=0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """n draws as (hi, lo) uint32 arrays, matching np_xorshift128p:
+    hi == draw >> 32, lo == draw & 0xffffffff. ``mix`` may be a traced
+    int32/uint32 scalar (e.g. the step counter inside jit)."""
+    from jax import lax
+
+    s0, s1 = seed_state(seed)
+
+    def split(v):
+        return jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF)
+
+    def body(carry, _):
+        s0h, s0l, s1h, s1l = carry
+        xh, xl, yh, yl = s0h, s0l, s1h, s1l
+        n0h, n0l = yh, yl
+        sh, sl = _shl(xh, xl, 23)
+        xh, xl = xh ^ sh, xl ^ sl
+        r17h, r17l = _shr(xh, xl, 17)
+        r26h, r26l = _shr(yh, yl, 26)
+        n1h = xh ^ yh ^ r17h ^ r26h
+        n1l = xl ^ yl ^ r17l ^ r26l
+        oh, ol = _add(n1h, n1l, yh, yl)
+        return (n0h, n0l, n1h, n1l), (oh, ol)
+
+    s0h, s0l = split(s0)
+    s0l = s0l ^ jnp.asarray(mix).astype(jnp.uint32)
+    init = (s0h, s0l, *split(s1))
+    _, (hi, lo) = lax.scan(body, init, None, length=n)
+    return hi, lo
+
+
+def _np_mm3(h: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = h ^ (h >> np.uint32(16))
+        h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+        h = h ^ (h >> np.uint32(13))
+        h = (h * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+        h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def np_uniform_parallel(seed: int, n: int, mix: int = 0,
+                        dtype=np.float32) -> np.ndarray:
+    """Counter-based parallel uniforms: murmur3 finalizer over
+    (index, seed, mix). O(1) depth — unlike the sequential xorshift stream —
+    so it is the right generator for per-element noise (dithering's
+    Bernoulli rounding) where no cross-party stream agreement is needed,
+    only np/jnp bit-parity. Golden model."""
+    s0, _ = seed_state(seed)
+    base = np.uint32(s0 & 0xFFFFFFFF) ^ np.uint32(mix & 0xFFFFFFFF)
+    with np.errstate(over="ignore"):
+        h = (np.arange(n, dtype=np.uint32) * np.uint32(0x9E3779B1) + base) \
+            & np.uint32(0xFFFFFFFF)
+    h = _np_mm3(h)
+    return ((h >> np.uint32(8)).astype(np.float64) / float(1 << 24)).astype(dtype)
+
+
+def jnp_uniform_parallel(seed: int, n: int, mix=0,
+                         dtype=jnp.float32) -> jnp.ndarray:
+    """Bit-exact jnp twin of np_uniform_parallel; ``mix`` may be traced."""
+    s0, _ = seed_state(seed)
+    base = jnp.uint32(s0 & 0xFFFFFFFF) ^ jnp.asarray(mix).astype(jnp.uint32)
+    h = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x9E3779B1) + base
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return ((h >> jnp.uint32(8)).astype(jnp.float32) / float(1 << 24)).astype(dtype)
+
+
+def np_uniform(seed: int, n: int, mix: int = 0, dtype=np.float32) -> np.ndarray:
+    """[0,1) floats from the top 24 bits of each golden draw."""
+    bits = np_xorshift128p(seed, n, mix)
+    return ((bits >> np.uint64(40)).astype(np.float64)
+            / float(1 << 24)).astype(dtype)
+
+
+def jnp_uniform(seed: int, n: int, mix=0, dtype=jnp.float32) -> jnp.ndarray:
+    """Same values as np_uniform, computed from the (hi, lo) lanes: the top
+    24 bits are hi >> 8."""
+    hi, _ = jnp_xorshift128p(seed, n, mix)
+    return ((hi >> np.uint32(8)).astype(jnp.float32)
+            / float(1 << 24)).astype(dtype)
