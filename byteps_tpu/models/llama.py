@@ -179,11 +179,30 @@ def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
 
 
 def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
-            attn_impl=None) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+            attn_impl=None, sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32).
+
+    ``sp_axis``: when running inside shard_map with the sequence sharded
+    over that mesh axis (ring attention), RoPE must use *global* positions:
+    the cache covers S * axis_size positions and each device slices its
+    chunk at axis_index * S.
+    """
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
-    cos, sin = rope_cache(cfg, S)
+    if sp_axis is not None and attn_impl is None:
+        # local dense attention would silently never cross shard
+        # boundaries; ring attention over the same axis is the only
+        # correct default here
+        from ..parallel.ring_attention import make_ring_attn
+        attn_impl = make_ring_attn(axis=sp_axis, causal=True)
+    if sp_axis is not None:
+        n_sp = jax.lax.axis_size(sp_axis)
+        cos_full, sin_full = rope_cache(cfg, S * n_sp)
+        start = jax.lax.axis_index(sp_axis) * S
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, start, S, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, start, S, axis=0)
+    else:
+        cos, sin = rope_cache(cfg, S)
 
     blk = params["blocks"]
 
@@ -201,12 +220,29 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
 
 
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
-            cfg: LlamaConfig, attn_impl=None) -> jnp.ndarray:
-    """Next-token cross-entropy. batch: {"tokens": [B, S]} — predicts
-    tokens[:, 1:] from tokens[:, :-1]."""
-    tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, attn_impl)
-    targets = tokens[:, 1:]
+            cfg: LlamaConfig, attn_impl=None,
+            sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Next-token cross-entropy.
+
+    batch: {"tokens": [B, S]} — predicts tokens[:, 1:] from tokens[:, :-1];
+    or pre-shifted {"inputs", "targets"} (required under sequence
+    parallelism, where the shift must happen before sharding).
+    """
+    if "inputs" in batch:
+        logits = forward(params, batch["inputs"], cfg, attn_impl, sp_axis)
+        targets = batch["targets"]
+    else:
+        if sp_axis is not None:
+            raise ValueError(
+                "sequence parallelism requires a pre-shifted batch "
+                "({'inputs', 'targets'}): shifting a sharded 'tokens' "
+                "locally would gap the global sequence")
+        tokens = batch["tokens"]
+        logits = forward(params, tokens[:, :-1], cfg, attn_impl, sp_axis)
+        targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if sp_axis is not None:
+        loss = jax.lax.pmean(loss, sp_axis)
+    return loss

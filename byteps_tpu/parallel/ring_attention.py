@@ -1,0 +1,114 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh axis.
+
+The reference framework has no sequence/context parallelism at all
+(SURVEY.md §5.7 — verified absent); this is green-field TPU design, following
+the ring-attention pattern (Liu et al.; blockwise online-softmax streaming):
+the sequence is sharded over the ``sp`` axis, each device keeps its Q shard
+resident and passes K/V shards around the ring with ``lax.ppermute``, folding
+each incoming block into a numerically-stable streaming softmax (running
+max / running normalizer, flash-attention style). Communication rides
+ICI neighbor links — n-1 permutes of the local KV shard — instead of an
+all_gather of the whole sequence, so the memory high-water mark stays
+O(S/n) per device and compute overlaps the permute.
+
+Causality over the ring: with ring step r, the incoming KV block originated
+at device (me - r) mod n. Blocks from later devices are fully masked (we
+skip their contribution entirely via lax.cond-free where-masking to stay
+SPMD-uniform); the self block applies the triangular mask; earlier blocks
+attend fully.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import SP_AXIS
+
+_NEG_INF = -1e30
+
+
+def _block_attn_accum(q, k, v, mask, m, l, o, scale):
+    """Fold one KV block into the streaming softmax accumulators.
+
+    q [B,Sq,H,D]; k,v [B,Sk,H,D]; mask [Sq,Sk] bool or None;
+    m,l [B,H,Sq]; o [B,Sq,H,D].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)                      # [B,H,Sq]
+    m_new = jnp.maximum(m, m_blk)
+    # exp with the new running max; fully-masked rows stay zero
+    p = jnp.exp(scores - m_new[..., None])                # [B,H,Sq,Sk]
+    corr = jnp.exp(m - m_new)                             # [B,H,Sq]
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] \
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis: str = SP_AXIS, causal: bool = True) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis``.
+
+    q [B, S_local, H, D], k/v [B, S_local, Hkv, D] (GQA: Hkv divides H).
+    Must run inside shard_map with ``axis`` bound. Returns [B,S_local,H,D].
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    B, S, H, D = q.shape
+    groups = H // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    scale = 1.0 / np.sqrt(D)
+
+    q32 = q.astype(jnp.float32)
+    tri = jnp.tril(jnp.ones((S, S), bool))
+
+    m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(r, carry):
+        m, l, o, kr, vr = carry
+        src = (me - r) % n                  # where this KV block came from
+        k32, v32 = kr.astype(jnp.float32), vr.astype(jnp.float32)
+        if causal:
+            # src < me: full attention; src == me: triangular; src > me:
+            # fully masked. Computed uniformly (SPMD) with a where-mask.
+            full = src < me
+            diag = src == me
+            mask2d = (tri & diag) | full          # broadcasts to (S, S)
+            m2, l2, o2 = _block_attn_accum(q32, k32, v32, mask2d,
+                                           m, l, o, scale)
+            use = full | diag
+            m = jnp.where(use, m2, m)
+            l = jnp.where(use, l2, l)
+            o = jnp.where(use, o2, o)
+        else:
+            m, l, o = _block_attn_accum(q32, k32, v32, None, m, l, o, scale)
+        # rotate KV around the ring (skip after the last fold)
+        kr = jax.lax.ppermute(kr, axis, perm)
+        vr = jax.lax.ppermute(vr, axis, perm)
+        return m, l, o, kr, vr
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attn(axis: str = SP_AXIS, causal: bool = True):
+    """Bind ring_attention as a models.llama ``attn_impl``."""
+
+    def impl(q, k, v):
+        return ring_attention(q, k, v, axis=axis, causal=causal)
+
+    return impl

@@ -1,0 +1,118 @@
+"""Ring attention correctness: must match dense causal attention exactly
+(it's an exact algorithm, not an approximation), including GQA, and compose
+with the Llama forward under sequence sharding."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.core.state import get_state
+from byteps_tpu.models import llama
+from byteps_tpu.parallel.ring_attention import make_ring_attn, ring_attention
+
+
+def dense_causal(q, k, v):
+    B, S, H, D = q.shape
+    groups = H // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("hkv", [8, 2])   # MHA and GQA
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(bps, hkv, causal):
+    mesh = get_state().mesh      # 8 devices on "dp"; reuse as the ring axis
+    B, S, H, D = 2, 64, 8, 16
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, hkv, D).astype(np.float32)
+    v = rng.randn(B, S, hkv, D).astype(np.float32)
+
+    if causal:
+        ref = dense_causal(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    else:
+        kk = jnp.repeat(jnp.asarray(k), H // hkv, axis=2)
+        vv = jnp.repeat(jnp.asarray(v), H // hkv, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", jnp.asarray(q), kk) / np.sqrt(D)
+        p = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+    ring = jax.jit(jax.shard_map(
+        functools.partial(ring_attention, axis="dp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "dp"), P(None, "dp"), P(None, "dp")),
+        out_specs=P(None, "dp"), check_vma=False))
+    out = ring(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_forward_sp_matches_dense(bps):
+    """Llama forward with sequence sharded over the mesh == unsharded."""
+    mesh = get_state().mesh
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq=64)
+    # fp32 for exact comparison
+    import dataclasses
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 64)), jnp.int32)
+
+    ref = llama.forward(params, tokens, cfg)
+
+    fwd_sp = jax.jit(jax.shard_map(
+        lambda p, t: llama.forward(p, t, cfg,
+                                   attn_impl=make_ring_attn(axis="dp"),
+                                   sp_axis="dp"),
+        mesh=mesh, in_specs=(P(), P(None, "dp")), out_specs=P(None, "dp"),
+        check_vma=False))
+    out = fwd_sp(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_llama_sp_trains(bps):
+    """End-to-end: tiny llama trains with ring attention + sequence
+    sharding (loss decreases)."""
+    import dataclasses
+    mesh = get_state().mesh
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=32, seq=64),
+                              dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    def local_loss(p, b):
+        return llama.loss_fn(p, b, cfg, attn_impl=make_ring_attn(axis="dp"),
+                             sp_axis="dp")
+
+    def step(p, o, b):
+        loss, g = jax.value_and_grad(local_loss)(p, b)
+        # grads already identical across sp (pmean'd loss); adam update
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    stepj = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(None, "dp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    seq = (np.arange(65)[None, :] + np.arange(4)[:, None]) % 13
+    batch = {"inputs": jnp.asarray(seq[:, :-1], jnp.int32),
+             "targets": jnp.asarray(seq[:, 1:], jnp.int32)}
+    losses = []
+    for _ in range(25):
+        params, opt, loss = stepj(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
