@@ -138,7 +138,8 @@ class GlobalState:
             bps_check(self.initialized, "suspend() before init()")
             if self.ps_client is not None:
                 try:
-                    self.ps_client.close()
+                    # leave servers running for resume
+                    self.ps_client.close(shutdown_servers=False)
                 except Exception:  # noqa: BLE001
                     pass
                 self.ps_client = None

@@ -139,10 +139,18 @@ def broadcast_parameters(params: Any, root_rank: int = 0,
     """Make every device's copy of ``params`` equal to the root's.
 
     Reference semantics: byteps/torch/__init__.py:261-293 (zero-non-root +
-    push_pull). Here: a native broadcast collective per leaf.
+    push_pull). Here: a native broadcast collective per leaf; in
+    multi-worker PS mode each leaf also round-trips the DCN PS keyed by its
+    tree path, so workers converge to the root worker's copy.
     """
-    return jax.tree.map(lambda p: broadcast(p, root_rank=root_rank, axis=axis),
-                        params)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in leaves:
+        name = "param/" + "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        out.append(broadcast(leaf, root_rank=root_rank, name=name,
+                             axis=axis))
+    return treedef.unflatten(out)
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, axis: str = DP_AXIS) -> Any:

@@ -160,6 +160,74 @@ def make_zero_train_step(
     return _with_tracer_tick(jax.jit(smapped, donate_argnums=donate_argnums))
 
 
+def make_ps_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DP_AXIS,
+):
+    """Two-phase train step for the DCN PS path — the reference's actual
+    architecture (docs/architecture.md "General Workflow"): the compiled
+    program reduces gradients over the local slice (ICI psum == the NCCL
+    ReduceScatter tier), gradients exit to host, the PS client push_pulls
+    each declared tensor across workers in priority order (the PUSH/PULL
+    stages over DCN), and a second compiled program applies the optimizer
+    update on the worker (servers only sum).
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
+    reads the PS client + registry from the global state at call time, so
+    it composes with suspend/resume.
+    """
+    import numpy as np
+
+    from ..core.state import get_state
+
+    def local_grads(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = psum_tree(grads, axis=axis, average=True)
+        loss = jax.lax.pmean(loss, axis)
+        return loss, grads
+
+    grad_fn = jax.jit(jax.shard_map(
+        local_grads, mesh=mesh, in_specs=(P(), P(axis)),
+        out_specs=(P(), P()), check_vma=False))
+
+    def apply_updates_fn(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    apply_fn = jax.jit(apply_updates_fn, donate_argnums=(0, 1))
+
+    def step(params, opt_state, batch):
+        state = get_state()
+        client = state.ps_client
+        loss, grads = grad_fn(params, batch)
+        if client is not None:
+            from ..server.client import ps_round_trip
+            paths, treedef = jax.tree_util.tree_flatten_with_path(grads)
+            items = []
+            for idx, (path, leaf) in enumerate(paths):
+                name = "grad/" + "/".join(
+                    str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+                # declare up-front so declared_key order is stable
+                ctx = state.registry.declare(name)
+                items.append((idx, ctx, name, np.asarray(leaf)))
+            # priority order: earlier-declared first (the reference uses
+            # priority = -declared_key, tensorflow/ops.cc:155-158)
+            results = [None] * len(items)
+            for idx, ctx, name, host in sorted(
+                    items, key=lambda t: t[1].declared_key):
+                out = ps_round_trip(state, name, host.reshape(-1),
+                                    average=True)
+                results[idx] = out.reshape(host.shape)
+            grads = treedef.unflatten(results)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step
+
+
 def init_zero_state(params, tx: optax.GradientTransformation, mesh: Mesh,
                     axis: str = DP_AXIS):
     """Initialize optimizer state over flat 1/N param shards (matches

@@ -1,0 +1,51 @@
+"""Lazy native build: compile ps.cc into libbyteps_ps.so on first use.
+
+The reference builds its native pieces through a 1141-line setup.py
+(reference: setup.py); since this framework must work without pip install,
+the shared library is compiled on demand with g++ and cached next to the
+source keyed by content hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ps.cc")
+_LOCK = threading.Lock()
+
+CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+
+def lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"libbyteps_ps-{digest}.so")
+
+
+def build(verbose: bool = False) -> str:
+    """Compile (if needed) and return the shared-library path."""
+    out = lib_path()
+    with _LOCK:
+        if os.path.exists(out):
+            return out
+        cmd = ["g++", *CXXFLAGS, _SRC, "-o", out + ".tmp"]
+        if verbose:
+            print("[byteps_tpu] building native PS:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed:\n{proc.stderr[-4000:]}")
+        os.replace(out + ".tmp", out)
+        # clean stale builds
+        for f in os.listdir(_DIR):
+            if (f.startswith("libbyteps_ps-") and f.endswith(".so")
+                    and os.path.join(_DIR, f) != out):
+                try:
+                    os.remove(os.path.join(_DIR, f))
+                except OSError:
+                    pass
+        return out

@@ -1,0 +1,804 @@
+// byteps_tpu DCN parameter server + worker client (C++17, POSIX sockets).
+//
+// TPU-native re-implementation of the reference's inter-node tier:
+// byteps/server/server.cc (BytePSHandler, engine threads, parked pulls,
+// sync/async modes) + the ps-lite ZPush/ZPull worker API used by
+// byteps/common/core_loops.cc:538-618. The RDMA/ZMQ transport becomes
+// length-prefixed TCP over DCN; zero-copy is approximated with one-copy
+// into page-aligned stores (reference: PageAlignedMalloc, server.cc:266-295).
+//
+// Protocol (little-endian, same-arch assumption documented in server/README):
+//   MsgHeader { magic u32; op u8; flags u8; sender u16; rid u32; key u64;
+//               cmd u32; len u32 }  -- 28 bytes, then len payload bytes.
+// Ops: INIT_PUSH, PUSH, PULL, BARRIER, SHUTDOWN from workers;
+//      ACK, PULL_REPLY from the server. Every request carries a worker-side
+//      request id (rid) echoed in the reply, so one connection multiplexes
+//      concurrent blocking calls from many scheduler threads (the ps-lite
+//      callback model, flattened to promise/wait).
+//
+// Aggregation protocol per key (sync mode, mirrors server.cc:296-409):
+//   - INIT_PUSH allocates the page-aligned store; the reply is withheld
+//     until all num_workers init-pushes arrive (global barrier semantics).
+//   - steady PUSH: first of a round memcpy's into accum, later ones sum
+//     (dtype-aware), the last one copies accum->merged, bumps
+//     completed_rounds and flushes parked pulls.
+//   - PULL from worker w is answerable iff completed_rounds >= w's push
+//     count (their contribution is folded in); otherwise parked.
+//   - async mode (BYTEPS_ENABLE_ASYNC, server.cc:315-319): every push sums
+//     straight into merged, pulls always answered.
+//
+// Engine threads: keys are load-balanced over N engine threads by
+// accumulated bytes (reference: server.h:154-178); each thread owns a
+// priority queue ordered by per-key completed push count when scheduling
+// is enabled (reference: server/queue.h:31-105).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace bps {
+
+static constexpr uint32_t kMagic = 0xB17E5000;
+
+enum Op : uint8_t {
+  INIT_PUSH = 1,
+  PUSH = 2,
+  PULL = 3,
+  BARRIER = 4,
+  SHUTDOWN = 5,
+  ACK = 6,
+  PULL_REPLY = 7,
+};
+
+// DataType codes match byteps_tpu.core.types.DataType (mshadow order).
+enum DType : uint32_t {
+  F32 = 0, F64 = 1, F16 = 2, U8 = 3, I32 = 4, I8 = 5, I64 = 6,
+  BF16 = 7, U16 = 8,
+};
+
+#pragma pack(push, 1)
+struct MsgHeader {
+  uint32_t magic;
+  uint8_t op;
+  uint8_t flags;
+  uint16_t sender;
+  uint32_t rid;
+  uint64_t key;
+  uint32_t cmd;   // cantor(request_type, dtype) — common.cc:98-101
+  uint32_t len;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(MsgHeader) == 28, "header layout");
+
+// Inverse Cantor pairing (common.cc:98-101).
+static inline void decode_cmd(uint32_t cmd, uint32_t* req, uint32_t* dtype) {
+  uint64_t w = (uint64_t)((std::sqrt(8.0 * cmd + 1) - 1) / 2);
+  uint64_t t = w * (w + 1) / 2;
+  *dtype = (uint32_t)(cmd - t);
+  *req = (uint32_t)(w - *dtype);
+}
+
+static bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool recv_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// dtype-aware summation: dst += src. Plain loops; -O3 auto-vectorizes
+// (the reference uses OpenMP SIMD pragmas, cpu_reducer.cc:59-120).
+static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
+  switch (dtype) {
+    case F32: {
+      float* d = (float*)dst;
+      const float* s = (const float*)src;
+      size_t n = bytes / 4;
+      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      break;
+    }
+    case F64: {
+      double* d = (double*)dst;
+      const double* s = (const double*)src;
+      size_t n = bytes / 8;
+      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      break;
+    }
+    case I32: {
+      int32_t* d = (int32_t*)dst;
+      const int32_t* s = (const int32_t*)src;
+      size_t n = bytes / 4;
+      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      break;
+    }
+    case I64: {
+      int64_t* d = (int64_t*)dst;
+      const int64_t* s = (const int64_t*)src;
+      size_t n = bytes / 8;
+      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      break;
+    }
+    case U8: case I8: {
+      uint8_t* d = (uint8_t*)dst;
+      const uint8_t* s = (const uint8_t*)src;
+      for (size_t i = 0; i < bytes; ++i) d[i] += s[i];
+      break;
+    }
+    default:
+      std::fprintf(stderr, "[bps-server] unsupported dtype %u for sum\n",
+                   dtype);
+      std::abort();
+  }
+}
+
+// ------------------------------------------------------------------ //
+// server
+// ------------------------------------------------------------------ //
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+  bool send_msg(const MsgHeader& h, const void* payload) {
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (!send_all(fd, &h, sizeof(h))) return false;
+    if (h.len && payload && !send_all(fd, payload, h.len)) return false;
+    return true;
+  }
+};
+
+struct ParkedPull {
+  std::shared_ptr<Conn> conn;
+  uint32_t rid;
+  uint16_t sender;
+};
+
+struct KeyStore {
+  std::vector<uint8_t> accum;    // receiving buffer for the current round
+  std::vector<uint8_t> merged;   // buffer served to pulls
+  uint32_t len = 0;
+  uint32_t dtype = F32;
+  uint32_t init_count = 0;       // init pushes seen
+  std::vector<ParkedPull> parked_inits;
+  uint32_t recv_count = 0;       // pushes folded this round
+  uint64_t completed_rounds = 0;
+  std::vector<uint64_t> worker_push_count;  // per worker
+  std::vector<ParkedPull> parked_pulls;
+  uint64_t total_pushes = 0;     // for priority scheduling
+};
+
+struct EngineMsg {
+  uint8_t op;
+  uint64_t key;
+  uint32_t dtype;
+  uint32_t rid;
+  uint16_t sender;
+  std::vector<uint8_t> payload;  // push data
+  std::shared_ptr<Conn> conn;
+};
+
+class EngineQueue {
+ public:
+  explicit EngineQueue(bool priority) : priority_(priority) {}
+
+  void push(EngineMsg&& m, uint64_t prio) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      q_.push({prio, seq_++, std::move(m)});
+    }
+    cv_.notify_one();
+  }
+
+  bool wait_pop(EngineMsg* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return stop_ || !q_.empty(); });
+    if (q_.empty()) return false;
+    // const_cast is safe: we pop immediately after moving
+    *out = std::move(const_cast<Item&>(q_.top()).msg);
+    q_.pop();
+    return true;
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  struct Item {
+    uint64_t prio;  // lower = first (push count when scheduling enabled)
+    uint64_t seq;
+    EngineMsg msg;
+    bool operator<(const Item& o) const {
+      if (prio != o.prio) return prio > o.prio;  // min-heap on prio
+      return seq > o.seq;                        // FIFO within a level
+    }
+  };
+  bool priority_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::priority_queue<Item> q_;
+  uint64_t seq_ = 0;
+  bool stop_ = false;
+};
+
+class Server {
+ public:
+  Server(int port, int num_workers, int num_engine_threads, bool async_mode,
+         bool enable_schedule)
+      : port_(port), num_workers_(num_workers),
+        async_(async_mode), schedule_(enable_schedule) {
+    for (int i = 0; i < num_engine_threads; ++i) {
+      queues_.emplace_back(new EngineQueue(enable_schedule));
+      engine_bytes_.push_back(0);
+    }
+    for (int i = 0; i < num_engine_threads; ++i) {
+      engine_threads_.emplace_back([this, i] { EngineLoop(i); });
+    }
+  }
+
+  int Run() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port_);
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      std::perror("[bps-server] bind");
+      return 1;
+    }
+    ::listen(listen_fd_, 64);
+    while (!shutting_down_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one2 = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      auto conn = std::make_shared<Conn>();
+      conn->fd = fd;
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      conn_threads_.emplace_back([this, conn] { ConnLoop(conn); });
+    }
+    Join();
+    return 0;
+  }
+
+  void Join() {
+    for (auto& q : queues_) q->stop();
+    for (auto& t : engine_threads_)
+      if (t.joinable()) t.join();
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  int ThreadForKey(uint64_t key, uint32_t len) {
+    // assign new keys to the least-loaded engine by accumulated bytes
+    // (reference: server.h:154-178)
+    std::lock_guard<std::mutex> lk(assign_mu_);
+    auto it = key_thread_.find(key);
+    if (it != key_thread_.end()) return it->second;
+    int best = 0;
+    for (size_t i = 1; i < engine_bytes_.size(); ++i)
+      if (engine_bytes_[i] < engine_bytes_[best]) best = (int)i;
+    engine_bytes_[best] += len;
+    key_thread_[key] = best;
+    return best;
+  }
+
+  void ConnLoop(std::shared_ptr<Conn> conn) {
+    MsgHeader h;
+    while (recv_all(conn->fd, &h, sizeof(h))) {
+      if (h.magic != kMagic) {
+        std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
+        break;
+      }
+      EngineMsg m;
+      m.op = h.op;
+      m.key = h.key;
+      m.rid = h.rid;
+      m.sender = h.sender;
+      m.conn = conn;
+      uint32_t req, dtype;
+      decode_cmd(h.cmd, &req, &dtype);
+      m.dtype = dtype;
+      if (h.len) {
+        m.payload.resize(h.len);
+        if (!recv_all(conn->fd, m.payload.data(), h.len)) break;
+      }
+      if (h.op == BARRIER) {
+        HandleBarrier(std::move(m));
+        continue;
+      }
+      if (h.op == SHUTDOWN) {
+        HandleShutdown(std::move(m));
+        break;
+      }
+      uint64_t prio = 0;
+      if (schedule_) {
+        std::lock_guard<std::mutex> lk(stores_mu_);
+        auto it = stores_.find(h.key);
+        // fewer completed pushes -> earlier (queue.h:31-105)
+        prio = it == stores_.end() ? 0 : it->second.total_pushes;
+      }
+      queues_[ThreadForKey(h.key, h.len)]->push(std::move(m), prio);
+    }
+  }
+
+  void HandleBarrier(EngineMsg&& m) {
+    std::vector<ParkedPull> release;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      barrier_waiters_.push_back({m.conn, m.rid, m.sender});
+      if ((int)barrier_waiters_.size() == num_workers_) {
+        release.swap(barrier_waiters_);
+      }
+    }
+    for (auto& w : release) {
+      MsgHeader r{kMagic, ACK, 0, 0, w.rid, 0, 0, 0};
+      w.conn->send_msg(r, nullptr);
+    }
+  }
+
+  void HandleShutdown(EngineMsg&& m) {
+    MsgHeader r{kMagic, ACK, 0, 0, m.rid, 0, 0, 0};
+    m.conn->send_msg(r, nullptr);
+    if (++shutdown_count_ >= num_workers_) {
+      shutting_down_.store(true);
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      for (auto& q : queues_) q->stop();
+    }
+  }
+
+  void EngineLoop(int idx) {
+    EngineMsg m;
+    while (queues_[idx]->wait_pop(&m)) {
+      switch (m.op) {
+        case INIT_PUSH: DoInit(m); break;
+        case PUSH: DoPush(m); break;
+        case PULL: DoPull(m); break;
+        default: break;
+      }
+    }
+  }
+
+  KeyStore& store_of(uint64_t key) {
+    std::lock_guard<std::mutex> lk(stores_mu_);
+    return stores_[key];
+  }
+
+  void DoInit(EngineMsg& m) {
+    // first push of a key allocates; reply withheld until every worker's
+    // init push arrived (server.cc:266-295)
+    std::vector<ParkedPull> release;
+    {
+      KeyStore& ks = store_of(m.key);
+      std::lock_guard<std::mutex> lk(key_mu_);
+      if (ks.len == 0) {
+        ks.len = (uint32_t)m.payload.size();
+        ks.dtype = m.dtype;
+        ks.accum.assign(ks.len, 0);
+        ks.merged = m.payload;  // init value (typically zeros or weights)
+        ks.worker_push_count.assign(num_workers_, 0);
+      }
+      ks.init_count++;
+      ks.parked_inits.push_back({m.conn, m.rid, m.sender});
+      if ((int)ks.init_count >= num_workers_) {
+        release.swap(ks.parked_inits);
+        ks.init_count = 0;  // allow re-init (elastic)
+      }
+    }
+    for (auto& w : release) {
+      MsgHeader r{kMagic, ACK, 0, 0, w.rid, m.key, 0, 0};
+      w.conn->send_msg(r, nullptr);
+    }
+  }
+
+  void DoPush(EngineMsg& m) {
+    std::vector<ParkedPull> flush;
+    KeyStore& ks = store_of(m.key);
+    {
+      std::lock_guard<std::mutex> lk(key_mu_);
+      if (ks.len == 0) {
+        std::fprintf(stderr, "[bps-server] push before init key=%llu\n",
+                     (unsigned long long)m.key);
+        // flags bit0 = error: reply instead of dropping, so the client
+        // raises instead of hanging on a never-acked request
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
+      ks.total_pushes++;
+      if (m.sender < ks.worker_push_count.size())
+        ks.worker_push_count[m.sender]++;
+      if (async_) {
+        // async: sum straight into merged (server.cc:315-319)
+        sum_into(ks.merged.data(), m.payload.data(), m.payload.size(),
+                 ks.dtype);
+        ks.completed_rounds++;
+        flush.swap(ks.parked_pulls);
+      } else {
+        if (ks.recv_count == 0) {
+          std::memcpy(ks.accum.data(), m.payload.data(), m.payload.size());
+        } else {
+          sum_into(ks.accum.data(), m.payload.data(), m.payload.size(),
+                   ks.dtype);
+        }
+        ks.recv_count++;
+        if ((int)ks.recv_count >= num_workers_) {
+          // ALL_RECV: publish and flush parked pulls (server.cc:345-375)
+          std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
+          ks.recv_count = 0;
+          ks.completed_rounds++;
+          flush.swap(ks.parked_pulls);
+        }
+      }
+    }
+    // ack the push (ZPush completion callback)
+    MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+    m.conn->send_msg(r, nullptr);
+    for (auto& p : flush) AnswerPull(ks, p);
+  }
+
+  bool PullReady(KeyStore& ks, uint16_t sender) {
+    if (async_) return true;
+    uint64_t pushed = sender < ks.worker_push_count.size()
+                          ? ks.worker_push_count[sender] : 0;
+    return ks.completed_rounds >= pushed;
+  }
+
+  void AnswerPull(KeyStore& ks, const ParkedPull& p) {
+    MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0, ks.len};
+    // merged is stable between rounds; the copy races only with the next
+    // round's ALL_RECV memcpy, which the key mutex serializes
+    std::vector<uint8_t> snapshot;
+    {
+      std::lock_guard<std::mutex> lk(key_mu_);
+      snapshot = ks.merged;
+    }
+    p.conn->send_msg(r, snapshot.data());
+  }
+
+  void DoPull(EngineMsg& m) {
+    KeyStore& ks = store_of(m.key);
+    bool ready;
+    bool uninit = false;
+    {
+      std::lock_guard<std::mutex> lk(key_mu_);
+      uninit = ks.len == 0;
+      ready = !uninit && PullReady(ks, m.sender);
+      if (!uninit && !ready) {
+        ks.parked_pulls.push_back({m.conn, m.rid, m.sender});
+      }
+    }
+    if (uninit) {
+      // pull before init: error reply (DoInit never flushes parked pulls,
+      // so parking here would hang the client forever)
+      std::fprintf(stderr, "[bps-server] pull before init key=%llu\n",
+                   (unsigned long long)m.key);
+      MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+      m.conn->send_msg(r, nullptr);
+      return;
+    }
+    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender});
+  }
+
+  int port_;
+  int num_workers_;
+  bool async_;
+  bool schedule_;
+  int listen_fd_ = -1;
+  std::atomic<bool> shutting_down_{false};
+  std::atomic<int> shutdown_count_{0};
+
+  std::vector<std::unique_ptr<EngineQueue>> queues_;
+  std::vector<std::thread> engine_threads_;
+  std::vector<uint64_t> engine_bytes_;
+  std::unordered_map<uint64_t, int> key_thread_;
+  std::mutex assign_mu_;
+
+  std::unordered_map<uint64_t, KeyStore> stores_;
+  std::mutex stores_mu_;
+  std::mutex key_mu_;  // coarse per-server key mutex (reference uses a
+                       // single handle_mu_ too, server.cc:208)
+
+  std::mutex conns_mu_;
+  std::vector<std::thread> conn_threads_;
+
+  std::mutex barrier_mu_;
+  std::vector<ParkedPull> barrier_waiters_;
+};
+
+// ------------------------------------------------------------------ //
+// client
+// ------------------------------------------------------------------ //
+
+struct Waiter {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  void* out = nullptr;
+  uint32_t out_len = 0;
+  uint32_t got_len = 0;
+  bool ok = true;
+};
+
+class ServerConn {
+ public:
+  bool Connect(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) return false;
+    for (int attempt = 0; attempt < 200; ++attempt) {
+      if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        recv_thread_ = std::thread([this] { RecvLoop(); });
+        return true;
+      }
+      ::usleep(50 * 1000);  // server may not be up yet (rendezvous retry)
+    }
+    return false;
+  }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+    if (recv_thread_.joinable()) recv_thread_.join();
+  }
+
+  // blocking request: returns got_len or ~0u on failure
+  uint32_t Request(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
+                   const void* data, uint32_t len, void* out,
+                   uint32_t out_len) {
+    auto w = std::make_shared<Waiter>();
+    w->out = out;
+    w->out_len = out_len;
+    uint32_t rid = next_rid_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      waiters_[rid] = w;
+    }
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      if (!send_all(fd_, &h, sizeof(h)) ||
+          (len && !send_all(fd_, data, len))) {
+        std::lock_guard<std::mutex> lk2(waiters_mu_);
+        waiters_.erase(rid);
+        return ~0u;
+      }
+    }
+    std::unique_lock<std::mutex> lk(w->mu);
+    w->cv.wait(lk, [&] { return w->done; });
+    return w->ok ? w->got_len : ~0u;
+  }
+
+ private:
+  void RecvLoop() {
+    MsgHeader h;
+    while (recv_all(fd_, &h, sizeof(h))) {
+      std::shared_ptr<Waiter> w;
+      {
+        std::lock_guard<std::mutex> lk(waiters_mu_);
+        auto it = waiters_.find(h.rid);
+        if (it != waiters_.end()) {
+          w = it->second;
+          waiters_.erase(it);
+        }
+      }
+      if (!w) {  // unknown rid: drain payload
+        std::vector<uint8_t> junk(h.len);
+        if (h.len && !recv_all(fd_, junk.data(), h.len)) break;
+        continue;
+      }
+      bool ok = true;
+      if (h.len) {
+        if (w->out && h.len <= w->out_len) {
+          ok = recv_all(fd_, w->out, h.len);
+        } else {
+          std::vector<uint8_t> junk(h.len);
+          ok = recv_all(fd_, junk.data(), h.len);
+        }
+      }
+      bool server_err = (h.flags & 1) != 0;
+      {
+        std::lock_guard<std::mutex> lk(w->mu);
+        w->got_len = h.len;
+        w->ok = ok && !server_err;
+        w->done = true;
+      }
+      w->cv.notify_one();
+      if (!ok) break;
+    }
+    // connection dead: fail all waiters
+    std::lock_guard<std::mutex> lk(waiters_mu_);
+    for (auto& [rid, w] : waiters_) {
+      std::lock_guard<std::mutex> lk2(w->mu);
+      w->ok = false;
+      w->done = true;
+      w->cv.notify_one();
+    }
+    waiters_.clear();
+  }
+
+  int fd_ = -1;
+  std::mutex send_mu_;
+  std::thread recv_thread_;
+  std::mutex waiters_mu_;
+  std::unordered_map<uint32_t, std::shared_ptr<Waiter>> waiters_;
+  std::atomic<uint32_t> next_rid_{1};
+};
+
+class Client {
+ public:
+  bool Connect(const std::vector<std::pair<std::string, int>>& servers,
+               int worker_id) {
+    worker_id_ = (uint16_t)worker_id;
+    conns_.resize(servers.size());
+    for (size_t i = 0; i < servers.size(); ++i) {
+      conns_[i] = std::make_unique<ServerConn>();
+      if (!conns_[i]->Connect(servers[i].first, servers[i].second))
+        return false;
+    }
+    return true;
+  }
+
+  void Close() {
+    for (auto& c : conns_)
+      if (c) c->Close();
+  }
+
+  int InitKey(int server, uint64_t key, const void* data, uint32_t len,
+              uint32_t cmd) {
+    uint32_t r = conns_[server]->Request(INIT_PUSH, key, cmd, worker_id_,
+                                         data, len, nullptr, 0);
+    return r == ~0u ? -1 : 0;
+  }
+
+  int Push(int server, uint64_t key, const void* data, uint32_t len,
+           uint32_t cmd) {
+    uint32_t r = conns_[server]->Request(PUSH, key, cmd, worker_id_, data,
+                                         len, nullptr, 0);
+    return r == ~0u ? -1 : 0;
+  }
+
+  int Pull(int server, uint64_t key, void* out, uint32_t out_len,
+           uint32_t cmd) {
+    uint32_t r = conns_[server]->Request(PULL, key, cmd, worker_id_, nullptr,
+                                         0, out, out_len);
+    return r == ~0u ? -1 : (int)r;
+  }
+
+  int Barrier() {
+    // barrier rides connection 0 (the root server coordinates)
+    uint32_t r = conns_[0]->Request(BARRIER, 0, 0, worker_id_, nullptr, 0,
+                                    nullptr, 0);
+    return r == ~0u ? -1 : 0;
+  }
+
+  int Shutdown() {
+    int rc = 0;
+    for (auto& c : conns_) {
+      if (c->Request(SHUTDOWN, 0, 0, worker_id_, nullptr, 0, nullptr, 0) ==
+          ~0u)
+        rc = -1;
+    }
+    return rc;
+  }
+
+ private:
+  uint16_t worker_id_ = 0;
+  std::vector<std::unique_ptr<ServerConn>> conns_;
+};
+
+}  // namespace bps
+
+// ------------------------------------------------------------------ //
+// C ABI (loaded from Python via ctypes)
+// ------------------------------------------------------------------ //
+
+extern "C" {
+
+void* bps_server_create(int port, int num_workers, int engine_threads,
+                        int async_mode, int enable_schedule) {
+  return new bps::Server(port, num_workers, engine_threads, async_mode != 0,
+                         enable_schedule != 0);
+}
+
+int bps_server_run(void* s) { return ((bps::Server*)s)->Run(); }
+
+void bps_server_destroy(void* s) { delete (bps::Server*)s; }
+
+void* bps_client_create(const char* servers_csv, int worker_id) {
+  // servers_csv: "host:port,host:port,..."
+  std::vector<std::pair<std::string, int>> servers;
+  std::string csv(servers_csv);
+  size_t pos = 0;
+  while (pos < csv.size()) {
+    size_t comma = csv.find(',', pos);
+    if (comma == std::string::npos) comma = csv.size();
+    std::string entry = csv.substr(pos, comma - pos);
+    size_t colon = entry.rfind(':');
+    if (colon == std::string::npos) return nullptr;
+    servers.emplace_back(entry.substr(0, colon),
+                         std::atoi(entry.c_str() + colon + 1));
+    pos = comma + 1;
+  }
+  auto* c = new bps::Client();
+  if (!c->Connect(servers, worker_id)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int bps_client_init_key(void* c, int server, uint64_t key, const void* data,
+                        uint32_t len, uint32_t cmd) {
+  return ((bps::Client*)c)->InitKey(server, key, data, len, cmd);
+}
+
+int bps_client_push(void* c, int server, uint64_t key, const void* data,
+                    uint32_t len, uint32_t cmd) {
+  return ((bps::Client*)c)->Push(server, key, data, len, cmd);
+}
+
+int bps_client_pull(void* c, int server, uint64_t key, void* out,
+                    uint32_t out_len, uint32_t cmd) {
+  return ((bps::Client*)c)->Pull(server, key, out, out_len, cmd);
+}
+
+int bps_client_barrier(void* c) { return ((bps::Client*)c)->Barrier(); }
+
+int bps_client_shutdown(void* c) { return ((bps::Client*)c)->Shutdown(); }
+
+void bps_client_destroy(void* c) {
+  ((bps::Client*)c)->Close();
+  delete (bps::Client*)c;
+}
+
+}  // extern "C"

@@ -161,6 +161,20 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
     fn = _cached_push_pull(mesh, tuple(x.shape[1:]), str(x.dtype), average, axis)
     out = fn(x)
     state.telemetry.record(out.nbytes * n)
+
+    if state.ps_client is not None:
+        # distributed tier: ICI-reduced value round-trips through the DCN
+        # PS for cross-worker summation (REDUCE -> PUSH -> PULL ->
+        # BROADCAST, docs/architecture.md "General Workflow")
+        if name is None:
+            raise ValueError(
+                "push_pull over the PS requires a tensor name (stable keys "
+                "must match across workers; operations.cc:420-427)")
+        from ..server.client import ps_round_trip
+        host = np.asarray(out).reshape(-1)
+        out = jnp.asarray(
+            ps_round_trip(state, name, host, average).reshape(out.shape))
+
     if state.tracer is not None and name is not None:
         state.tracer.instant(name, "push_pull")
     return out
@@ -191,7 +205,24 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
                 f"size), got shape {x.shape}")
     else:
         x = jnp.broadcast_to(x, (n,) + x.shape)
-    return _cached_broadcast(mesh, root_rank, axis)(x)
+    out = _cached_broadcast(mesh, root_rank % n, axis)(x)
+
+    if state.ps_client is not None and state.config.num_workers > 1:
+        # cross-worker tier: the reference's broadcast IS zero-non-root +
+        # push_pull(sum) (torch/__init__.py:261-293). root_rank is global:
+        # worker root_rank // n holds the source copy.
+        if name is None:
+            raise ValueError(
+                "broadcast over the PS requires a tensor name")
+        from ..server.client import ps_round_trip
+        root_worker = root_rank // n
+        host = np.asarray(out).reshape(-1)
+        if state.config.worker_id != root_worker:
+            host = np.zeros_like(host)
+        out = jnp.asarray(
+            ps_round_trip(state, "bcast/" + name, host,
+                          average=False).reshape(out.shape))
+    return out
 
 
 @functools.lru_cache(maxsize=64)

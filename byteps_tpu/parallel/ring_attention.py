@@ -63,9 +63,6 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     me = jax.lax.axis_index(axis)
     B, S, H, D = q.shape
     groups = H // k.shape[2]
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
     scale = 1.0 / np.sqrt(D)
 
     q32 = q.astype(jnp.float32)
@@ -79,7 +76,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def body(r, carry):
         m, l, o, kr, vr = carry
         src = (me - r) % n                  # where this KV block came from
+        # K/V ride the ring with their compact Hkv heads; the GQA expansion
+        # happens per-fold so ppermute traffic stays 1/groups of H
         k32, v32 = kr.astype(jnp.float32), vr.astype(jnp.float32)
+        if groups > 1:
+            k32 = jnp.repeat(k32, groups, axis=2)
+            v32 = jnp.repeat(v32, groups, axis=2)
         if causal:
             # src < me: full attention; src == me: triangular; src > me:
             # fully masked. Computed uniformly (SPMD) with a where-mask.
@@ -94,9 +96,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             o = jnp.where(use, o2, o)
         else:
             m, l, o = _block_attn_accum(q32, k32, v32, None, m, l, o, scale)
-        # rotate KV around the ring (skip after the last fold)
-        kr = jax.lax.ppermute(kr, axis, perm)
-        vr = jax.lax.ppermute(vr, axis, perm)
+
+        # rotate KV around the ring; the rotation after the last fold is
+        # dead traffic, so skip it (r is uniform across devices, making the
+        # cond collective-safe)
+        def rotate(kv):
+            kk, vv = kv
+            return (jax.lax.ppermute(kk, axis, perm),
+                    jax.lax.ppermute(vv, axis, perm))
+
+        kr, vr = jax.lax.cond(r < n - 1, rotate, lambda kv: kv, (kr, vr))
         return m, l, o, kr, vr
 
     m, l, o, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k, v))

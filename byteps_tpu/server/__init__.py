@@ -1,0 +1,42 @@
+"""byteps_tpu.server — the DCN parameter server.
+
+Run a server process with ``python -m byteps_tpu.server`` (role/topology
+from DMLC_* env vars, like the reference's
+``python3 -c 'import byteps.server'`` launched by bpslaunch,
+reference: byteps/server/__init__.py:21-27, launcher/launch.py:241-249).
+
+The server itself is native C++ (byteps_tpu/native/ps.cc): engine threads,
+per-key stores, first-copy/sum/all-recv aggregation, parked pulls, sync +
+async modes. This package holds the thin Python entry and the worker-side
+client (client.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from ..config import Config
+from ..native.build import build
+
+
+def run_server(port: Optional[int] = None,
+               config: Optional[Config] = None) -> int:
+    """Start the native PS and block until all workers send SHUTDOWN."""
+    config = config or Config.from_env()
+    if port is None:
+        server_id = int(os.environ.get("BYTEPS_SERVER_ID", "0"))
+        port = config.scheduler_port + server_id
+    lib = ctypes.CDLL(build())
+    lib.bps_server_create.restype = ctypes.c_void_p
+    lib.bps_server_create.argtypes = [ctypes.c_int] * 5
+    lib.bps_server_run.argtypes = [ctypes.c_void_p]
+    lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
+    srv = lib.bps_server_create(
+        port, max(1, config.num_workers), config.server_engine_threads,
+        1 if config.enable_async else 0,
+        1 if config.server_enable_schedule else 0)
+    rc = lib.bps_server_run(srv)
+    lib.bps_server_destroy(srv)
+    return rc

@@ -1,0 +1,9 @@
+"""``python -m byteps_tpu.server`` — run one PS process (topology from
+DMLC_*/BYTEPS_* env, reference: launcher/launch.py:241-249)."""
+
+import sys
+
+from . import run_server
+
+if __name__ == "__main__":
+    sys.exit(run_server())

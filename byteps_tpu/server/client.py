@@ -1,16 +1,219 @@
-"""DCN parameter-server worker client (placeholder — native transport lands
-with byteps_tpu.server).
+"""DCN parameter-server worker client.
 
-Reference equivalent: ps::KVWorker<char>::ZPush/ZPull over ps-lite
-(3rdparty/ps-lite; used from byteps/common/core_loops.cc:571,609).
+The ps-lite ZPush/ZPull surface (reference: ps::KVWorker<char>, used from
+byteps/common/core_loops.cc:571,609) over the native TCP client in
+byteps_tpu/native/ps.cc. Per-partition push/pull runs on a thread pool in
+priority order — the worker-side seed of the reference's PUSH/PULL pipeline
+stages (core_loops.cc:538-618) — with partitions of one tensor fanned out
+across servers by the registry's key->server assignment.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
+import ctypes
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
 from ..config import Config
+from ..core.types import (
+    DataType, Partition, RequestType, TensorContext, get_command_type,
+)
+from ..native.build import build
+from ..utils.logging import log
 
 
-def connect_from_config(config: Config):
-    raise RuntimeError(
-        "byteps_tpu DCN PS transport is not available yet in this build; "
-        "set DMLC_NUM_SERVER=0 (pure ICI mode) or use init(lazy=True)")
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(build())
+    lib.bps_client_create.restype = ctypes.c_void_p
+    lib.bps_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bps_client_init_key.restype = ctypes.c_int
+    lib.bps_client_init_key.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint32, ctypes.c_uint32]
+    lib.bps_client_push.restype = ctypes.c_int
+    lib.bps_client_push.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_pull.restype = ctypes.c_int
+    lib.bps_client_pull.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
+    lib.bps_client_barrier.restype = ctypes.c_int
+    lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
+    lib.bps_client_shutdown.restype = ctypes.c_int
+    lib.bps_client_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def server_addresses(config: Config) -> List[str]:
+    """Server endpoints: explicit BYTEPS_SERVER_HOSTS="h:p,h:p,..." or the
+    scheduler URI with consecutive ports (root_port + server_id). The list
+    length must equal num_servers — the registry assigns partitions to
+    server indices [0, num_servers) and those index the native connection
+    table unchecked."""
+    hosts = os.environ.get("BYTEPS_SERVER_HOSTS", "")
+    if hosts:
+        addrs = [h.strip() for h in hosts.split(",") if h.strip()]
+        if len(addrs) != config.num_servers:
+            raise ValueError(
+                f"BYTEPS_SERVER_HOSTS has {len(addrs)} entries but "
+                f"DMLC_NUM_SERVER={config.num_servers}")
+        return addrs
+    return [f"{config.scheduler_uri}:{config.scheduler_port + i}"
+            for i in range(config.num_servers)]
+
+
+def ps_round_trip(state, name: str, host: np.ndarray,
+                  average: bool) -> np.ndarray:
+    """Shared get-or-declare + server round-trip for one flat host tensor:
+    used by both the eager push_pull PS tier and make_ps_train_step."""
+    ctx = state.registry.get(name)
+    if ctx is None or not ctx.initialized:
+        ctx = state.registry.init_tensor(name, host.nbytes,
+                                         DataType.from_np(host.dtype))
+    out = state.ps_client.push_pull(
+        ctx, host, average=average, num_workers=state.config.num_workers)
+    state.telemetry.record(host.nbytes * 2)
+    return out
+
+
+class PSClient:
+    """Blocking-per-call, thread-safe ZPush/ZPull client; one native
+    connection per server, multiplexed by request id."""
+
+    def __init__(self, servers: Sequence[str], worker_id: int,
+                 num_threads: int = 8):
+        self._lib = _load_lib()
+        csv = ",".join(servers).encode()
+        self._handle = self._lib.bps_client_create(csv, worker_id)
+        if not self._handle:
+            raise RuntimeError(
+                f"failed to connect to PS servers {servers!r}")
+        self._servers = list(servers)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=num_threads, thread_name_prefix="bps-pushpull")
+        self._closed = False
+        self._lock = threading.Lock()
+        # keys this client has init-pushed on the server (server-side
+        # initialization is per-store, distinct from registry declaration)
+        self._inited_keys: set = set()
+
+    # ------------------------------------------------------------ #
+    # raw per-key ops (ZPush/ZPull)
+    # ------------------------------------------------------------ #
+
+    def init_key(self, server: int, key: int, data: np.ndarray,
+                 cmd: int) -> None:
+        buf = np.ascontiguousarray(data)
+        rc = self._lib.bps_client_init_key(
+            self._handle, server, key, buf.ctypes.data, buf.nbytes, cmd)
+        if rc != 0:
+            raise RuntimeError(f"init_key failed key={key}")
+
+    def zpush(self, server: int, key: int, data: np.ndarray,
+              cmd: int) -> None:
+        rc = self._lib.bps_client_push(
+            self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+        if rc != 0:
+            raise RuntimeError(f"push failed key={key}")
+
+    def zpull(self, server: int, key: int, out: np.ndarray,
+              cmd: int) -> None:
+        rc = self._lib.bps_client_pull(
+            self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
+        if rc < 0:
+            raise RuntimeError(f"pull failed key={key}")
+
+    def barrier(self) -> None:
+        if self._lib.bps_client_barrier(self._handle) != 0:
+            raise RuntimeError("barrier failed")
+
+    # ------------------------------------------------------------ #
+    # tensor-level push_pull over partitions
+    # ------------------------------------------------------------ #
+
+    def init_tensor(self, ctx: TensorContext, flat: np.ndarray) -> None:
+        """Blocking initial push of every partition — acts as the per-key
+        init barrier (reference: operations.cc:283-414)."""
+        cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL, ctx.dtype)
+        view = flat.view(np.uint8)
+        futures = [
+            self._pool.submit(self.init_key, p.server, p.key,
+                              view[p.offset:p.offset + p.length], cmd)
+            for p in ctx.partitions
+        ]
+        for f in futures:
+            f.result()
+        with self._lock:
+            self._inited_keys.update(p.key for p in ctx.partitions)
+
+    def ensure_init(self, ctx: TensorContext, nbytes: int) -> None:
+        """Init-push any partition of ctx this client hasn't initialized on
+        the server yet (registry declaration alone doesn't allocate the
+        server store)."""
+        with self._lock:
+            missing = [p for p in ctx.partitions
+                       if p.key not in self._inited_keys]
+        if missing:
+            self.init_tensor(ctx, np.zeros(nbytes, np.uint8))
+
+    def push_pull(self, ctx: TensorContext, flat: np.ndarray,
+                  average: bool = True,
+                  num_workers: Optional[int] = None) -> np.ndarray:
+        """Partitioned push+pull of one tensor; returns the summed
+        (averaged) flat array. Partitions run concurrently on the pool,
+        each as push-then-pull against its assigned server."""
+        if self._closed:
+            raise RuntimeError("push_pull on a closed PSClient")
+        dtype = flat.dtype
+        cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                               DataType.from_np(dtype))
+        self.ensure_init(ctx, flat.nbytes)
+        out = np.empty_like(flat)
+        in_view = flat.view(np.uint8)
+        out_view = out.view(np.uint8)
+
+        def one(p: Partition):
+            self.zpush(p.server, p.key,
+                       in_view[p.offset:p.offset + p.length], cmd)
+            self.zpull(p.server, p.key,
+                       out_view[p.offset:p.offset + p.length], cmd)
+
+        futures = [self._pool.submit(one, p) for p in ctx.partitions]
+        for f in futures:
+            f.result()
+        if average and num_workers and num_workers > 1:
+            if np.issubdtype(dtype, np.integer):
+                out //= num_workers
+            else:
+                out /= num_workers
+        return out
+
+    def close(self, shutdown_servers: bool = True) -> None:
+        """``shutdown_servers=False`` = elastic suspend: drop the
+        connections but leave servers running for resume (the reference's
+        Finalize-without-terminate path, global.cc:319-403)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        # drain in-flight partition tasks BEFORE freeing the native client —
+        # wait=False would leave pool threads calling into freed memory
+        self._pool.shutdown(wait=True)
+        if shutdown_servers:
+            try:
+                self._lib.bps_client_shutdown(self._handle)
+            except Exception:  # noqa: BLE001
+                pass
+        self._lib.bps_client_destroy(self._handle)
+
+
+def connect_from_config(config: Config) -> PSClient:
+    servers = server_addresses(config)
+    if not servers:
+        raise RuntimeError("num_servers > 0 but no server addresses")
+    rank = (config.global_rank if config.global_rank is not None
+            else config.worker_id * config.local_size + config.local_rank)
+    log.info("connecting PS client: servers=%s worker=%d", servers, rank)
+    return PSClient(servers, rank)

@@ -1,0 +1,216 @@
+"""DCN parameter-server tests: all roles on localhost over loopback TCP —
+the reference's MetaTest pattern (tests/meta_test.py:27-86), with servers on
+background threads instead of subprocesses (the native Run loop releases
+the GIL).
+
+Covers: init-push barrier, sync aggregation (first-copy/sum/all-recv),
+parked pulls, multi-server key sharding via the registry, async mode,
+barrier, multi-round training-loop shape, and elastic reconnect.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+
+_NEXT_PORT = [19350]
+
+
+def start_servers(n_servers: int, num_workers: int, async_mode: bool = False,
+                  schedule: bool = False):
+    """Spawn n servers on fresh loopback ports; returns (addrs, threads)."""
+    import os
+    base = _NEXT_PORT[0]
+    _NEXT_PORT[0] += n_servers
+    cfgkw = dict(num_workers=num_workers, enable_async=async_mode,
+                 server_enable_schedule=schedule, num_servers=n_servers)
+    threads = []
+    for i in range(n_servers):
+        cfg = Config(**cfgkw)
+        t = threading.Thread(target=run_server, args=(base + i, cfg),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    addrs = [f"127.0.0.1:{base + i}" for i in range(n_servers)]
+    return addrs, threads
+
+
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+
+
+def test_single_worker_roundtrip():
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    x = np.arange(100, dtype=np.float32)
+    c.init_key(0, 7, np.zeros_like(x), CMD_F32)
+    c.zpush(0, 7, x, CMD_F32)
+    out = np.empty_like(x)
+    c.zpull(0, 7, out, CMD_F32)
+    np.testing.assert_array_equal(out, x)
+    c.close()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_two_workers_sum_and_parked_pull():
+    addrs, threads = start_servers(1, num_workers=2)
+    c0 = PSClient(addrs, worker_id=0)
+    c1 = PSClient(addrs, worker_id=1)
+    x0 = np.full(64, 1.5, np.float32)
+    x1 = np.full(64, 2.0, np.float32)
+
+    t_init = threading.Thread(
+        target=lambda: c1.init_key(0, 3, np.zeros_like(x1), CMD_F32))
+    t_init.start()
+    c0.init_key(0, 3, np.zeros_like(x0), CMD_F32)  # blocks till both arrive
+    t_init.join(timeout=10)
+    assert not t_init.is_alive()
+
+    # worker 0 pushes and pulls immediately: the pull must PARK until
+    # worker 1's push completes the round
+    out0 = np.empty_like(x0)
+    done0 = threading.Event()
+
+    def w0():
+        c0.zpush(0, 3, x0, CMD_F32)
+        c0.zpull(0, 3, out0, CMD_F32)
+        done0.set()
+
+    th = threading.Thread(target=w0)
+    th.start()
+    time.sleep(0.3)
+    assert not done0.is_set()          # parked: round incomplete
+    c1.zpush(0, 3, x1, CMD_F32)        # completes the round
+    assert done0.wait(timeout=10)
+    np.testing.assert_allclose(out0, x0 + x1)
+    out1 = np.empty_like(x1)
+    c1.zpull(0, 3, out1, CMD_F32)
+    np.testing.assert_allclose(out1, x0 + x1)
+    c0.close()
+    c1.close()
+
+
+def test_multi_server_partitioned_tensor():
+    """A 100KB tensor partitioned into 4KB keys spread across 3 servers
+    through the registry's hashing, push_pulled at the tensor level."""
+    addrs, threads = start_servers(3, num_workers=1)
+    reg = TensorRegistry(Config(num_servers=3, partition_bytes=4096))
+    ctx = reg.init_tensor("grad/w", nbytes=100_000, dtype=DataType.FLOAT32)
+    assert len(ctx.partitions) == 25
+    assert len({p.server for p in ctx.partitions}) > 1  # actually spread
+
+    c = PSClient(addrs, worker_id=0)
+    x = np.random.RandomState(0).randn(25_000).astype(np.float32)
+    c.init_tensor(ctx, np.zeros_like(x))
+    out = c.push_pull(ctx, x, average=False)
+    np.testing.assert_array_equal(out, x)
+    # second round (steady state reuses stores)
+    out2 = c.push_pull(ctx, x * 2, average=False)
+    np.testing.assert_array_equal(out2, x * 2)
+    c.close()
+
+
+def test_async_mode_accumulates():
+    addrs, threads = start_servers(1, num_workers=1, async_mode=True)
+    c = PSClient(addrs, worker_id=0)
+    x = np.ones(32, np.float32)
+    c.init_key(0, 1, np.zeros_like(x), CMD_F32)
+    out = np.empty_like(x)
+    # async: every push adds into the authoritative store; pulls answer
+    # immediately (server.cc:315-319,380-382)
+    c.zpush(0, 1, x, CMD_F32)
+    c.zpull(0, 1, out, CMD_F32)
+    np.testing.assert_allclose(out, 1.0)
+    c.zpush(0, 1, x, CMD_F32)
+    c.zpull(0, 1, out, CMD_F32)
+    np.testing.assert_allclose(out, 2.0)
+    c.close()
+
+
+def test_barrier_releases_all_workers():
+    addrs, threads = start_servers(1, num_workers=2)
+    c0 = PSClient(addrs, worker_id=0)
+    c1 = PSClient(addrs, worker_id=1)
+    reached = []
+
+    def wait(c, i):
+        c.barrier()
+        reached.append(i)
+
+    t0 = threading.Thread(target=wait, args=(c0, 0))
+    t0.start()
+    time.sleep(0.3)
+    assert reached == []               # barrier holds until all arrive
+    wait(c1, 1)
+    t0.join(timeout=10)
+    assert sorted(reached) == [0, 1]
+    c0.close()
+    c1.close()
+
+
+def test_training_loop_shape_two_workers():
+    """Simulated 2-worker data-parallel loop: each round both workers push
+    local grads, pull the sum, apply the same update — weights stay
+    identical (the consistency the reference's whole pipeline exists to
+    provide)."""
+    addrs, threads = start_servers(2, num_workers=2)
+    reg = TensorRegistry(Config(num_servers=2, partition_bytes=4096))
+    ctx = reg.init_tensor("w", nbytes=40_000, dtype=DataType.FLOAT32)
+    c0 = PSClient(addrs, worker_id=0)
+    c1 = PSClient(addrs, worker_id=1)
+    w0 = np.zeros(10_000, np.float32)
+    w1 = np.zeros(10_000, np.float32)
+    for c in (c0, c1):
+        t = threading.Thread(target=c.init_tensor,
+                             args=(ctx, np.zeros_like(w0)))
+        t.start()
+    time.sleep(0.1)
+
+    rng = np.random.RandomState(0)
+    for step in range(3):
+        g0 = rng.randn(10_000).astype(np.float32)
+        g1 = rng.randn(10_000).astype(np.float32)
+        res = {}
+
+        def worker(c, g, tag):
+            res[tag] = c.push_pull(ctx, g, average=True, num_workers=2)
+
+        ta = threading.Thread(target=worker, args=(c0, g0, "a"))
+        tb = threading.Thread(target=worker, args=(c1, g1, "b"))
+        ta.start(); tb.start(); ta.join(10); tb.join(10)
+        expected = (g0 + g1) / 2
+        np.testing.assert_allclose(res["a"], expected, rtol=1e-6)
+        np.testing.assert_allclose(res["b"], expected, rtol=1e-6)
+        w0 -= 0.1 * res["a"]
+        w1 -= 0.1 * res["b"]
+    np.testing.assert_array_equal(w0, w1)
+    c0.close()
+    c1.close()
+
+
+def test_elastic_reconnect():
+    """Suspend-style disconnect (servers stay up) then reconnect and keep
+    using the same keys (global.cc:431-436 resume semantics)."""
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    x = np.ones(16, np.float32)
+    c.init_key(0, 5, np.zeros_like(x), CMD_F32)
+    c.zpush(0, 5, x, CMD_F32)
+    out = np.empty_like(x)
+    c.zpull(0, 5, out, CMD_F32)
+    c.close(shutdown_servers=False)    # suspend: servers keep running
+
+    c2 = PSClient(addrs, worker_id=0)  # resume
+    c2.zpush(0, 5, x * 3, CMD_F32)
+    out2 = np.empty_like(x)
+    c2.zpull(0, 5, out2, CMD_F32)
+    np.testing.assert_allclose(out2, 3.0)
+    c2.close()
