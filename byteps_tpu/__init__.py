@@ -32,7 +32,8 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "suspend", "resume",
     "rank", "size", "local_rank", "local_size",
-    "push_pull", "broadcast", "declare_tensor",
+    "push_pull", "push_pull_async", "poll", "synchronize", "broadcast",
+    "declare_tensor", "profiler_step",
     "get_pushpull_speed", "Config", "DataType", "QueueType", "Status",
 ]
 
@@ -91,3 +92,49 @@ def profiler_step() -> None:
     tracer = get_state().tracer
     if tracer is not None:
         tracer.step()
+
+
+def push_pull_async(tensor, name: str, average: bool = True,
+                    priority: Optional[int] = None) -> int:
+    """Asynchronous PS push_pull: returns an int handle immediately; the
+    partitions flow through the priority-scheduled pipeline. Horovod-style
+    async surface (reference: byteps_torch_push_pull_async_*,
+    torch/ops.py:157-174 + handle_manager).
+
+    Requires the DCN PS (num_servers > 0). The input is the local (host)
+    value; the result (sum or mean across workers) is retrieved with
+    ``synchronize(handle)``. ``priority=None`` schedules in layer order
+    (earlier-declared first); an explicit value overrides (higher = sooner).
+    """
+    import numpy as np
+
+    state = get_state()
+    if state.scheduler is None:
+        raise RuntimeError("push_pull_async requires a connected PS "
+                           "(DMLC_NUM_SERVER > 0)")
+    host = np.ascontiguousarray(tensor)
+    flat = host.reshape(-1)
+    from .server.client import get_or_init_ctx
+    ctx = get_or_init_ctx(state, name, flat)
+    handle = state.handles.allocate(name)
+    handle._shape = host.shape
+    state.scheduler.submit(ctx, flat, handle, average,
+                           state.config.num_workers,
+                           version=state.next_version(name),
+                           priority=priority)
+    return handle.id
+
+
+def poll(handle: int) -> bool:
+    """True when the async push_pull behind ``handle`` finished
+    (reference: PollHandle, torch/ops.cc:129-135)."""
+    return get_state().handles.poll(handle)
+
+
+def synchronize(handle: int, timeout: float = None):
+    """Block until the async push_pull completes; returns the reduced
+    array (reference: WaitAndClear, torch/__init__.py:160-176)."""
+    state = get_state()
+    h = state.handles.get(handle)
+    out = state.handles.wait_and_clear(handle, timeout)
+    return out.reshape(getattr(h, "_shape", out.shape))

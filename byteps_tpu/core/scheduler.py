@@ -1,0 +1,308 @@
+"""Host-side pipeline scheduler for the DCN PS path.
+
+TPU re-grounding of the reference's core pipeline (byteps/common/
+core_loops.cc, scheduled_queue.cc, ready_table.cc): on GPU the 12-stage
+host-thread pipeline exists because every stage (NCCL, D2H, compress, push)
+must be hand-overlapped; on TPU, XLA owns everything on-device, so the host
+pipeline shrinks to the stages that actually cross the DCN boundary:
+
+    EXPORT (device->host) -> PUSH -> PULL -> IMPORT (host->device)
+
+with per-partition tasks, priority scheduling and credit-based admission
+exactly as the reference's worker side does it:
+
+- ``ScheduledQueue``: tasks ordered by (priority desc, key asc)
+  (scheduled_queue.cc:82-102), admitted while the in-flight byte credit
+  lasts (BYTEPS_SCHEDULING_CREDIT, scheduled_queue.cc:33-45,136-149);
+  ``report_finish`` returns credit.
+- ``PipelineScheduler``: one thread pool per comm stage; a task finishing a
+  stage proceeds to the next queue, and the per-tensor atomic counter fires
+  the completion callback when the last partition lands (FinishOrProceed,
+  core_loops.cc:31-137).
+- ``HandleManager``: integer handles for the async API
+  (reference: byteps/torch/handle_manager.cc, ops.py:48-85).
+
+Priority convention matches the reference: priority = -declared_key so
+earlier-declared (front-of-model) tensors win ties in the backward flush
+(tensorflow/ops.cc:155-158); higher value = more urgent.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log
+from .types import Partition, TensorContext
+
+# Credit default when scheduling is off: effectively unlimited
+# (the reference uses 32 GB, scheduled_queue.cc:33-45).
+UNLIMITED_CREDIT = 32 << 30
+
+
+class ScheduledQueue:
+    """Priority + credit gated task queue (scheduled_queue.cc)."""
+
+    def __init__(self, credit_bytes: int = 0):
+        # credit_bytes <= 0 -> scheduling disabled -> huge credit
+        self._credit = credit_bytes if credit_bytes > 0 else UNLIMITED_CREDIT
+        self._capacity = self._credit
+        self._scheduling = credit_bytes > 0
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._heap: List = []
+        self._counter = itertools.count()
+        self._stopped = False
+
+    def add_task(self, task: "PartitionTask") -> None:
+        with self._cv:
+            # (priority desc, key asc): negate priority for the min-heap
+            heapq.heappush(self._heap,
+                           (-task.priority, task.key, next(self._counter),
+                            task))
+            self._cv.notify()
+
+    def get_task(self) -> Optional["PartitionTask"]:
+        """Block until a task is admitted (enough credit) or stop()."""
+        with self._cv:
+            while True:
+                if self._stopped:
+                    return None
+                if self._heap:
+                    head = self._heap[0][3]
+                    # a task larger than the whole capacity must still run
+                    # once credit is fully restored, or it stalls the queue
+                    # forever (and everything behind it)
+                    if (head.nbytes <= self._credit
+                            or self._credit >= self._capacity):
+                        _, _, _, task = heapq.heappop(self._heap)
+                        self._credit -= task.nbytes
+                        return task
+                self._cv.wait(timeout=0.1)
+
+    def drain(self) -> List["PartitionTask"]:
+        """Remove and return all queued (unstarted) tasks."""
+        with self._cv:
+            tasks = [item[3] for item in self._heap]
+            self._heap.clear()
+            return tasks
+
+    def report_finish(self, nbytes: int) -> None:
+        with self._cv:
+            self._credit += nbytes
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    @property
+    def pending(self) -> int:
+        with self._mu:
+            return len(self._heap)
+
+
+class PartitionTask:
+    """One partition of one push_pull — the reference's TensorTableEntry
+    (common.h:221-264) reduced to the DCN stages."""
+
+    __slots__ = ("ctx", "partition", "priority", "version", "in_view",
+                 "out_view", "group", "cmd")
+
+    def __init__(self, ctx, partition, priority, version, in_view, out_view,
+                 group, cmd):
+        self.ctx: TensorContext = ctx
+        self.partition: Partition = partition
+        self.priority = priority
+        self.version = version
+        self.in_view = in_view     # np.uint8 view of this partition's input
+        self.out_view = out_view   # np.uint8 view of the output slot
+        self.group: "TaskGroup" = group
+        self.cmd = cmd
+
+    @property
+    def key(self) -> int:
+        return self.partition.key
+
+    @property
+    def nbytes(self) -> int:
+        return self.partition.length
+
+
+class TaskGroup:
+    """Per-tensor completion tracking: the shared atomic counter + callback
+    of the reference's partition fan-out (operations.cc:140-180)."""
+
+    def __init__(self, ctx: TensorContext, total: int,
+                 callback: Callable[[Optional[Exception]], None]):
+        self.ctx = ctx
+        self._remaining = total
+        self._mu = threading.Lock()
+        self._callback = callback
+        self._error: Optional[Exception] = None
+
+    def partition_done(self, err: Optional[Exception] = None) -> None:
+        with self._mu:
+            if err is not None and self._error is None:
+                self._error = err
+            self._remaining -= 1
+            fire = self._remaining == 0
+        if fire:
+            self._callback(self._error)
+
+
+class Handle:
+    """Async completion handle (HandleManager parity)."""
+
+    def __init__(self, hid: int, name: str):
+        self.id = hid
+        self.name = name
+        self._ev = threading.Event()
+        self._err: Optional[Exception] = None
+        self.result: Optional[np.ndarray] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(f"push_pull {self.name!r} timed out")
+        if self._err is not None:
+            raise self._err
+        return self.result
+
+    def _finish(self, result, err) -> None:
+        self.result = result
+        self._err = err
+        self._ev.set()
+
+
+class HandleManager:
+    """int handle allocation + poll/wait (torch/handle_manager.cc:22,
+    ops.py:48-85)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._next = 0
+        self._handles: Dict[int, Handle] = {}
+
+    def allocate(self, name: str) -> Handle:
+        with self._mu:
+            h = Handle(self._next, name)
+            self._handles[h.id] = h
+            self._next += 1
+            return h
+
+    def get(self, hid: int) -> Handle:
+        with self._mu:
+            return self._handles[hid]
+
+    def poll(self, hid: int) -> bool:
+        return self.get(hid).done()
+
+    def wait_and_clear(self, hid: int, timeout=None) -> np.ndarray:
+        h = self.get(hid)
+        out = h.wait(timeout)
+        with self._mu:
+            self._handles.pop(hid, None)
+        return out
+
+
+class PipelineScheduler:
+    """Stage-threaded push/pull pipeline over the PS client.
+
+    Each admitted partition runs PUSH then PULL on a pipeline worker; the
+    priority queue decides admission order and the credit bounds in-flight
+    bytes — so a high-priority (front-layer) gradient overtakes queued bulk
+    traffic exactly as in the reference's scheduler.
+    """
+
+    def __init__(self, client, num_threads: int = 8,
+                 credit_bytes: int = 0, tracer=None, telemetry=None):
+        self._client = client
+        self._queue = ScheduledQueue(credit_bytes)
+        self._tracer = tracer
+        self._telemetry = telemetry
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"bps-sched-{i}",
+                             daemon=True)
+            for i in range(num_threads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            task = self._queue.get_task()
+            if task is None:
+                return
+            name = task.ctx.name
+            err = None
+            try:
+                if self._tracer:
+                    self._tracer.begin(name, f"PUSH.{task.partition.index}")
+                self._client.zpush(task.partition.server, task.key,
+                                   task.in_view, task.cmd)
+                if self._tracer:
+                    self._tracer.end(name, f"PUSH.{task.partition.index}")
+                    self._tracer.begin(name, f"PULL.{task.partition.index}")
+                self._client.zpull(task.partition.server, task.key,
+                                   task.out_view, task.cmd)
+                if self._tracer:
+                    self._tracer.end(name, f"PULL.{task.partition.index}")
+            except Exception as e:  # noqa: BLE001 - forwarded to waiter
+                err = e
+            finally:
+                self._queue.report_finish(task.nbytes)
+                if self._telemetry:
+                    self._telemetry.record(task.nbytes * 2)
+                task.group.partition_done(err)
+
+    def submit(self, ctx: TensorContext, flat_in: np.ndarray,
+               handle: Handle, average: bool, num_workers: int,
+               version: int = 0, priority: Optional[int] = None) -> None:
+        """Enqueue all partitions of one tensor; fills ``handle`` when the
+        last partition completes. ``priority=None`` uses the layer-order
+        default -declared_key (tensorflow/ops.cc:155-158); an explicit
+        value overrides it (higher = sooner)."""
+        from .types import DataType, RequestType, get_command_type
+
+        self._client.ensure_init(ctx, flat_in.nbytes)
+        cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                               DataType.from_np(flat_in.dtype))
+        out = np.empty_like(flat_in)
+        in_view = flat_in.view(np.uint8)
+        out_view = out.view(np.uint8)
+
+        def on_complete(err: Optional[Exception]) -> None:
+            if err is None and average and num_workers > 1:
+                if np.issubdtype(out.dtype, np.integer):
+                    np.floor_divide(out, num_workers, out=out)
+                else:
+                    np.divide(out, num_workers, out=out)
+            handle._finish(out if err is None else None, err)
+
+        group = TaskGroup(ctx, len(ctx.partitions), on_complete)
+        if priority is None:
+            priority = -ctx.declared_key
+        for p in ctx.partitions:
+            self._queue.add_task(PartitionTask(
+                ctx, p, priority, version,
+                in_view[p.offset:p.offset + p.length],
+                out_view[p.offset:p.offset + p.length],
+                group, cmd))
+
+    def stop(self) -> None:
+        # fail queued-but-unstarted tasks so outstanding synchronize()
+        # callers get an error instead of waiting forever
+        for task in self._queue.drain():
+            task.group.partition_done(
+                RuntimeError("scheduler stopped before task ran"))
+        self._queue.stop()
+        for t in self._threads:
+            t.join(timeout=5)

@@ -71,6 +71,8 @@ class GlobalState:
         self.telemetry = _Telemetry()
         self.tracer = None           # set lazily by utils.tracing
         self.ps_client = None        # set by server.client when PS configured
+        self.scheduler = None        # PipelineScheduler over ps_client
+        self.handles = None          # HandleManager for the async API
         self._version: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -112,6 +114,12 @@ class GlobalState:
                     and self.config.role == "worker"):
                 from ..server.client import connect_from_config
                 self.ps_client = connect_from_config(self.config)
+                from .scheduler import HandleManager, PipelineScheduler
+                self.scheduler = PipelineScheduler(
+                    self.ps_client,
+                    credit_bytes=self.config.scheduling_credit,
+                    tracer=self.tracer, telemetry=self.telemetry)
+                self.handles = HandleManager()
             self.initialized = True
             self.suspended = False
             log.info("byteps_tpu initialized: rank=%d size=%d devices=%d mesh=%s",
@@ -120,6 +128,7 @@ class GlobalState:
 
     def shutdown(self) -> None:
         with self._lock:
+            self._stop_scheduler()
             if self.ps_client is not None:
                 try:
                     self.ps_client.close()
@@ -136,6 +145,7 @@ class GlobalState:
         keep the declared-tensor table so resume re-assigns identical keys."""
         with self._lock:
             bps_check(self.initialized, "suspend() before init()")
+            self._stop_scheduler()
             if self.ps_client is not None:
                 try:
                     # leave servers running for resume
@@ -156,6 +166,15 @@ class GlobalState:
             os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
         # init() re-establishes the PS client that suspend() closed.
         self.init(Config.from_env())
+
+    def _stop_scheduler(self) -> None:
+        if self.scheduler is not None:
+            try:
+                self.scheduler.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self.scheduler = None
+            self.handles = None
 
     # ------------------------------------------------------------------ #
     # identity (communicator.cc:60-96)

@@ -203,24 +203,30 @@ def make_ps_train_step(
         client = state.ps_client
         loss, grads = grad_fn(params, batch)
         if client is not None:
-            from ..server.client import ps_round_trip
             paths, treedef = jax.tree_util.tree_flatten_with_path(grads)
-            items = []
-            for idx, (path, leaf) in enumerate(paths):
-                name = "grad/" + "/".join(
+            hosts, names = [], []
+            for path, leaf in paths:
+                names.append("grad/" + "/".join(
                     str(getattr(k, "key", getattr(k, "idx", k)))
-                    for k in path)
-                # declare up-front so declared_key order is stable
-                ctx = state.registry.declare(name)
-                items.append((idx, ctx, name, np.asarray(leaf)))
-            # priority order: earlier-declared first (the reference uses
-            # priority = -declared_key, tensorflow/ops.cc:155-158)
-            results = [None] * len(items)
-            for idx, ctx, name, host in sorted(
-                    items, key=lambda t: t[1].declared_key):
-                out = ps_round_trip(state, name, host.reshape(-1),
-                                    average=True)
-                results[idx] = out.reshape(host.shape)
+                    for k in path))
+                hosts.append(np.asarray(leaf))
+            if state.scheduler is not None:
+                # pipelined: all tensors' partitions enter the priority-
+                # scheduled queue at once; PUSH/PULL of different
+                # partitions overlap on the stage threads
+                import byteps_tpu as bps
+                handles = [
+                    bps.push_pull_async(h, name, average=True)
+                    for name, h in zip(names, hosts)
+                ]
+                results = [bps.synchronize(hd) for hd in handles]
+            else:
+                from ..server.client import ps_round_trip
+                results = [
+                    ps_round_trip(state, name, h.reshape(-1), average=True)
+                    .reshape(h.shape)
+                    for name, h in zip(names, hosts)
+                ]
             grads = treedef.unflatten(results)
         params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, loss

@@ -36,6 +36,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -120,6 +121,45 @@ static bool recv_all(int fd, void* buf, size_t n) {
   return true;
 }
 
+// header+payload in one gathered send; sendmsg (not writev) so
+// MSG_NOSIGNAL applies — a peer disconnect must return an error, not
+// SIGPIPE the training process
+static bool send_msg_iov(int fd, const MsgHeader& h, const void* payload) {
+  iovec iov[2];
+  iov[0].iov_base = (void*)&h;
+  iov[0].iov_len = sizeof(h);
+  iov[1].iov_base = (void*)payload;
+  iov[1].iov_len = payload ? h.len : 0;
+  size_t total = iov[0].iov_len + iov[1].iov_len;
+  size_t sent = 0;
+  int idx = 0;
+  while (sent < total) {
+    msghdr msg{};
+    msg.msg_iov = &iov[idx];
+    msg.msg_iovlen = 2 - idx;
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    sent += (size_t)w;
+    while (idx < 2 && iov[idx].iov_len <= (size_t)w) {
+      w -= iov[idx].iov_len;
+      idx++;
+    }
+    if (idx < 2 && w > 0) {
+      iov[idx].iov_base = (char*)iov[idx].iov_base + w;
+      iov[idx].iov_len -= (size_t)w;
+    }
+  }
+  return true;
+}
+
+static void tune_socket(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int buf = 8 << 20;  // 8 MB socket buffers for multi-MB partitions
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
 // dtype-aware summation: dst += src. Plain loops; -O3 auto-vectorizes
 // (the reference uses OpenMP SIMD pragmas, cpu_reducer.cc:59-120).
 static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
@@ -174,9 +214,7 @@ struct Conn {
   std::mutex write_mu;
   bool send_msg(const MsgHeader& h, const void* payload) {
     std::lock_guard<std::mutex> lk(write_mu);
-    if (!send_all(fd, &h, sizeof(h))) return false;
-    if (h.len && payload && !send_all(fd, payload, h.len)) return false;
-    return true;
+    return send_msg_iov(fd, h, payload);
   }
 };
 
@@ -187,6 +225,8 @@ struct ParkedPull {
 };
 
 struct KeyStore {
+  std::mutex mu;                 // per-key lock: sums/copies of different
+                                 // keys must not serialize each other
   std::vector<uint8_t> accum;    // receiving buffer for the current round
   std::vector<uint8_t> merged;   // buffer served to pulls
   uint32_t len = 0;
@@ -289,8 +329,7 @@ class Server {
     while (!shutting_down_.load()) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) break;
-      int one2 = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      tune_socket(fd);
       auto conn = std::make_shared<Conn>();
       conn->fd = fd;
       std::lock_guard<std::mutex> lk(conns_mu_);
@@ -402,6 +441,7 @@ class Server {
   }
 
   KeyStore& store_of(uint64_t key) {
+    // unordered_map guarantees reference stability across rehash
     std::lock_guard<std::mutex> lk(stores_mu_);
     return stores_[key];
   }
@@ -412,13 +452,17 @@ class Server {
     std::vector<ParkedPull> release;
     {
       KeyStore& ks = store_of(m.key);
-      std::lock_guard<std::mutex> lk(key_mu_);
-      if (ks.len == 0) {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      if (ks.len != (uint32_t)m.payload.size()) {
+        // fresh key, or re-init with a new length (tensor resize): reset
+        // the whole aggregation state
         ks.len = (uint32_t)m.payload.size();
         ks.dtype = m.dtype;
         ks.accum.assign(ks.len, 0);
         ks.merged = m.payload;  // init value (typically zeros or weights)
         ks.worker_push_count.assign(num_workers_, 0);
+        ks.recv_count = 0;
+        ks.completed_rounds = 0;
       }
       ks.init_count++;
       ks.parked_inits.push_back({m.conn, m.rid, m.sender});
@@ -437,10 +481,14 @@ class Server {
     std::vector<ParkedPull> flush;
     KeyStore& ks = store_of(m.key);
     {
-      std::lock_guard<std::mutex> lk(key_mu_);
-      if (ks.len == 0) {
-        std::fprintf(stderr, "[bps-server] push before init key=%llu\n",
-                     (unsigned long long)m.key);
+      std::lock_guard<std::mutex> lk(ks.mu);
+      if (ks.len == 0 || m.payload.size() != ks.len) {
+        // uninitialized OR size mismatch (stale partitioning after a
+        // tensor resize): error-reply; memcpy/sum with the wrong length
+        // would corrupt the heap
+        std::fprintf(stderr,
+                     "[bps-server] push rejected key=%llu len=%zu store=%u\n",
+                     (unsigned long long)m.key, m.payload.size(), ks.len);
         // flags bit0 = error: reply instead of dropping, so the client
         // raises instead of hanging on a never-acked request
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
@@ -492,7 +540,7 @@ class Server {
     // round's ALL_RECV memcpy, which the key mutex serializes
     std::vector<uint8_t> snapshot;
     {
-      std::lock_guard<std::mutex> lk(key_mu_);
+      std::lock_guard<std::mutex> lk(ks.mu);
       snapshot = ks.merged;
     }
     p.conn->send_msg(r, snapshot.data());
@@ -503,7 +551,7 @@ class Server {
     bool ready;
     bool uninit = false;
     {
-      std::lock_guard<std::mutex> lk(key_mu_);
+      std::lock_guard<std::mutex> lk(ks.mu);
       uninit = ks.len == 0;
       ready = !uninit && PullReady(ks, m.sender);
       if (!uninit && !ready) {
@@ -537,9 +585,9 @@ class Server {
   std::mutex assign_mu_;
 
   std::unordered_map<uint64_t, KeyStore> stores_;
-  std::mutex stores_mu_;
-  std::mutex key_mu_;  // coarse per-server key mutex (reference uses a
-                       // single handle_mu_ too, server.cc:208)
+  std::mutex stores_mu_;  // guards only the map itself; data ops take the
+                          // per-key KeyStore::mu (finer than the
+                          // reference's single handle_mu_, server.cc:208)
 
   std::mutex conns_mu_;
   std::vector<std::thread> conn_threads_;
@@ -572,8 +620,7 @@ class ServerConn {
     if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) return false;
     for (int attempt = 0; attempt < 200; ++attempt) {
       if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
-        int one = 1;
-        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        tune_socket(fd_);
         recv_thread_ = std::thread([this] { RecvLoop(); });
         return true;
       }
@@ -606,8 +653,7 @@ class ServerConn {
     MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
     {
       std::lock_guard<std::mutex> lk(send_mu_);
-      if (!send_all(fd_, &h, sizeof(h)) ||
-          (len && !send_all(fd_, data, len))) {
+      if (!send_msg_iov(fd_, h, data)) {
         std::lock_guard<std::mutex> lk2(waiters_mu_);
         waiters_.erase(rid);
         return ~0u;

@@ -64,14 +64,19 @@ def server_addresses(config: Config) -> List[str]:
             for i in range(config.num_servers)]
 
 
+def get_or_init_ctx(state, name: str, host: np.ndarray) -> TensorContext:
+    """Registry get-or-init for a host tensor. Always goes through
+    init_tensor: it is idempotent for an unchanged size and re-partitions
+    on resize (stale partitions would slice the wrong byte ranges)."""
+    return state.registry.init_tensor(name, host.nbytes,
+                                      DataType.from_np(host.dtype))
+
+
 def ps_round_trip(state, name: str, host: np.ndarray,
                   average: bool) -> np.ndarray:
     """Shared get-or-declare + server round-trip for one flat host tensor:
     used by both the eager push_pull PS tier and make_ps_train_step."""
-    ctx = state.registry.get(name)
-    if ctx is None or not ctx.initialized:
-        ctx = state.registry.init_tensor(name, host.nbytes,
-                                         DataType.from_np(host.dtype))
+    ctx = get_or_init_ctx(state, name, host)
     out = state.ps_client.push_pull(
         ctx, host, average=average, num_workers=state.config.num_workers)
     state.telemetry.record(host.nbytes * 2)
@@ -95,9 +100,10 @@ class PSClient:
             max_workers=num_threads, thread_name_prefix="bps-pushpull")
         self._closed = False
         self._lock = threading.Lock()
-        # keys this client has init-pushed on the server (server-side
-        # initialization is per-store, distinct from registry declaration)
-        self._inited_keys: set = set()
+        # key -> store length this client has init-pushed on the server
+        # (server-side initialization is per-store, distinct from registry
+        # declaration; a resize needs a fresh init push)
+        self._inited_keys: dict = {}
 
     # ------------------------------------------------------------ #
     # raw per-key ops (ZPush/ZPull)
@@ -146,15 +152,16 @@ class PSClient:
         for f in futures:
             f.result()
         with self._lock:
-            self._inited_keys.update(p.key for p in ctx.partitions)
+            self._inited_keys.update(
+                {p.key: p.length for p in ctx.partitions})
 
     def ensure_init(self, ctx: TensorContext, nbytes: int) -> None:
         """Init-push any partition of ctx this client hasn't initialized on
-        the server yet (registry declaration alone doesn't allocate the
-        server store)."""
+        the server at its current length (registry declaration alone doesn't
+        allocate the server store; a resized tensor re-inits)."""
         with self._lock:
             missing = [p for p in ctx.partitions
-                       if p.key not in self._inited_keys]
+                       if self._inited_keys.get(p.key) != p.length]
         if missing:
             self.init_tensor(ctx, np.zeros(nbytes, np.uint8))
 
