@@ -57,32 +57,60 @@ class ScheduledQueue:
         self._heap: List = []
         self._counter = itertools.count()
         self._stopped = False
+        # keys with a task currently running: same-key tasks are serialized
+        # so overlapping push_pulls of one tensor can't interleave their
+        # PUSH/PULL into the same server aggregation round
+        self._inflight: set = set()
 
     def add_task(self, task: "PartitionTask") -> None:
         with self._cv:
-            # (priority desc, key asc): negate priority for the min-heap
+            if self._stopped:
+                raise RuntimeError("scheduler stopped")
+            # (priority desc, key asc): negate priority for the min-heap;
+            # seq keeps same-key tasks in submission order
             heapq.heappush(self._heap,
                            (-task.priority, task.key, next(self._counter),
                             task))
             self._cv.notify()
 
     def get_task(self) -> Optional["PartitionTask"]:
-        """Block until a task is admitted (enough credit) or stop()."""
+        """Block until a task is admitted (enough credit, key not already
+        in flight) or stop()."""
         with self._cv:
             while True:
                 if self._stopped:
                     return None
-                if self._heap:
-                    head = self._heap[0][3]
-                    # a task larger than the whole capacity must still run
-                    # once credit is fully restored, or it stalls the queue
-                    # forever (and everything behind it)
-                    if (head.nbytes <= self._credit
-                            or self._credit >= self._capacity):
-                        _, _, _, task = heapq.heappop(self._heap)
-                        self._credit -= task.nbytes
-                        return task
+                task = self._pop_admissible_locked()
+                if task is not None:
+                    self._credit -= task.nbytes
+                    self._inflight.add(task.key)
+                    return task
                 self._cv.wait(timeout=0.1)
+
+    def _pop_admissible_locked(self) -> Optional["PartitionTask"]:
+        """Pop the highest-priority admissible task. In-flight keys are
+        skipped (their next task runs when the current one finishes); a
+        credit-starved head blocks admission entirely — lower-priority
+        tasks must not overtake it just because they're smaller
+        (scheduled_queue.cc:136-149 admits strictly in order)."""
+        skipped: List = []
+        found = None
+        while self._heap:
+            item = heapq.heappop(self._heap)
+            t = item[3]
+            if t.key in self._inflight:
+                skipped.append(item)
+                continue
+            # a task larger than the whole capacity must still run once
+            # credit is fully restored, or it stalls the queue forever
+            if t.nbytes <= self._credit or self._credit >= self._capacity:
+                found = t
+            else:
+                skipped.append(item)
+            break
+        for item in skipped:
+            heapq.heappush(self._heap, item)
+        return found
 
     def drain(self) -> List["PartitionTask"]:
         """Remove and return all queued (unstarted) tasks."""
@@ -91,15 +119,24 @@ class ScheduledQueue:
             self._heap.clear()
             return tasks
 
-    def report_finish(self, nbytes: int) -> None:
+    def report_finish(self, task: "PartitionTask") -> None:
         with self._cv:
-            self._credit += nbytes
+            self._credit += task.nbytes
+            self._inflight.discard(task.key)
             self._cv.notify_all()
 
     def stop(self) -> None:
+        """Stop and return the tasks that never ran (callers fail them).
+        The flag flip and the drain are atomic so an add_task racing with
+        stop either lands before the drain or raises."""
         with self._cv:
             self._stopped = True
+            tasks = [item[3] for item in self._heap]
+            self._heap.clear()
             self._cv.notify_all()
+        for task in tasks:
+            task.group.partition_done(
+                RuntimeError("scheduler stopped before task ran"))
 
     @property
     def pending(self) -> int:
@@ -258,7 +295,7 @@ class PipelineScheduler:
             except Exception as e:  # noqa: BLE001 - forwarded to waiter
                 err = e
             finally:
-                self._queue.report_finish(task.nbytes)
+                self._queue.report_finish(task)
                 if self._telemetry:
                     self._telemetry.record(task.nbytes * 2)
                 task.group.partition_done(err)
@@ -291,18 +328,22 @@ class PipelineScheduler:
         if priority is None:
             priority = -ctx.declared_key
         for p in ctx.partitions:
-            self._queue.add_task(PartitionTask(
+            task = PartitionTask(
                 ctx, p, priority, version,
                 in_view[p.offset:p.offset + p.length],
                 out_view[p.offset:p.offset + p.length],
-                group, cmd))
+                group, cmd)
+            try:
+                self._queue.add_task(task)
+            except RuntimeError as e:
+                # scheduler stopped mid-submit: fail this partition so the
+                # handle resolves with an error instead of hanging
+                group.partition_done(e)
 
     def stop(self) -> None:
-        # fail queued-but-unstarted tasks so outstanding synchronize()
-        # callers get an error instead of waiting forever
-        for task in self._queue.drain():
-            task.group.partition_done(
-                RuntimeError("scheduler stopped before task ran"))
+        # stop() atomically flips the flag and fails queued-but-unstarted
+        # tasks, so outstanding synchronize() callers get an error instead
+        # of waiting forever
         self._queue.stop()
         for t in self._threads:
             t.join(timeout=5)

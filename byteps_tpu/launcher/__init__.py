@@ -1,0 +1,235 @@
+"""byteps_tpu launcher — the ``bpslaunch`` equivalent.
+
+Single-node launcher with role dispatch (reference: launcher/launch.py):
+
+- role ``worker``: spawn one training process per local worker
+  (``BYTEPS_LOCAL_SIZE``, default 1 — on TPU a single process owns every
+  local chip, so local_size>1 is only for CPU-emulation tests and host-side
+  data workers), set ``BYTEPS_LOCAL_RANK/SIZE`` per child
+  (reference: launch.py:155-239), pin each child to an allocated set of
+  physical cores (reference NUMA allocator: launch.py:43-135), optionally
+  wrap in gdb (``BYTEPS_ENABLE_GDB``, launch.py:159-162), and create the
+  trace dir tree when tracing is on (launch.py:181-191).
+- role ``server``: run the native DCN PS in-process
+  (reference: launch.py:241-249 runs ``python3 -c 'import byteps.server'``).
+- role ``scheduler``: no-op kept for launch-script parity — the reference
+  needs a ps-lite rendezvous process, but byteps_tpu's transport derives
+  every server address statically from DMLC_PS_ROOT_URI/PORT
+  (server.client.server_addresses), so there is nothing to coordinate.
+
+Multi-node SSH fan-out lives in ``byteps_tpu.launcher.dist``
+(reference: launcher/dist_launcher.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.logging import log
+
+__all__ = [
+    "allocate_cpu_cores",
+    "launch_workers",
+    "run_role",
+    "main",
+]
+
+
+# ------------------------------------------------------------------ #
+# CPU core allocation (reference: launcher/launch.py:43-135)
+# ------------------------------------------------------------------ #
+
+
+def _parse_core_list(spec: str) -> List[int]:
+    """Parse "0-3,8,10-11" into [0,1,2,3,8,10,11]."""
+    cores: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, _, hi = part.partition("-")
+            cores.extend(range(int(lo), int(hi) + 1))
+        else:
+            cores.append(int(part))
+    return cores
+
+
+def _physical_cores() -> Dict[int, List[int]]:
+    """Map physical core -> [logical siblings] from sysfs topology, so
+    hyperthread siblings are allocated together (the reference allocates
+    sibling pairs as one unit, launch.py:60-95). Falls back to
+    each-logical-is-physical when sysfs is unavailable."""
+    avail = sorted(os.sched_getaffinity(0))
+    seen: Dict[int, List[int]] = {}
+    for cpu in avail:
+        path = (f"/sys/devices/system/cpu/cpu{cpu}/topology/"
+                "thread_siblings_list")
+        try:
+            with open(path) as f:
+                siblings = _parse_core_list(f.read().strip())
+        except OSError:
+            siblings = [cpu]
+        phys = min(siblings)
+        seen.setdefault(phys, [])
+        if cpu not in seen[phys]:
+            seen[phys].append(cpu)
+    return seen
+
+
+def allocate_cpu_cores(local_size: int,
+                       avail: Optional[Sequence[int]] = None) -> List[List[int]]:
+    """Partition host cores into ``local_size`` affinity sets.
+
+    Env knobs (reference names, launch.py:96-135,219-236):
+
+    - ``BYTEPS_VISIBLE_CPU_CORES``: explicit per-worker sets separated by
+      ``;`` (e.g. ``"0-3;4-7"``) — manual override, used verbatim.
+    - ``BYTEPS_CPU_BLACKLIST``: comma/range list of cores never allocated.
+    - ``BYTEPS_NUMA_DEFAULT_QUOTA``: max physical cores per worker
+      (0 = fair share).
+    - ``BYTEPS_MULTITHREADED_CPU``: when false, only the first hyperthread
+      sibling of each physical core is used.
+
+    Returns one (possibly empty) core list per local worker; an empty list
+    means "don't pin".
+    """
+    visible = os.environ.get("BYTEPS_VISIBLE_CPU_CORES", "")
+    if visible:
+        sets = [_parse_core_list(s) for s in visible.split(";") if s.strip()]
+        if len(sets) < local_size:
+            raise ValueError(
+                f"BYTEPS_VISIBLE_CPU_CORES has {len(sets)} sets for "
+                f"{local_size} workers")
+        return sets[:local_size]
+
+    blacklist = set(_parse_core_list(os.environ.get("BYTEPS_CPU_BLACKLIST", "")))
+    use_ht = os.environ.get("BYTEPS_MULTITHREADED_CPU", "1") not in (
+        "0", "false", "False")
+    quota = int(os.environ.get("BYTEPS_NUMA_DEFAULT_QUOTA", "0") or 0)
+
+    if avail is not None:
+        phys = {c: [c] for c in avail}
+    else:
+        phys = _physical_cores()
+    units: List[List[int]] = []
+    for p in sorted(phys):
+        logical = [c for c in sorted(phys[p]) if c not in blacklist]
+        if not use_ht:
+            logical = logical[:1]
+        if logical:
+            units.append(logical)
+
+    if not units or local_size <= 0:
+        return [[] for _ in range(max(0, local_size))]
+
+    share = max(1, len(units) // local_size)
+    if quota:
+        share = min(share, quota)
+    out: List[List[int]] = []
+    for i in range(local_size):
+        chunk = units[i * share:(i + 1) * share]
+        if not chunk:  # more workers than cores: round-robin single units
+            chunk = [units[i % len(units)]]
+        out.append([c for u in chunk for c in u])
+    return out
+
+
+# ------------------------------------------------------------------ #
+# process spawning
+# ------------------------------------------------------------------ #
+
+
+def _child_env(local_rank: int, local_size: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["BYTEPS_LOCAL_RANK"] = str(local_rank)
+    env["BYTEPS_LOCAL_SIZE"] = str(local_size)
+    return env
+
+
+def _maybe_gdb(command: List[str]) -> List[str]:
+    """Wrap in gdb for crash backtraces (reference: launch.py:159-162)."""
+    if os.environ.get("BYTEPS_ENABLE_GDB", "0") in ("1", "true", "True"):
+        return ["gdb", "-ex", "run", "-ex", "bt", "-batch", "--args"] + command
+    return command
+
+
+def _make_trace_dirs(local_size: int) -> None:
+    """Pre-create per-rank trace dirs (reference: launch.py:181-191)."""
+    if os.environ.get("BYTEPS_TRACE_ON", "0") in ("1", "true", "True"):
+        base = os.environ.get("BYTEPS_TRACE_DIR", "./traces")
+        for r in range(local_size):
+            os.makedirs(os.path.join(base, str(r)), exist_ok=True)
+
+
+def launch_workers(command: Sequence[str],
+                   local_size: Optional[int] = None) -> int:
+    """Spawn ``local_size`` copies of ``command`` with per-rank env and core
+    pinning; wait for all; return the first nonzero exit code (terminating
+    the rest, like the reference's process-group teardown)."""
+    if local_size is None:
+        local_size = int(os.environ.get("BYTEPS_LOCAL_SIZE", "1"))
+    _make_trace_dirs(local_size)
+    core_sets = allocate_cpu_cores(local_size)
+    cmd = _maybe_gdb(list(command))
+
+    procs: List[subprocess.Popen] = []
+    for r in range(local_size):
+        cores = core_sets[r]
+
+        def preexec(cores=cores):
+            if cores:
+                try:
+                    os.sched_setaffinity(0, set(cores))
+                except OSError:
+                    pass
+
+        log.info("launching worker local_rank=%d cores=%s cmd=%s",
+                 r, cores or "any", shlex.join(cmd))
+        procs.append(subprocess.Popen(
+            cmd, env=_child_env(r, local_size), preexec_fn=preexec))
+
+    # wait in completion order, not rank order: a crashed rank must tear
+    # down survivors that are blocked on it (e.g. in a collective), which
+    # rank-order wait() would deadlock on
+    import time
+    rc = 0
+    live = list(procs)
+    while live:
+        done = [p for p in live if p.poll() is not None]
+        if not done:
+            time.sleep(0.05)
+            continue
+        for p in done:
+            live.remove(p)
+            if p.returncode != 0 and rc == 0:
+                rc = p.returncode
+                for q in live:
+                    q.terminate()
+    return rc
+
+
+def run_role(command: Sequence[str]) -> int:
+    """Dispatch on DMLC_ROLE (reference: launch.py:241-253)."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        from ..server import run_server
+        return run_server()
+    if role == "scheduler":
+        log.info("byteps_tpu uses static rendezvous "
+                 "(DMLC_PS_ROOT_URI/PORT + server index); scheduler role "
+                 "is a no-op kept for launch-script parity")
+        return 0
+    if not command:
+        print("usage: bpslaunch <training command...>", file=sys.stderr)
+        return 2
+    return launch_workers(command)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return run_role(argv)

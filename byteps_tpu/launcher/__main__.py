@@ -1,0 +1,9 @@
+"""``python -m byteps_tpu.launcher <cmd...>`` — the bpslaunch entry
+(reference: launcher/launch.py console script)."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Multi-node SSH fan-out launcher (reference: launcher/dist_launcher.py).
+
+Reads worker/server hostfiles, builds per-host commands that export the
+DMLC_* topology env and run ``bpslaunch`` remotely, then fans them out over
+ssh, teeing each host's output to ``sshlog/<host>.log``
+(reference: dist_launcher.py:36-100). ``--dry-run`` prints the commands
+instead of executing (used by tests and for operator inspection).
+
+Usage:
+    python -m byteps_tpu.launcher.dist \
+        --worker-hostfile workers.txt --server-hostfile servers.txt \
+        --scheduler-uri 10.0.0.1 --scheduler-port 9000 \
+        -- python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.logging import log
+
+
+def read_hostfile(path: str) -> List[str]:
+    """One host per line; blank lines and #-comments ignored
+    (reference: dist_launcher.py:23-33)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line)
+    return hosts
+
+
+def _export_str(env: Dict[str, str]) -> str:
+    return " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+
+
+def build_commands(workers: Sequence[str], servers: Sequence[str],
+                   scheduler_uri: str, scheduler_port: int,
+                   command: Sequence[str],
+                   extra_env: Optional[Dict[str, str]] = None,
+                   username: str = "") -> List[Dict[str, str]]:
+    """Per-host launch plan: list of {host, role, ssh_cmd, remote_cmd}.
+
+    Env layout mirrors the reference (dist_launcher.py:60-92): every host
+    gets DMLC_NUM_WORKER/NUM_SERVER/PS_ROOT_URI/PORT + its role; workers
+    additionally get DMLC_WORKER_ID; servers get BYTEPS_SERVER_ID (which
+    byteps_tpu.server uses to derive its listen port).
+    """
+    base = {
+        "DMLC_NUM_WORKER": str(len(workers)),
+        "DMLC_NUM_SERVER": str(len(servers)),
+        "DMLC_PS_ROOT_URI": scheduler_uri,
+        "DMLC_PS_ROOT_PORT": str(scheduler_port),
+    }
+    if extra_env:
+        base.update(extra_env)
+    plans: List[Dict[str, str]] = []
+
+    def plan(host: str, role: str, role_env: Dict[str, str],
+             cmd: Sequence[str]) -> Dict[str, str]:
+        env = dict(base)
+        env["DMLC_ROLE"] = role
+        env.update(role_env)
+        remote = f"{_export_str(env)} cd {shlex.quote(os.getcwd())}; " \
+                 f"{shlex.join(cmd)}"
+        target = f"{username}@{host}" if username else host
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no", target, remote]
+        return {"host": host, "role": role,
+                "remote_cmd": remote, "ssh_cmd": shlex.join(ssh_cmd)}
+
+    launcher = ["python", "-m", "byteps_tpu.launcher"]
+    for i, host in enumerate(servers):
+        plans.append(plan(host, "server", {"BYTEPS_SERVER_ID": str(i)},
+                          launcher))
+    for i, host in enumerate(workers):
+        plans.append(plan(host, "worker", {"DMLC_WORKER_ID": str(i)},
+                          launcher + list(command)))
+    return plans
+
+
+def run_plans(plans: List[Dict[str, str]], log_dir: str = "sshlog") -> int:
+    """Execute the ssh commands concurrently, teeing output per host
+    (reference: dist_launcher.py:36-58 thread-per-host)."""
+    os.makedirs(log_dir, exist_ok=True)
+    codes = [0] * len(plans)
+
+    def run_one(i: int, p: Dict[str, str]) -> None:
+        path = os.path.join(log_dir, f"{p['role']}-{p['host']}.log")
+        with open(path, "wb") as f:
+            proc = subprocess.Popen(shlex.split(p["ssh_cmd"]),
+                                    stdout=f, stderr=subprocess.STDOUT)
+            codes[i] = proc.wait()
+
+    threads = [threading.Thread(target=run_one, args=(i, p))
+               for i, p in enumerate(plans)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    bad = [p["host"] for p, c in zip(plans, codes) if c != 0]
+    if bad:
+        log.error("nonzero exit on hosts: %s (logs in %s/)", bad, log_dir)
+        return 1
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker-hostfile", required=True)
+    ap.add_argument("--server-hostfile", default="")
+    ap.add_argument("--scheduler-uri", default="")
+    ap.add_argument("--scheduler-port", type=int, default=9000)
+    ap.add_argument("--username", default="")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE exported on every host")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the per-host commands and exit")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="training command (after --)")
+    args = ap.parse_args(argv)
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    workers = read_hostfile(args.worker_hostfile)
+    servers = (read_hostfile(args.server_hostfile)
+               if args.server_hostfile else [])
+    scheduler = args.scheduler_uri or (servers[0].split(":")[0] if servers
+                                       else "127.0.0.1")
+    extra = dict(e.split("=", 1) for e in args.env)
+    plans = build_commands(workers, servers, scheduler, args.scheduler_port,
+                           command, extra_env=extra, username=args.username)
+    if args.dry_run:
+        for p in plans:
+            print(f"[{p['role']}@{p['host']}] {p['ssh_cmd']}")
+        return 0
+    return run_plans(plans)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -450,12 +450,23 @@ class Server {
     // first push of a key allocates; reply withheld until every worker's
     // init push arrived (server.cc:266-295)
     std::vector<ParkedPull> release;
+    std::vector<ParkedPull> stale;  // parked under the OLD length: error out
     {
       KeyStore& ks = store_of(m.key);
       std::lock_guard<std::mutex> lk(ks.mu);
       if (ks.len != (uint32_t)m.payload.size()) {
         // fresh key, or re-init with a new length (tensor resize): reset
-        // the whole aggregation state
+        // the whole aggregation state. Anything parked against the old
+        // length must be error-replied, NOT left parked — an old-length
+        // pull answered later with new-length bytes is silently discarded
+        // by the client (out_len mismatch) and reads as success with an
+        // unwritten output buffer.
+        stale.reserve(ks.parked_pulls.size() + ks.parked_inits.size());
+        for (auto& p : ks.parked_pulls) stale.push_back(p);
+        for (auto& p : ks.parked_inits) stale.push_back(p);
+        ks.parked_pulls.clear();
+        ks.parked_inits.clear();
+        ks.init_count = 0;
         ks.len = (uint32_t)m.payload.size();
         ks.dtype = m.dtype;
         ks.accum.assign(ks.len, 0);
@@ -470,6 +481,10 @@ class Server {
         release.swap(ks.parked_inits);
         ks.init_count = 0;  // allow re-init (elastic)
       }
+    }
+    for (auto& w : stale) {
+      MsgHeader r{kMagic, ACK, 1, 0, w.rid, m.key, 0, 0};  // flags=1: error
+      w.conn->send_msg(r, nullptr);
     }
     for (auto& w : release) {
       MsgHeader r{kMagic, ACK, 0, 0, w.rid, m.key, 0, 0};

@@ -158,12 +158,25 @@ class PSClient:
     def ensure_init(self, ctx: TensorContext, nbytes: int) -> None:
         """Init-push any partition of ctx this client hasn't initialized on
         the server at its current length (registry declaration alone doesn't
-        allocate the server store; a resized tensor re-inits)."""
+        allocate the server store; a resized tensor re-inits). Only the
+        missing partitions are pushed — every worker derives the same
+        ``missing`` set from the shared registry partitioning, so the
+        per-key init barrier still converges."""
         with self._lock:
             missing = [p for p in ctx.partitions
                        if self._inited_keys.get(p.key) != p.length]
-        if missing:
-            self.init_tensor(ctx, np.zeros(nbytes, np.uint8))
+        if not missing:
+            return
+        cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL, ctx.dtype)
+        futures = [
+            self._pool.submit(self.init_key, p.server, p.key,
+                              np.zeros(p.length, np.uint8), cmd)
+            for p in missing
+        ]
+        for f in futures:
+            f.result()
+        with self._lock:
+            self._inited_keys.update({p.key: p.length for p in missing})
 
     def push_pull(self, ctx: TensorContext, flat: np.ndarray,
                   average: bool = True,

@@ -1,0 +1,132 @@
+"""Launcher tests: core allocation knobs, per-rank env wiring + affinity of
+spawned workers, role dispatch, and the multi-node command builder
+(reference behaviors: launcher/launch.py:43-239, dist_launcher.py:36-100)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from byteps_tpu.launcher import (
+    _parse_core_list, allocate_cpu_cores, launch_workers, run_role,
+)
+from byteps_tpu.launcher.dist import build_commands, read_hostfile
+
+
+def test_parse_core_list():
+    assert _parse_core_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert _parse_core_list("") == []
+
+
+def test_allocate_fair_share():
+    sets = allocate_cpu_cores(2, avail=[0, 1, 2, 3])
+    assert sets == [[0, 1], [2, 3]]
+
+
+def test_allocate_visible_override(monkeypatch):
+    monkeypatch.setenv("BYTEPS_VISIBLE_CPU_CORES", "0-1;6,7")
+    assert allocate_cpu_cores(2) == [[0, 1], [6, 7]]
+    with pytest.raises(ValueError):
+        allocate_cpu_cores(3)
+
+
+def test_allocate_blacklist_and_quota(monkeypatch):
+    monkeypatch.setenv("BYTEPS_CPU_BLACKLIST", "0")
+    monkeypatch.setenv("BYTEPS_NUMA_DEFAULT_QUOTA", "1")
+    sets = allocate_cpu_cores(2, avail=[0, 1, 2, 3])
+    assert sets == [[1], [2]]  # core 0 excluded, 1 core each
+
+
+def test_allocate_more_workers_than_cores():
+    sets = allocate_cpu_cores(3, avail=[0, 1])
+    assert len(sets) == 3 and all(s for s in sets)
+
+
+def test_launch_workers_env_and_affinity(tmp_path):
+    """Each child sees its BYTEPS_LOCAL_RANK/SIZE and a pinned affinity."""
+    out = tmp_path / "env"
+    prog = (
+        "import os, json, sys;"
+        "json.dump({'rank': os.environ['BYTEPS_LOCAL_RANK'],"
+        " 'size': os.environ['BYTEPS_LOCAL_SIZE'],"
+        " 'aff': sorted(os.sched_getaffinity(0))},"
+        " open(sys.argv[1] + os.environ['BYTEPS_LOCAL_RANK'], 'w'))"
+    )
+    rc = launch_workers([sys.executable, "-c", prog, str(out)], local_size=2)
+    assert rc == 0
+    recs = [json.load(open(f"{out}{r}")) for r in range(2)]
+    assert [r["rank"] for r in recs] == ["0", "1"]
+    assert all(r["size"] == "2" for r in recs)
+    if len(os.sched_getaffinity(0)) >= 2:
+        assert set(recs[0]["aff"]).isdisjoint(recs[1]["aff"])
+
+
+def test_launch_workers_propagates_failure():
+    rc = launch_workers([sys.executable, "-c", "import sys; sys.exit(3)"],
+                        local_size=1)
+    assert rc == 3
+
+
+def test_trace_dirs_created(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYTEPS_TRACE_ON", "1")
+    monkeypatch.setenv("BYTEPS_TRACE_DIR", str(tmp_path / "tr"))
+    rc = launch_workers([sys.executable, "-c", "pass"], local_size=2)
+    assert rc == 0
+    assert (tmp_path / "tr" / "0").is_dir() and (tmp_path / "tr" / "1").is_dir()
+
+
+def test_scheduler_role_noop(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "scheduler")
+    assert run_role([]) == 0
+
+
+def test_worker_role_requires_command(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    assert run_role([]) == 2
+
+
+def test_cli_entry():
+    rc = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher",
+         sys.executable, "-c", "print('ok')"],
+        capture_output=True, text=True,
+        env={**os.environ, "BYTEPS_LOCAL_SIZE": "1",
+             "JAX_PLATFORMS": "cpu"})
+    assert rc.returncode == 0 and "ok" in rc.stdout
+
+
+def test_dist_build_commands(tmp_path):
+    wf = tmp_path / "workers.txt"
+    wf.write_text("# comment\nw0\nw1\n\n")
+    sf = tmp_path / "servers.txt"
+    sf.write_text("s0\n")
+    workers, servers = read_hostfile(str(wf)), read_hostfile(str(sf))
+    assert workers == ["w0", "w1"] and servers == ["s0"]
+    plans = build_commands(workers, servers, "10.0.0.1", 9100,
+                           ["python", "train.py"],
+                           extra_env={"FOO": "bar"})
+    assert [p["role"] for p in plans] == ["server", "worker", "worker"]
+    srv, w0, w1 = plans
+    assert "export BYTEPS_SERVER_ID=0;" in srv["remote_cmd"]
+    assert "export DMLC_WORKER_ID=0;" in w0["remote_cmd"]
+    assert "export DMLC_WORKER_ID=1;" in w1["remote_cmd"]
+    for p in plans:
+        assert "export DMLC_NUM_WORKER=2;" in p["remote_cmd"]
+        assert "export DMLC_NUM_SERVER=1;" in p["remote_cmd"]
+        assert "export DMLC_PS_ROOT_URI=10.0.0.1;" in p["remote_cmd"]
+        assert "export FOO=bar;" in p["remote_cmd"]
+        assert p["ssh_cmd"].startswith("ssh ")
+    assert "train.py" in w0["remote_cmd"] and "train.py" not in srv["remote_cmd"]
+
+
+def test_dist_dry_run(tmp_path, capsys):
+    from byteps_tpu.launcher.dist import main as dist_main
+    wf = tmp_path / "w.txt"
+    wf.write_text("h1\n")
+    rc = dist_main(["--worker-hostfile", str(wf), "--dry-run",
+                    "--", "python", "t.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[worker@h1]" in out and "t.py" in out

@@ -62,9 +62,54 @@ def test_queue_credit_blocks_admission():
     th.start()
     time.sleep(0.3)
     assert got == []               # only 50 bytes credit left: blocked
-    q.report_finish(100)           # returns credit
+    q.report_finish(t0)            # returns credit
     th.join(timeout=5)
     assert got and got[0].key == 1
+
+
+def test_queue_serializes_same_key():
+    """Two tasks for the same key never run concurrently: the second is
+    held until report_finish of the first, so overlapping push_pulls of one
+    tensor can't interleave server aggregation rounds."""
+    q = ScheduledQueue()
+    first, second = mk_task(key=7, priority=0), mk_task(key=7, priority=0)
+    other = mk_task(key=8, priority=-1)  # lower priority, different key
+    q.add_task(first)
+    q.add_task(second)
+    q.add_task(other)
+    t0 = q.get_task()
+    assert t0 is first
+    # key 7 in flight: next admission skips `second` and takes key 8
+    t1 = q.get_task()
+    assert t1 is other
+    got = []
+    th = threading.Thread(target=lambda: got.append(q.get_task()))
+    th.start()
+    time.sleep(0.2)
+    assert got == []               # second still blocked on in-flight key
+    q.report_finish(t0)
+    th.join(timeout=5)
+    assert got and got[0] is second
+
+
+def test_add_task_after_stop_raises():
+    q = ScheduledQueue()
+    q.stop()
+    with pytest.raises(RuntimeError):
+        q.add_task(mk_task(key=0, priority=0))
+
+
+def test_stop_fails_queued_tasks():
+    """Tasks still queued at stop() resolve their groups with an error so
+    synchronize() raises instead of hanging."""
+    errs = []
+    ctx = TensorContext(name="t", declared_key=0, dtype=DataType.FLOAT32)
+    g = TaskGroup(ctx, 1, lambda e: errs.append(e))
+    part = Partition(key=0, index=0, offset=0, length=10)
+    q = ScheduledQueue()
+    q.add_task(PartitionTask(ctx, part, 0, 0, None, None, g, 0))
+    q.stop()
+    assert len(errs) == 1 and isinstance(errs[0], RuntimeError)
 
 
 def test_task_group_counts_partitions():
