@@ -112,13 +112,6 @@ class ScheduledQueue:
             heapq.heappush(self._heap, item)
         return found
 
-    def drain(self) -> List["PartitionTask"]:
-        """Remove and return all queued (unstarted) tasks."""
-        with self._cv:
-            tasks = [item[3] for item in self._heap]
-            self._heap.clear()
-            return tasks
-
     def report_finish(self, task: "PartitionTask") -> None:
         with self._cv:
             self._credit += task.nbytes

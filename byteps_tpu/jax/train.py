@@ -234,6 +234,86 @@ def make_ps_train_step(
     return step
 
 
+def make_async_ps_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DP_AXIS,
+):
+    """Asynchronous data-parallel train step (the reference's
+    BYTEPS_ENABLE_ASYNC mode, torch/__init__.py:188-216, server.cc:315-319):
+    each worker updates its params locally, pushes the weight DELTA to the
+    PS — which folds it into the authoritative weights with no aggregation
+    barrier — and pulls the current weights back. Workers never wait for
+    each other; staleness is the accepted tradeoff.
+
+    The server must run with BYTEPS_ENABLE_ASYNC=1. On the first step each
+    worker init-pushes its initial weights (first arrival seeds the
+    authoritative copy — start workers from identical or broadcast params).
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+    Without a PS configured, degrades to plain local (single-worker) SGD.
+    """
+    import numpy as np
+
+    from ..core.state import get_state
+    from ..server.client import get_or_init_ctx
+
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = psum_tree(grads, axis=axis, average=True)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        delta = jax.tree.map(jnp.subtract, new_params, params)
+        loss = jax.lax.pmean(loss, axis)
+        return loss, delta, opt_state
+
+    local_fn = jax.jit(jax.shard_map(
+        local_step, mesh=mesh, in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    seeded = set()  # names whose initial weights were init-pushed
+
+    def step(params, opt_state, batch):
+        state = get_state()
+        client = state.ps_client
+        loss, delta, opt_state = local_fn(params, opt_state, batch)
+        if client is None:
+            params = jax.tree.map(jnp.add, params, delta)
+            return params, opt_state, loss
+        paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+        deltas = jax.tree.leaves(delta)
+        leaves = []
+        for (path, leaf), d in zip(paths, deltas):
+            name = "asyncw/" + "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+            host_w = np.asarray(leaf).reshape(-1)
+            ctx = get_or_init_ctx(state, name, host_w)
+            if name not in seeded:
+                client.init_weights(ctx, host_w)
+                seeded.add(name)
+            leaves.append((ctx, leaf, np.asarray(d).reshape(-1)))
+
+        # overlap the per-leaf round trips (they'd otherwise serialize the
+        # step on sum-of-RTTs); a dedicated pool, NOT client._pool — these
+        # calls block on client-pool futures and would deadlock it
+        import concurrent.futures
+
+        def one(item):
+            ctx, leaf, d = item
+            out = client.push_delta_pull_weights(ctx, d)
+            state.telemetry.record(out.nbytes * 2)
+            return jnp.asarray(out.reshape(leaf.shape))
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(16, len(leaves) or 1)) as pool:
+            pulled = list(pool.map(one, leaves))
+        params = treedef.unflatten(pulled)
+        return params, opt_state, loss
+
+    return step
+
+
 def init_zero_state(params, tx: optax.GradientTransformation, mesh: Mesh,
                     axis: str = DP_AXIS):
     """Initialize optimizer state over flat 1/N param shards (matches

@@ -195,13 +195,19 @@ def launch_workers(command: Sequence[str],
 
     # wait in completion order, not rank order: a crashed rank must tear
     # down survivors that are blocked on it (e.g. in a collective), which
-    # rank-order wait() would deadlock on
+    # rank-order wait() would deadlock on. SIGTERM escalates to SIGKILL so
+    # a child with a wedged TERM handler can't hang the launcher.
     import time
     rc = 0
     live = list(procs)
+    kill_deadline = None
     while live:
         done = [p for p in live if p.poll() is not None]
         if not done:
+            if kill_deadline is not None and time.time() > kill_deadline:
+                for q in live:
+                    q.kill()
+                kill_deadline = time.time() + 30  # re-arm; kill is decisive
             time.sleep(0.05)
             continue
         for p in done:
@@ -210,6 +216,7 @@ def launch_workers(command: Sequence[str],
                 rc = p.returncode
                 for q in live:
                     q.terminate()
+                kill_deadline = time.time() + 10
     return rc
 
 

@@ -19,7 +19,6 @@ import argparse
 import os
 import shlex
 import subprocess
-import threading
 from typing import Dict, List, Optional, Sequence
 
 from ..utils.logging import log
@@ -87,23 +86,55 @@ def build_commands(workers: Sequence[str], servers: Sequence[str],
 
 def run_plans(plans: List[Dict[str, str]], log_dir: str = "sshlog") -> int:
     """Execute the ssh commands concurrently, teeing output per host
-    (reference: dist_launcher.py:36-58 thread-per-host)."""
+    (reference: dist_launcher.py:36-58 thread-per-host). The first host to
+    fail (spawn error or nonzero exit) tears down the remaining ssh
+    processes — a dead server must not leave workers parked forever in the
+    init barrier."""
+    import time
+
     os.makedirs(log_dir, exist_ok=True)
-    codes = [0] * len(plans)
-
-    def run_one(i: int, p: Dict[str, str]) -> None:
+    procs: List[Optional[subprocess.Popen]] = []
+    codes: List[Optional[int]] = []
+    for p in plans:
         path = os.path.join(log_dir, f"{p['role']}-{p['host']}.log")
-        with open(path, "wb") as f:
-            proc = subprocess.Popen(shlex.split(p["ssh_cmd"]),
-                                    stdout=f, stderr=subprocess.STDOUT)
-            codes[i] = proc.wait()
+        try:
+            f = open(path, "wb")
+            procs.append(subprocess.Popen(shlex.split(p["ssh_cmd"]),
+                                          stdout=f, stderr=subprocess.STDOUT))
+            codes.append(None)
+        except OSError as e:  # spawn failure IS a host failure, not success
+            log.error("failed to launch %s@%s: %s", p["role"], p["host"], e)
+            procs.append(None)
+            codes.append(127)
 
-    threads = [threading.Thread(target=run_one, args=(i, p))
-               for i, p in enumerate(plans)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    rc = 1 if any(c == 127 for c in codes) else 0
+    if rc == 0:
+        while any(c is None for c in codes):
+            progressed = False
+            for i, proc in enumerate(procs):
+                if codes[i] is None and proc.poll() is not None:
+                    codes[i] = proc.returncode
+                    progressed = True
+            if rc == 0 and any(c not in (None, 0) for c in codes):
+                rc = 1
+                break
+            if not progressed:
+                time.sleep(0.2)
+    if rc != 0:  # teardown survivors
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in procs:
+            if proc is None:
+                continue
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if proc.poll() is None:
+                proc.kill()
+    for i, proc in enumerate(procs):
+        if proc is not None and codes[i] is None:
+            codes[i] = proc.wait()
     bad = [p["host"] for p, c in zip(plans, codes) if c != 0]
     if bad:
         log.error("nonzero exit on hosts: %s (logs in %s/)", bad, log_dir)

@@ -178,21 +178,14 @@ class PSClient:
         with self._lock:
             self._inited_keys.update({p.key: p.length for p in missing})
 
-    def push_pull(self, ctx: TensorContext, flat: np.ndarray,
-                  average: bool = True,
-                  num_workers: Optional[int] = None) -> np.ndarray:
-        """Partitioned push+pull of one tensor; returns the summed
-        (averaged) flat array. Partitions run concurrently on the pool,
-        each as push-then-pull against its assigned server."""
-        if self._closed:
-            raise RuntimeError("push_pull on a closed PSClient")
-        dtype = flat.dtype
+    def _round_trip(self, ctx: TensorContext, in_flat: np.ndarray,
+                    out_flat: np.ndarray) -> None:
+        """Concurrent per-partition push-then-pull against the assigned
+        servers (the PUSH/PULL stage pair, core_loops.cc:538-618)."""
         cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
-                               DataType.from_np(dtype))
-        self.ensure_init(ctx, flat.nbytes)
-        out = np.empty_like(flat)
-        in_view = flat.view(np.uint8)
-        out_view = out.view(np.uint8)
+                               DataType.from_np(in_flat.dtype))
+        in_view = in_flat.view(np.uint8)
+        out_view = out_flat.view(np.uint8)
 
         def one(p: Partition):
             self.zpush(p.server, p.key,
@@ -203,11 +196,45 @@ class PSClient:
         futures = [self._pool.submit(one, p) for p in ctx.partitions]
         for f in futures:
             f.result()
+
+    def push_pull(self, ctx: TensorContext, flat: np.ndarray,
+                  average: bool = True,
+                  num_workers: Optional[int] = None) -> np.ndarray:
+        """Partitioned push+pull of one tensor; returns the summed
+        (averaged) flat array."""
+        if self._closed:
+            raise RuntimeError("push_pull on a closed PSClient")
+        dtype = flat.dtype
+        self.ensure_init(ctx, flat.nbytes)
+        out = np.empty_like(flat)
+        self._round_trip(ctx, flat, out)
         if average and num_workers and num_workers > 1:
             if np.issubdtype(dtype, np.integer):
                 out //= num_workers
             else:
                 out /= num_workers
+        return out
+
+    def init_weights(self, ctx: TensorContext, flat: np.ndarray) -> None:
+        """Async-mode bootstrap: init-push the worker's initial weights so
+        the server's authoritative copy starts from them (the reference
+        seeds the async store with the first init push,
+        server.cc:266-295,434-436). Blocks until every worker has
+        init-pushed (the per-key barrier); the first arrival's values win."""
+        self.init_tensor(ctx, flat)
+
+    def push_delta_pull_weights(self, ctx: TensorContext,
+                                delta: np.ndarray) -> np.ndarray:
+        """Asynchronous data parallelism (BYTEPS_ENABLE_ASYNC): push this
+        worker's weight DELTA — the server folds it straight into the
+        authoritative weights — and pull the current weights back, with no
+        cross-worker aggregation barrier (reference: server.cc:315-319,
+        torch/__init__.py:188-216). Requires the server to run in async
+        mode; no averaging (each worker's delta applies in full)."""
+        if self._closed:
+            raise RuntimeError("push_delta_pull_weights on a closed PSClient")
+        out = np.empty_like(delta)
+        self._round_trip(ctx, delta, out)
         return out
 
     def close(self, shutdown_servers: bool = True) -> None:

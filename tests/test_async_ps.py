@@ -1,0 +1,135 @@
+"""Asynchronous data parallelism (BYTEPS_ENABLE_ASYNC): server folds weight
+deltas straight into the authoritative weights with no aggregation barrier,
+pulls are always answerable (reference: server.cc:315-319,434-436;
+torch/__init__.py:188-216)."""
+
+import threading
+
+import numpy as np
+import optax
+import pytest
+
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.types import DataType
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+
+_PORT = [20300]
+
+
+def _start_async_server(port, num_workers):
+    server = threading.Thread(
+        target=run_server,
+        args=(port, Config(num_workers=num_workers, num_servers=1,
+                           enable_async=True)),
+        daemon=True)
+    server.start()
+    return server
+
+
+def test_async_protocol_two_workers():
+    """Two workers seed the same initial weights, push deltas without any
+    round barrier; every pull reflects all deltas folded so far."""
+    port = _PORT[0]
+    _PORT[0] += 1
+    server = _start_async_server(port, num_workers=2)
+    reg = TensorRegistry(Config(num_workers=2, num_servers=1))
+    ctx = reg.init_tensor("w", 64 * 4, DataType.FLOAT32)
+    w0 = np.arange(64, dtype=np.float32)
+
+    c0 = PSClient([f"127.0.0.1:{port}"], worker_id=0)
+    c1 = PSClient([f"127.0.0.1:{port}"], worker_id=1)
+    try:
+        # init barrier: both workers must seed before either proceeds
+        t = threading.Thread(target=c1.init_weights, args=(ctx, w0.copy()))
+        t.start()
+        c0.init_weights(ctx, w0.copy())
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+        d0 = np.full(64, 0.5, np.float32)
+        out0 = c0.push_delta_pull_weights(ctx, d0)
+        np.testing.assert_allclose(out0, w0 + 0.5)   # no barrier on w1
+        d1 = np.full(64, 0.25, np.float32)
+        out1 = c1.push_delta_pull_weights(ctx, d1)
+        np.testing.assert_allclose(out1, w0 + 0.75)  # both deltas folded
+        # worker 0 pushes again immediately — async never parks
+        out0b = c0.push_delta_pull_weights(ctx, d0)
+        np.testing.assert_allclose(out0b, w0 + 1.25)
+    finally:
+        c0.close(shutdown_servers=True)
+        c1.close(shutdown_servers=True)
+        server.join(timeout=10)
+
+
+@pytest.fixture()
+def async_env(monkeypatch):
+    from byteps_tpu.core.state import GlobalState
+
+    port = _PORT[0]
+    _PORT[0] += 1
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+    server = _start_async_server(port, num_workers=1)
+
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    yield bps
+    bps.shutdown()
+    server.join(timeout=10)
+    GlobalState._instance = None
+
+
+def test_async_train_step(async_env):
+    import jax
+    from byteps_tpu.core.state import get_state
+    from byteps_tpu.jax.train import make_async_ps_train_step
+    from byteps_tpu.models import mlp
+
+    assert get_state().config.enable_async
+    mesh = get_state().mesh
+    cfg = mlp.MLPConfig(in_dim=32, hidden=(16,), n_classes=4)
+    params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.sgd(0.1)
+    step = make_async_ps_train_step(
+        lambda p, b: mlp.loss_fn(p, b, cfg), tx, mesh)
+    opt = tx.init(params)
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 32).astype(np.float32)
+    y = np.argmax(x @ rng.randn(32, 4), -1).astype(np.int32)
+    losses = []
+    for _ in range(15):
+        params, opt, loss = step(params, opt, {"x": x, "y": y})
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_async_step_without_ps(bps):
+    """No PS configured: the async step degrades to local SGD."""
+    import jax
+    from byteps_tpu.core.state import get_state
+    from byteps_tpu.jax.train import make_async_ps_train_step
+    from byteps_tpu.models import mlp
+
+    assert get_state().ps_client is None
+    mesh = get_state().mesh
+    cfg = mlp.MLPConfig(in_dim=8, hidden=(8,), n_classes=3)
+    params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.sgd(0.1)
+    step = make_async_ps_train_step(
+        lambda p, b: mlp.loss_fn(p, b, cfg), tx, mesh)
+    opt = tx.init(params)
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = rng.randint(0, 3, 64).astype(np.int32)
+    l0 = None
+    for _ in range(10):
+        params, opt, loss = step(params, opt, {"x": x, "y": y})
+        l0 = l0 or float(loss)
+    assert float(loss) < l0
