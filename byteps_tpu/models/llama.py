@@ -156,8 +156,9 @@ def _attention(q, k, v, cfg: LlamaConfig, attn_impl=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
-    """One decoder block; p holds this layer's (unstacked) params."""
+def attn_sublayer(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
+    """Pre-norm attention sublayer with residual: x + Attn(RMSNorm(x)).
+    Shared by the dense block here and the MoE block (models/moe.py)."""
     B, S, d = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -169,8 +170,29 @@ def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = _attention(q, k, v, cfg, attn_impl)
-    x = x + attn.reshape(B, S, nh * hd) @ p["wo"].astype(dt)
+    return x + attn.reshape(B, S, nh * hd) @ p["wo"].astype(dt)
 
+
+def next_token_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy. logits [B, S, V], targets [B, S].
+    The single loss definition shared by llama/moe/pp paths."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def split_batch(batch: Dict[str, jnp.ndarray]) -> tuple:
+    """(inputs, targets) from either a pre-shifted {'inputs','targets'}
+    batch or a raw {'tokens'} batch (shifted here)."""
+    if "inputs" in batch:
+        return batch["inputs"], batch["targets"]
+    return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+
+
+def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
+    """One decoder block; p holds this layer's (unstacked) params."""
+    dt = cfg.dtype
+    x = attn_sublayer(x, p, cos, sin, cfg, attn_impl)
     h = _rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
     up = h @ p["w_up"].astype(dt)
@@ -219,6 +241,47 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
     return logits.astype(jnp.float32)
 
 
+def forward_pp(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
+               *, num_microbatches: int, pp_axis: str = "pp") -> jnp.ndarray:
+    """Pipeline-parallel forward. Call INSIDE shard_map with
+    ``params['blocks']`` leaves sharded on their leading [n_layers] dim over
+    ``pp_axis`` (each stage holds n_layers/P layers) and everything else
+    replicated. Returns logits valid ONLY on the last stage (zeros
+    elsewhere); see loss_fn_pp for the masked-psum loss."""
+    from ..parallel.pipeline import pipeline_forward
+
+    B, S = tokens.shape
+    cos, sin = rope_cache(cfg, S)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def layer_fn(h, p_layer):
+        return _block(h, p_layer, cos, sin, cfg, None)
+
+    out = pipeline_forward(x, params["blocks"], layer_fn,
+                           num_microbatches=num_microbatches, axis=pp_axis,
+                           remat=cfg.remat)
+    h = _rmsnorm(out, params["final_norm"], cfg.norm_eps)
+    return (h @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn_pp(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+               cfg: LlamaConfig, *, num_microbatches: int,
+               pp_axis: str = "pp") -> jnp.ndarray:
+    """Pipeline-parallel next-token loss, replicated across stages.
+
+    Gradient contract: blocks grads come out stage-local (sharded over
+    ``pp_axis``); grads of the pp-replicated leaves (embed, final_norm,
+    lm_head) are per-stage partials — psum them over ``pp_axis``
+    (parallel.pipeline.replicated_grad_correction) before use.
+    """
+    from ..parallel.pipeline import last_stage_value
+
+    inputs, targets = split_batch(batch)
+    logits = forward_pp(params, inputs, cfg,
+                        num_microbatches=num_microbatches, pp_axis=pp_axis)
+    return last_stage_value(next_token_xent(logits, targets), pp_axis)
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
             cfg: LlamaConfig, attn_impl=None,
             sp_axis: Optional[str] = None) -> jnp.ndarray:
@@ -228,21 +291,14 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
     or pre-shifted {"inputs", "targets"} (required under sequence
     parallelism, where the shift must happen before sharding).
     """
-    if "inputs" in batch:
-        logits = forward(params, batch["inputs"], cfg, attn_impl, sp_axis)
-        targets = batch["targets"]
-    else:
-        if sp_axis is not None:
-            raise ValueError(
-                "sequence parallelism requires a pre-shifted batch "
-                "({'inputs', 'targets'}): shifting a sharded 'tokens' "
-                "locally would gap the global sequence")
-        tokens = batch["tokens"]
-        logits = forward(params, tokens[:, :-1], cfg, attn_impl, sp_axis)
-        targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    if "inputs" not in batch and sp_axis is not None:
+        raise ValueError(
+            "sequence parallelism requires a pre-shifted batch "
+            "({'inputs', 'targets'}): shifting a sharded 'tokens' "
+            "locally would gap the global sequence")
+    inputs, targets = split_batch(batch)
+    logits = forward(params, inputs, cfg, attn_impl, sp_axis)
+    loss = next_token_xent(logits, targets)
     if sp_axis is not None:
         loss = jax.lax.pmean(loss, sp_axis)
     return loss

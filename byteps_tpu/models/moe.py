@@ -1,0 +1,271 @@
+"""Mixtral-style sparse Mixture-of-Experts transformer with expert
+parallelism over the ``ep`` mesh axis.
+
+The reference has no MoE / expert parallelism (SURVEY.md §2.8 — absent);
+this is green-field TPU design following the GShard/Switch SPMD recipe:
+
+- routing is dense math (top-k gating, capacity-bounded dispatch masks) so
+  everything stays static-shaped for XLA — no data-dependent gather loops;
+- dispatch/combine are einsums against a [tokens, experts, capacity] mask,
+  which XLA fuses onto the MXU;
+- expert parallelism = shard the experts dim over ``ep`` and move tokens
+  with two ``lax.all_to_all`` calls (dispatch there, combine back), the
+  collective riding ICI inside shard_map;
+- attention/embedding reuse the Llama building blocks (models/llama.py).
+
+Tokens dropped beyond expert capacity pass through the residual unchanged
+(standard Switch behavior). The router adds the Switch load-balancing
+auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama as L
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    n_experts: int = 8
+    top_k: int = 2
+    expert_hidden: int = 14336
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def as_llama(self) -> L.LlamaConfig:
+        """The attention-relevant subset as a LlamaConfig (for reusing the
+        llama block helpers)."""
+        return L.LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            hidden_dim=self.expert_hidden, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dtype=self.dtype, param_dtype=self.param_dtype, remat=False)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, seq: int = 64) -> "MoEConfig":
+        return MoEConfig(vocab_size=vocab_size, dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, n_experts=4, top_k=2,
+                         expert_hidden=128, max_seq_len=seq, remat=False,
+                         capacity_factor=2.0)
+
+    @staticmethod
+    def small(vocab_size: int = 32000) -> "MoEConfig":
+        """Mixtral-flavored benchmark config at ~125M-active scale."""
+        return MoEConfig(vocab_size=vocab_size, dim=768, n_layers=12,
+                         n_heads=12, n_kv_heads=4, n_experts=8, top_k=2,
+                         expert_hidden=2048, max_seq_len=2048)
+
+
+def capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    """Static per-expert token capacity for a batch of n_tokens."""
+    return max(1, int(math.ceil(
+        cfg.top_k * n_tokens / cfg.n_experts * cfg.capacity_factor)))
+
+
+# --------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------- #
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
+    k_emb, k_blk, k_out = jax.random.split(rng, 3)
+    d, h, E = cfg.dim, cfg.expert_hidden, cfg.n_experts
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    Ln = cfg.n_layers
+
+    def dense_init(key, shape, scale=None):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
+        scale = scale if scale is not None else 1.0 / np.sqrt(fan_in)
+        return jax.random.normal(key, shape, cfg.param_dtype) * scale
+
+    ks = jax.random.split(k_blk, 9)
+    block = {
+        "attn_norm": jnp.ones((Ln, d), cfg.param_dtype),
+        "wq": dense_init(ks[0], (Ln, d, nh * hd)),
+        "wk": dense_init(ks[1], (Ln, d, nkv * hd)),
+        "wv": dense_init(ks[2], (Ln, d, nkv * hd)),
+        "wo": dense_init(ks[3], (Ln, nh * hd, d)),
+        "mlp_norm": jnp.ones((Ln, d), cfg.param_dtype),
+        "router": dense_init(ks[4], (Ln, d, E), scale=0.02),
+        "w_gate": dense_init(ks[5], (Ln, E, d, h)),
+        "w_up": dense_init(ks[6], (Ln, E, d, h)),
+        "w_down": dense_init(ks[7], (Ln, E, h, d)),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, d), scale=0.02),
+        "blocks": block,
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+        "lm_head": dense_init(k_out, (d, cfg.vocab_size)),
+    }
+
+
+def param_count(params: Dict[str, Any]) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+# --------------------------------------------------------------------- #
+# routing + expert layer
+# --------------------------------------------------------------------- #
+
+def _route(x_flat: jnp.ndarray, router_w: jnp.ndarray, cfg: MoEConfig,
+           cap: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k capacity-bounded routing.
+
+    x_flat: [T, d]. Returns (dispatch [T, E, C] float mask,
+    combine [T, E, C] gate-weighted mask, aux_loss scalar).
+    """
+    T = x_flat.shape[0]
+    E, k = cfg.n_experts, cfg.top_k
+    logits = (x_flat.astype(jnp.float32)
+              @ router_w.astype(jnp.float32))            # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [T, k]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # Choice slots flattened k-major: slot 0 of every token claims capacity
+    # before any slot 1 (Switch priority: primary routes never lose space
+    # to secondary ones).
+    idx_flat = gate_idx.T.reshape(-1)                    # [k*T]
+    onehot = jax.nn.one_hot(idx_flat, E, dtype=jnp.float32)   # [k*T, E]
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot)     # exclusive
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)       # [k*T]
+    keep = pos < cap
+
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                          dtype=jnp.float32)             # [k*T, C]
+    mask = (onehot * keep[:, None])[:, :, None] * slot[:, None, :]
+    mask = mask.reshape(k, T, E, cap)                    # [k, T, E, C]
+    dispatch = jnp.sum(mask, axis=0)                     # [T, E, C]
+    combine = jnp.sum(mask * gate_vals.T.reshape(k, T, 1, 1), axis=0)
+
+    # Switch aux loss: E * sum_e f_e * p_e  (f = token fraction routed to e
+    # on the primary choice, p = mean router prob)
+    prime = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
+    aux = E * jnp.sum(jnp.mean(prime, axis=0) * jnp.mean(probs, axis=0))
+    return dispatch, combine, aux
+
+
+def _expert_ffn(h: jnp.ndarray, w_gate, w_up, w_down,
+                dtype) -> jnp.ndarray:
+    """SwiGLU per expert. h: [E_local, C', d]."""
+    g = jax.nn.silu(jnp.einsum("ecd,edh->ech", h, w_gate.astype(dtype)))
+    u = jnp.einsum("ecd,edh->ech", h, w_up.astype(dtype))
+    return jnp.einsum("ech,ehd->ecd", g * u, w_down.astype(dtype))
+
+
+def moe_layer(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg: MoEConfig,
+              ep_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The MoE FFN: route, dispatch, expert-compute, combine.
+
+    x: [B, S, d]. ``p`` holds ONE layer's params; with ``ep_axis`` set (call
+    inside shard_map), p's expert leaves (w_gate/w_up/w_down) carry only the
+    E_local = E/P local experts and tokens travel via all_to_all. Returns
+    (output [B, S, d], aux_loss).
+    """
+    B, S, d = x.shape
+    dt = cfg.dtype
+    x_flat = x.reshape(B * S, d)
+    cap = capacity(cfg, B * S)
+    dispatch, combine, aux = _route(x_flat, p["router"], cfg, cap)
+
+    # [T, E, C] x [T, d] -> [E, C, d]
+    h = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x_flat)
+    if ep_axis is None:
+        out_e = _expert_ffn(h, p["w_gate"], p["w_up"], p["w_down"], dt)
+    else:
+        # E -> E_local chunks scattered to their owner, each expert now sees
+        # P*C token slots (C from every ep peer)
+        h = jax.lax.all_to_all(h, ep_axis, split_axis=0, concat_axis=1,
+                               tiled=True)               # [E_local, P*C, d]
+        out_e = _expert_ffn(h, p["w_gate"], p["w_up"], p["w_down"], dt)
+        out_e = jax.lax.all_to_all(out_e, ep_axis, split_axis=1,
+                                   concat_axis=0, tiled=True)  # [E, C, d]
+    out = jnp.einsum("tec,ecd->td", combine.astype(dt), out_e)
+    return out.reshape(B, S, d), aux
+
+
+# --------------------------------------------------------------------- #
+# full model
+# --------------------------------------------------------------------- #
+
+def _moe_block(x, p, cos, sin, cfg: MoEConfig,
+               ep_axis: Optional[str]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Attention (llama's shared sublayer) + MoE FFN. p: one layer's
+    params."""
+    x = L.attn_sublayer(x, p, cos, sin, cfg.as_llama())
+    h = L._rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+    ffn, aux = moe_layer(h, p, cfg, ep_axis)
+    return x + ffn, aux
+
+
+def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
+            ep_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] -> (logits [B, S, vocab] fp32, mean aux loss)."""
+    B, S = tokens.shape
+    cos, sin = L.rope_cache(cfg.as_llama(), S)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def body(x, layer_params):
+        fn = _moe_block
+        if cfg.remat:
+            fn = jax.checkpoint(_moe_block, static_argnums=(4, 5))
+        x, aux = fn(x, layer_params, cos, sin, cfg, ep_axis)
+        return x, aux
+
+    x, auxes = jax.lax.scan(body, x, params["blocks"])
+    x = L._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, jnp.mean(auxes)
+
+
+EXPERT_LEAVES = ("w_gate", "w_up", "w_down")
+
+
+def ep_grad_correction(grads: Dict[str, Any], axis: str) -> Dict[str, Any]:
+    """Turn per-device ``jax.grad(local loss)`` output into the gradient of
+    the global (device-mean) loss under expert parallelism.
+
+    Expert leaves already carry the cross-device sum — the transpose of the
+    dispatch ``all_to_all`` routes every peer's cotangents back to the
+    expert's owner — so they only need the 1/P mean scaling. Every other
+    leaf is a local partial and gets the standard DP pmean.
+    """
+
+    def fix(path, leaf):
+        keys = {getattr(k, "key", None) for k in path}
+        if keys & set(EXPERT_LEAVES):
+            return leaf / jax.lax.axis_size(axis)
+        return jax.lax.pmean(leaf, axis)
+
+    return jax.tree_util.tree_map_with_path(fix, grads)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+            cfg: MoEConfig, ep_axis: Optional[str] = None) -> jnp.ndarray:
+    """Next-token cross-entropy + router aux loss."""
+    inputs, targets = L.split_batch(batch)
+    logits, aux = forward(params, inputs, cfg, ep_axis)
+    return (L.next_token_xent(logits, targets)
+            + cfg.router_aux_weight * aux)

@@ -1,0 +1,137 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.8 — absent); this is
+green-field TPU design. The approach is the standard TPU SPMD pipeline
+(GPipe-style microbatching expressed as collective ops so it compiles into
+one XLA program):
+
+- layer params are stacked on a leading [L] dim (as in models/llama.py) and
+  sharded over the ``pp`` axis — each of the P stages holds L/P layers;
+- inside ``shard_map`` each stage repeatedly (a) injects the next microbatch
+  at stage 0, (b) runs its local layers, (c) collects finished microbatches
+  at the last stage, (d) rotates activations one stage forward with
+  ``lax.ppermute`` (a cyclic shift whose wrap-around edge carries only
+  ignored padding);
+- the loop runs M + P - 1 ticks (`lax.scan`), the classic pipeline fill +
+  drain schedule; bubbles are idle compute on garbage data, masked at the
+  edges.
+
+Because ``ppermute`` is differentiable (its transpose is the reverse
+permutation), ``jax.grad`` through ``pipeline_forward`` yields exactly the
+1F1B-communication-pattern backward for free — XLA schedules the reverse
+rotations.
+
+Everything here is called INSIDE shard_map with the ``pp`` axis bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import PP_AXIS
+
+
+def stage_index(axis: str = PP_AXIS) -> jnp.ndarray:
+    return jax.lax.axis_index(axis)
+
+
+def pipeline_forward(
+    x: jnp.ndarray,
+    stage_params: Any,
+    layer_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+    *,
+    num_microbatches: int,
+    axis: str = PP_AXIS,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Run the local batch ``x`` through all L stacked layers pipelined over
+    ``axis``.
+
+    x: [B, ...] local-batch activations (replicated over ``axis``; B must be
+       divisible by ``num_microbatches``).
+    stage_params: pytree whose leaves have leading dim L_local = L / P —
+       this stage's shard of the stacked layer params.
+    layer_fn(h, p_layer) -> h: applies ONE layer (unstacked params).
+
+    Returns [B, ...] outputs, valid ONLY on the last stage (others hold
+    zeros) — combine with :func:`last_stage_value` or compute the loss
+    locally and mask+psum (see models/llama.py loss_fn_pp).
+    """
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    P = jax.lax.axis_size(axis)
+    s = jax.lax.axis_index(axis)
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+
+    def run_stage(h):
+        def body(h, p_layer):
+            fn = jax.checkpoint(layer_fn) if remat else layer_fn
+            return fn(h, p_layer), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def tick(carry, t):
+        state, out_buf = carry
+        # (a) inject microbatch t at stage 0 (clamped index; validity is
+        # implied by the collect window, garbage never reaches out_buf)
+        inj = jax.lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, M - 1), 0,
+                                           keepdims=False)
+        state = jnp.where(s == 0, inj.astype(state.dtype), state)
+        # (b) this stage's layers
+        state = run_stage(state)
+        # (c) last stage finished microbatch t-(P-1) at tick t
+        m = t - (P - 1)
+        out_buf = jax.lax.cond(
+            m >= 0,
+            lambda buf: jax.lax.dynamic_update_index_in_dim(
+                buf, state.astype(buf.dtype), jnp.maximum(m, 0), 0),
+            lambda buf: buf,
+            out_buf)
+        # (d) rotate activations one stage forward
+        state = jax.lax.ppermute(state, axis, perm)
+        return (state, out_buf), None
+
+    state0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    out0 = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
+    (_, out_buf), _ = jax.lax.scan(
+        tick, (state0, out0), jnp.arange(M + P - 1))
+
+    out = out_buf.reshape((B,) + x.shape[1:])
+    # only the last stage collected real data; zero elsewhere so callers can
+    # psum-broadcast without double counting
+    return jnp.where(s == P - 1, out, jnp.zeros_like(out))
+
+
+def last_stage_value(v: jnp.ndarray, axis: str = PP_AXIS) -> jnp.ndarray:
+    """Broadcast a value that is only valid on the last pipeline stage to
+    every stage (zero elsewhere + psum).
+
+    Gradient-correct under per-device ``jax.grad``: the broadcast output is
+    replicated, so every stage seeds cotangent 1 and the psum transpose
+    would inflate upstream gradients by the stage count P; the
+    stop-gradient rescale keeps the value while scaling the differentiable
+    path by 1/P, so block grads come out exact per stage.
+    """
+    P = jax.lax.axis_size(axis)
+    s = jax.lax.axis_index(axis)
+    summed = jax.lax.psum(jnp.where(s == P - 1, v, jnp.zeros_like(v)), axis)
+    if P == 1:
+        return summed
+    return summed / P + jax.lax.stop_gradient(summed) * ((P - 1) / P)
+
+
+def replicated_grad_correction(grads: Any, axis: str = PP_AXIS) -> Any:
+    """Sum gradients of pp-replicated params (embeddings, lm head, final
+    norm) across stages: each stage only touched them in its own segment of
+    the computation, so the true gradient is the sum of the per-stage
+    partials."""
+    return jax.lax.psum(grads, axis)

@@ -21,7 +21,7 @@ from typing import Any, Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DP_AXIS, SP_AXIS, TP_AXIS
+from .mesh import DP_AXIS, EP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS
 
 
 def llama_param_specs(params_shape: Any) -> Any:
@@ -65,6 +65,84 @@ def bert_param_specs(params_shape: Any) -> Any:
         "mlm_ln_g": P(), "mlm_ln_b": P(),
         "mlm_out_bias": P(),
     }
+
+
+def llama_pp_param_specs() -> Any:
+    """PartitionSpec pytree for pipeline-parallel Llama: the stacked
+    [n_layers] leading dim of every block leaf shards over ``pp`` (each
+    stage owns n_layers/P layers); embeddings/head replicate across stages
+    (their grads are psum'd, parallel/pipeline.py)."""
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "lm_head": P(),
+        "blocks": {
+            k: P(PP_AXIS) for k in
+            ("attn_norm", "wq", "wk", "wv", "wo",
+             "mlp_norm", "w_gate", "w_up", "w_down")
+        },
+    }
+
+
+def moe_param_specs(tp: bool = False) -> Any:
+    """PartitionSpec pytree for models/moe.py params: the experts dim shards
+    over ``ep``; attention optionally Megatron-``tp``."""
+    attn_col = P(None, None, TP_AXIS) if tp else P()
+    attn_row = P(None, TP_AXIS, None) if tp else P()
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "lm_head": P(),
+        "blocks": {
+            "attn_norm": P(),
+            "wq": attn_col, "wk": attn_col, "wv": attn_col,
+            "wo": attn_row,
+            "mlp_norm": P(),
+            "router": P(),
+            # [L, E, d, h]: experts over ep
+            "w_gate": P(None, EP_AXIS),
+            "w_up": P(None, EP_AXIS),
+            "w_down": P(None, EP_AXIS),
+        },
+    }
+
+
+def _keystr(k) -> str:
+    return str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+
+
+def mirror_opt_specs(tx, params: Any, param_specs: Any) -> Any:
+    """PartitionSpec tree for ``tx.init(params)``'s state.
+
+    Optimizer-state leaves that mirror a param leaf (adam mu/nu, momentum
+    buffers, ...) inherit that param's spec, matched by tree-path *suffix*
+    (an opt-state path like ``(0, 'mu', 'blocks', 'wq')`` ends with the
+    param path ``('blocks', 'wq')``) with a shape check so equal-shaped
+    params with different specs can't cross-contaminate. Scalar counts and
+    anything unmatched replicate.
+    """
+    opt_shapes = jax.eval_shape(tx.init, params)
+    spec_by_path = {
+        tuple(_keystr(k) for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            param_specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
+    shape_by_path = {
+        tuple(_keystr(k) for k in path): tuple(leaf.shape)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def spec_of(path, leaf):
+        keys = tuple(_keystr(k) for k in path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        for i in range(len(keys)):
+            suffix = keys[i:]
+            if (suffix in spec_by_path
+                    and shape_by_path.get(suffix) == shape):
+                return spec_by_path[suffix]
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_of, opt_shapes)
 
 
 def to_shardings(mesh: Mesh, spec_tree: Any) -> Any:

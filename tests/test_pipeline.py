@@ -1,0 +1,124 @@
+"""Pipeline parallelism: pp-sharded forward/backward must match the dense
+single-device model (the reference has no PP — SURVEY.md §2.8 — so the
+oracle is our own dense path)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.models import llama
+from byteps_tpu.parallel import pipeline as pl
+from byteps_tpu.parallel import sharding as sh
+from byteps_tpu.parallel.mesh import DP_AXIS, PP_AXIS, make_mesh
+
+
+def _cfg(n_layers=4):
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq=16)
+    return dataclasses.replace(cfg, n_layers=n_layers,
+                               dtype=jnp.float32)
+
+
+def _data(cfg, batch=8):
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (batch, 17)),
+        jnp.int32)
+    return params, tokens
+
+
+PP_SPECS = sh.llama_pp_param_specs()
+
+
+def test_pipeline_forward_matches_dense(devices):
+    cfg = _cfg()
+    params, tokens = _data(cfg)
+    dense = llama.loss_fn(params, {"tokens": tokens}, cfg)
+
+    mesh = make_mesh({PP_AXIS: 4}, devices[:4])
+    f = shard_map(
+        lambda p, t: llama.loss_fn_pp(p, {"tokens": t}, cfg,
+                                      num_microbatches=2),
+        mesh=mesh, in_specs=(PP_SPECS, P()), out_specs=P(),
+        check_vma=False)
+    pp = jax.jit(f)(params, tokens)
+    np.testing.assert_allclose(np.asarray(pp), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_grads_match_dense(devices):
+    cfg = _cfg()
+    params, tokens = _data(cfg)
+    dense_grads = jax.grad(
+        lambda p: llama.loss_fn(p, {"tokens": tokens}, cfg))(params)
+
+    mesh = make_mesh({PP_AXIS: 4}, devices[:4])
+
+    def pp_grads(p, t):
+        g = jax.grad(lambda q: llama.loss_fn_pp(
+            q, {"tokens": t}, cfg, num_microbatches=2))(p)
+        # pp-replicated leaves: per-stage partials -> sum across stages
+        for k in ("embed", "final_norm", "lm_head"):
+            g[k] = pl.replicated_grad_correction(g[k], PP_AXIS)
+        return g
+
+    grad_specs = dict(PP_SPECS)
+    f = shard_map(pp_grads, mesh=mesh, in_specs=(PP_SPECS, P()),
+                  out_specs=grad_specs, check_vma=False)
+    g = jax.jit(f)(params, tokens)
+
+    flat_d, _ = jax.tree_util.tree_flatten_with_path(dense_grads)
+    flat_p = dict(jax.tree_util.tree_flatten_with_path(g)[0])
+    for path, gd in flat_d:
+        gp = flat_p[path]
+        np.testing.assert_allclose(
+            np.asarray(gp), np.asarray(gd), rtol=5e-4, atol=5e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+
+
+def test_pipeline_microbatch_counts(devices):
+    """Loss is invariant to the microbatch count (schedule-only knob)."""
+    cfg = _cfg()
+    params, tokens = _data(cfg)
+    mesh = make_mesh({PP_AXIS: 4}, devices[:4])
+    losses = []
+    for m in (1, 2, 4, 8):
+        f = shard_map(
+            lambda p, t, m=m: llama.loss_fn_pp(p, {"tokens": t}, cfg,
+                                               num_microbatches=m),
+            mesh=mesh, in_specs=(PP_SPECS, P()), out_specs=P(),
+            check_vma=False)
+        losses.append(float(jax.jit(f)(params, tokens)))
+    np.testing.assert_allclose(losses, losses[0], rtol=2e-5)
+
+
+def test_pipeline_composes_with_dp(devices):
+    """dp x pp mesh: batch sharded over dp, stages over pp, grads psum'd
+    over dp — the full 2D layout on 8 virtual devices."""
+    cfg = _cfg(n_layers=2)
+    params, tokens = _data(cfg, batch=8)
+    dense = llama.loss_fn(params, {"tokens": tokens}, cfg)
+
+    mesh = make_mesh({DP_AXIS: 4, PP_AXIS: 2}, devices)
+
+    def step(p, t):
+        loss = llama.loss_fn_pp(p, {"tokens": t}, cfg, num_microbatches=2)
+        return jax.lax.pmean(loss, DP_AXIS)
+
+    f = shard_map(step, mesh=mesh, in_specs=(PP_SPECS, P(DP_AXIS)),
+                  out_specs=P(), check_vma=False)
+    out = jax.jit(f)(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_rejects_bad_microbatch():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="not divisible"):
+        pl.pipeline_forward(
+            jnp.zeros((7, 4)), {"w": jnp.zeros((1, 4, 4))},
+            lambda h, p: h, num_microbatches=3)
