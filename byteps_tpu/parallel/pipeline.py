@@ -26,16 +26,12 @@ Everything here is called INSIDE shard_map with the ``pp`` axis bound.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 
 from .mesh import PP_AXIS
-
-
-def stage_index(axis: str = PP_AXIS) -> jnp.ndarray:
-    return jax.lax.axis_index(axis)
 
 
 def pipeline_forward(

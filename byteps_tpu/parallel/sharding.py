@@ -84,19 +84,20 @@ def llama_pp_param_specs() -> Any:
     }
 
 
-def moe_param_specs(tp: bool = False) -> Any:
-    """PartitionSpec pytree for models/moe.py params: the experts dim shards
-    over ``ep``; attention optionally Megatron-``tp``."""
-    attn_col = P(None, None, TP_AXIS) if tp else P()
-    attn_row = P(None, TP_AXIS, None) if tp else P()
+def moe_param_specs() -> Any:
+    """PartitionSpec pytree for models/moe.py params under shard_map: the
+    experts dim shards over ``ep``; attention/router/embeddings replicate.
+    (Megatron-tp attention sharding is only valid on the GSPMD/jit tier
+    where XLA inserts the reduction collectives — attn_sublayer has no
+    explicit tp psum, so tp specs must not be combined with shard_map.)"""
     return {
         "embed": P(),
         "final_norm": P(),
         "lm_head": P(),
         "blocks": {
             "attn_norm": P(),
-            "wq": attn_col, "wk": attn_col, "wv": attn_col,
-            "wo": attn_row,
+            "wq": P(), "wk": P(), "wv": P(),
+            "wo": P(),
             "mlp_norm": P(),
             "router": P(),
             # [L, E, d, h]: experts over ep

@@ -1,0 +1,59 @@
+"""Intra-worker wire compression for the torch adapter.
+
+Mirror of the reference's byteps/torch/compression.py:47-76: a Compressor
+compresses the tensor before push_pull and decompresses the result; fp16
+halves wire bytes on the DCN PS hop. (The on-device Pallas codec stack in
+byteps_tpu.ops.compression is the heavy-weight path for JAX training; this
+is the adapter-level convenience knob.)
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        """Return (compressed_tensor, ctx) — ctx is whatever decompress
+        needs."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast fp32/fp64 to fp16 for the wire, restore on the way back
+    (reference: compression.py:47-64)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point and tensor.dtype != torch.float16:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace matching the reference's selection surface
+    (``compression=bps.Compression.fp16``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
