@@ -160,11 +160,29 @@ def make_zero_train_step(
     return _with_tracer_tick(jax.jit(smapped, donate_argnums=donate_argnums))
 
 
+_COMP_POOL = None
+
+
+def _comp_pool():
+    """Shared tensor-level fan-out pool for compressed push_pull. Must be
+    distinct from the client's partition pool (a tensor task blocks on
+    partition tasks — sharing one pool could deadlock) and shared across
+    step functions so rebuilding a step never accumulates executors."""
+    global _COMP_POOL
+    if _COMP_POOL is None:
+        import concurrent.futures
+        _COMP_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="bps-comp")
+    return _COMP_POOL
+
+
 def make_ps_train_step(
     loss_fn: Callable,
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis: str = DP_AXIS,
+    compression: Optional[dict] = None,
+    min_compress_bytes: Optional[int] = None,
 ):
     """Two-phase train step for the DCN PS path — the reference's actual
     architecture (docs/architecture.md "General Workflow"): the compiled
@@ -174,6 +192,13 @@ def make_ps_train_step(
     stages over DCN), and a second compiled program applies the optimizer
     update on the worker (servers only sum).
 
+    ``compression``: string-kwargs dict for the codec registry (e.g.
+    ``{"compressor": "onebit", "ef": "vanilla"}``) — gradients then ride
+    the wire compressed with the C++ server decompress/sum/recompress
+    mirror (reference: BASELINE config 4 path; server.cc:92-118). EF and
+    momentum state live worker-side per tensor. ``min_compress_bytes``
+    gates small tensors onto the dense path (BYTEPS_MIN_COMPRESS_BYTES).
+
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
     reads the PS client + registry from the global state at call time, so
     it composes with suspend/resume.
@@ -181,6 +206,11 @@ def make_ps_train_step(
     import numpy as np
 
     from ..core.state import get_state
+
+    # registry is keyed to the client that created it: suspend/resume
+    # replaces state.ps_client, and a cached registry would then push on a
+    # destroyed native handle with a stale worker count
+    comp_state = {"registry": None, "client": None}
 
     def local_grads(params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -210,7 +240,29 @@ def make_ps_train_step(
                     str(getattr(k, "key", getattr(k, "idx", k)))
                     for k in path))
                 hosts.append(np.asarray(leaf))
-            if state.scheduler is not None:
+            if compression is not None:
+                if comp_state["client"] is not client:
+                    from ..server.compressed import CompressedRegistry
+                    mcb = min_compress_bytes
+                    if mcb is None:
+                        mcb = getattr(state.config, "min_compress_bytes", 0)
+                    comp_state["registry"] = CompressedRegistry(
+                        client, state.config.num_workers, compression, mcb)
+                    comp_state["client"] = client
+                reg = comp_state["registry"]
+                pool = _comp_pool()
+                futures = [
+                    pool.submit(
+                        reg.push_pull, state, name,
+                        h.reshape(-1).astype(np.float32, copy=False),
+                        True)
+                    for name, h in zip(names, hosts)
+                ]
+                results = [
+                    f.result().reshape(h.shape)
+                    for f, h in zip(futures, hosts)
+                ]
+            elif state.scheduler is not None:
                 # pipelined: all tensors' partitions enter the priority-
                 # scheduled queue at once; PUSH/PULL of different
                 # partitions overlap on the stage threads

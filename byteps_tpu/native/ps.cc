@@ -39,6 +39,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <condition_variable>
@@ -68,6 +69,13 @@ enum Op : uint8_t {
   SHUTDOWN = 5,
   ACK = 6,
   PULL_REPLY = 7,
+  COMP_INIT = 8,  // per-key compressor kwargs (operations.cc:396-408)
+};
+
+enum ReqType : uint32_t {
+  kDefaultPushPull = 0,
+  kRowSparsePushPull = 1,
+  kCompressedPushPull = 2,
 };
 
 // DataType codes match byteps_tpu.core.types.DataType (mshadow order).
@@ -206,6 +214,268 @@ static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
 }
 
 // ------------------------------------------------------------------ //
+// server-side compression mirror
+//
+// The reference server instantiates the worker's compressor from kwargs
+// pushed in-band, decompresses each push, sums dense, and recompresses the
+// aggregate for pulls (server.cc:92-118,228-257). Wire formats match
+// byteps_tpu/ops/compression/host.py (the portable layouts, NOT the Pallas
+// sublane-folded onebit layout). Bit-exactness contract: signs, levels and
+// indices are bit-for-bit with the numpy golden; reduction-derived scalars
+// (onebit scale, dithering l2 norm) may differ by an ulp — this side
+// accumulates in double, numpy uses float32 pairwise summation.
+// ------------------------------------------------------------------ //
+
+// splitmix64 seeding shared with ops/compression/rng.py seed_state().
+static void seed_state64(uint64_t seed, uint64_t* s0, uint64_t* s1) {
+  uint64_t out[2];
+  uint64_t z = seed;
+  for (int i = 0; i < 2; ++i) {
+    z += 0x9E3779B97F4A7C15ULL;
+    uint64_t x = z;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    out[i] = x ^ (x >> 31);
+  }
+  *s0 = out[0];
+  *s1 = out[1];
+}
+
+static inline uint32_t mm3_fin(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6BU;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35U;
+  h ^= h >> 16;
+  return h;
+}
+
+// counter-based uniform, bit-exact with rng.np_uniform_parallel
+static inline float uniform_at(uint32_t i, uint32_t base) {
+  uint32_t h = mm3_fin(i * 0x9E3779B1U + base);
+  return (float)((double)(h >> 8) / 16777216.0);
+}
+
+struct CompressorCfg {
+  enum Type { NONE = 0, ONEBIT, TOPK, RANDOMK, DITHERING };
+  int type = NONE;
+  uint32_t n = 0;       // uncompressed f32 element count
+  uint32_t k = 0;       // topk/randomk
+  uint32_t s = 127;     // dithering levels
+  uint64_t seed = 0;
+  bool scaled = true;   // onebit
+  bool natural = false; // dithering partition
+  bool l2 = false;      // dithering normalize
+
+  uint32_t WireLen() const {
+    switch (type) {
+      case ONEBIT: return ((n + 31) / 32) * 4 + 4;
+      case TOPK: case RANDOMK: return k * 8;
+      case DITHERING: return n + 4;
+      default: return 0;
+    }
+  }
+
+  bool operator==(const CompressorCfg& o) const {
+    return type == o.type && n == o.n && k == o.k && s == o.s &&
+           seed == o.seed && scaled == o.scaled && natural == o.natural &&
+           l2 == o.l2;
+  }
+
+  // kwargs string: "compressor=onebit;n=100;scaling=1;..."
+  // (host.py kwargs_wire). Returns false on malformed/unknown input.
+  static bool Parse(const std::string& kw, CompressorCfg* out) {
+    CompressorCfg c;
+    std::string name;
+    size_t pos = 0;
+    while (pos < kw.size()) {
+      size_t semi = kw.find(';', pos);
+      if (semi == std::string::npos) semi = kw.size();
+      std::string pair = kw.substr(pos, semi - pos);
+      size_t eq = pair.find('=');
+      if (eq != std::string::npos) {
+        std::string key = pair.substr(0, eq);
+        std::string val = pair.substr(eq + 1);
+        if (key == "compressor") name = val;
+        else if (key == "n") c.n = (uint32_t)std::atoll(val.c_str());
+        else if (key == "k") c.k = (uint32_t)std::atoll(val.c_str());
+        else if (key == "s") c.s = (uint32_t)std::atoll(val.c_str());
+        else if (key == "seed") c.seed = (uint64_t)std::atoll(val.c_str());
+        else if (key == "scaling")
+          c.scaled = (val == "1" || val == "true");
+        else if (key == "partition_type") c.natural = (val == "natural");
+        else if (key == "normalize_type") c.l2 = (val == "l2");
+      }
+      pos = semi + 1;
+    }
+    if (name == "onebit") c.type = ONEBIT;
+    else if (name == "topk") c.type = TOPK;
+    else if (name == "randomk") c.type = RANDOMK;
+    else if (name == "dithering") c.type = DITHERING;
+    else return false;
+    if (c.n == 0) return false;
+    if ((c.type == TOPK || c.type == RANDOMK) &&
+        (c.k == 0 || c.k > c.n)) return false;
+    if (c.type == DITHERING && (c.s == 0 || c.s > 127)) return false;
+    *out = c;
+    return true;
+  }
+
+  // wire payload -> dense f32[n]; for randomk/topk also exposes the
+  // payload's indices (randomk recompression reuses the round's shared
+  // indices instead of re-deriving the xorshift stream)
+  bool Decompress(const uint8_t* in, uint32_t len, float* out,
+                  std::vector<int32_t>* idx_out) const {
+    if (len != WireLen()) return false;
+    switch (type) {
+      case ONEBIT: {
+        float scale;
+        std::memcpy(&scale, in + len - 4, 4);
+        const uint32_t* bits = (const uint32_t*)in;
+        for (uint32_t i = 0; i < n; ++i) {
+          uint32_t w = bits[i / 32];
+          out[i] = ((w >> (i % 32)) & 1) ? scale : -scale;
+        }
+        return true;
+      }
+      case TOPK: case RANDOMK: {
+        const int32_t* idx = (const int32_t*)in;
+        const float* val = (const float*)(in + 4 * k);
+        std::memset(out, 0, n * sizeof(float));
+        for (uint32_t i = 0; i < k; ++i) {
+          if (idx[i] < 0 || (uint32_t)idx[i] >= n) return false;
+          out[idx[i]] = val[i];  // duplicate idx: last wins (numpy parity)
+        }
+        if (idx_out) idx_out->assign(idx, idx + k);
+        return true;
+      }
+      case DITHERING: {
+        float norm;
+        std::memcpy(&norm, in + n, 4);
+        const int8_t* lv = (const int8_t*)in;
+        for (uint32_t i = 0; i < n; ++i) {
+          float l = (float)lv[i];
+          float a = std::fabs(l);
+          float mag;
+          if (!natural) {
+            mag = a / (float)s;
+          } else {
+            mag = (l == 0.0f) ? 0.0f : std::exp2f(-(a - 1.0f));
+          }
+          float sgn = (l > 0) - (l < 0);
+          out[i] = sgn * mag * norm;
+        }
+        return true;
+      }
+      default: return false;
+    }
+  }
+
+  // dense f32[n] -> wire payload. step = completed aggregation rounds
+  // before this one (matches the worker's per-key push counter);
+  // round_idx = the shared indices of this round's randomk payloads.
+  void Compress(const float* in, uint8_t* out, uint64_t step,
+                const std::vector<int32_t>& round_idx) const {
+    switch (type) {
+      case ONEBIT: {
+        float scale = 1.0f;
+        if (scaled) {
+          double acc = 0;
+          for (uint32_t i = 0; i < n; ++i) acc += std::fabs(in[i]);
+          scale = (float)(acc / n);
+        }
+        uint32_t words = (n + 31) / 32;
+        uint32_t* bits = (uint32_t*)out;
+        for (uint32_t w = 0; w < words; ++w) {
+          uint32_t word = 0;
+          for (uint32_t b = 0; b < 32; ++b) {
+            uint32_t i = w * 32 + b;
+            // zero-padding beyond n packs as +1 (host.py parity)
+            uint32_t bit = (i < n) ? (in[i] >= 0.0f) : 1u;
+            word |= bit << b;
+          }
+          bits[w] = word;
+        }
+        std::memcpy(out + words * 4, &scale, 4);
+        break;
+      }
+      case TOPK: {
+        // (|v| desc, idx asc) selection, emitted in ascending-index order
+        // (host.py HostTopk.select)
+        std::vector<int32_t> order(n);
+        for (uint32_t i = 0; i < n; ++i) order[i] = (int32_t)i;
+        auto cmp = [&](int32_t a, int32_t b) {
+          float fa = std::fabs(in[a]), fb = std::fabs(in[b]);
+          if (fa != fb) return fa > fb;
+          return a < b;
+        };
+        std::nth_element(order.begin(), order.begin() + k, order.end(), cmp);
+        std::sort(order.begin(), order.begin() + k);  // ascending index
+        int32_t* idx = (int32_t*)out;
+        float* val = (float*)(out + 4 * k);
+        for (uint32_t i = 0; i < k; ++i) {
+          idx[i] = order[i];
+          val[i] = in[order[i]];
+        }
+        break;
+      }
+      case RANDOMK: {
+        int32_t* idx = (int32_t*)out;
+        float* val = (float*)(out + 4 * k);
+        for (uint32_t i = 0; i < k; ++i) {
+          int32_t j = i < round_idx.size() ? round_idx[i] : 0;
+          idx[i] = j;
+          val[i] = in[j];
+        }
+        break;
+      }
+      case DITHERING: {
+        float norm = 0.0f;
+        if (!l2) {
+          for (uint32_t i = 0; i < n; ++i)
+            norm = std::max(norm, std::fabs(in[i]));
+        } else {
+          double acc = 0;
+          for (uint32_t i = 0; i < n; ++i)
+            acc += (double)in[i] * (double)in[i];
+          norm = (float)std::sqrt(acc);
+        }
+        norm = std::max(norm, 1e-30f);
+        uint64_t s0, s1;
+        seed_state64(seed, &s0, &s1);
+        uint32_t base = (uint32_t)(s0 & 0xFFFFFFFFULL) ^ (uint32_t)step;
+        int8_t* lv = (int8_t*)out;
+        for (uint32_t i = 0; i < n; ++i) {
+          float scl = std::fabs(in[i]) / norm;
+          float u = uniform_at(i, base);
+          float level;
+          if (!natural) {
+            float pos = scl * (float)s;
+            float fl = std::floor(pos);
+            level = fl + (u < (pos - fl) ? 1.0f : 0.0f);
+          } else {
+            float safe = std::max(scl, 1e-30f);
+            float j = std::floor(-std::log2f(safe));
+            j = std::min(std::max(j, 0.0f), 30.0f);
+            float low = std::exp2f(-j - 1.0f);
+            float high = std::exp2f(-j);
+            float frac = (scl - low) / (high - low);
+            float e = (u < frac) ? j : j + 1.0f;
+            level = (scl < std::exp2f(-31.0f)) ? 0.0f : e + 1.0f;
+            level = std::min(std::max(level, 0.0f), 126.0f);
+          }
+          float sgn = (in[i] > 0) - (in[i] < 0);
+          lv[i] = (int8_t)(sgn * level);
+        }
+        std::memcpy(out + n, &norm, 4);
+        break;
+      }
+      default: break;
+    }
+  }
+};
+
+// ------------------------------------------------------------------ //
 // server
 // ------------------------------------------------------------------ //
 
@@ -222,6 +492,7 @@ struct ParkedPull {
   std::shared_ptr<Conn> conn;
   uint32_t rid;
   uint16_t sender;
+  bool compressed = false;
 };
 
 struct KeyStore {
@@ -238,11 +509,17 @@ struct KeyStore {
   std::vector<uint64_t> worker_push_count;  // per worker
   std::vector<ParkedPull> parked_pulls;
   uint64_t total_pushes = 0;     // for priority scheduling
+  // compression mirror (server.cc:92-118): set by COMP_INIT
+  CompressorCfg comp;
+  std::vector<uint8_t> wire_merged;   // compressed aggregate for pulls
+  std::vector<int32_t> round_idx;     // randomk: this round's indices
+  std::vector<float> scratch;         // decompress buffer
 };
 
 struct EngineMsg {
   uint8_t op;
   uint64_t key;
+  uint32_t req = 0;              // RequestType from cmd
   uint32_t dtype;
   uint32_t rid;
   uint16_t sender;
@@ -378,6 +655,7 @@ class Server {
       m.conn = conn;
       uint32_t req, dtype;
       decode_cmd(h.cmd, &req, &dtype);
+      m.req = req;
       m.dtype = dtype;
       if (h.len) {
         m.payload.resize(h.len);
@@ -435,6 +713,7 @@ class Server {
         case INIT_PUSH: DoInit(m); break;
         case PUSH: DoPush(m); break;
         case PULL: DoPull(m); break;
+        case COMP_INIT: DoCompInit(m); break;
         default: break;
       }
     }
@@ -474,6 +753,12 @@ class Server {
         ks.worker_push_count.assign(num_workers_, 0);
         ks.recv_count = 0;
         ks.completed_rounds = 0;
+        // a resize invalidates any compressor (stale n): workers must
+        // re-send COMP_INIT for the new length
+        ks.comp = CompressorCfg();
+        ks.wire_merged.clear();
+        ks.round_idx.clear();
+        ks.scratch.clear();
       }
       ks.init_count++;
       ks.parked_inits.push_back({m.conn, m.rid, m.sender});
@@ -492,9 +777,110 @@ class Server {
     }
   }
 
+  void DoCompInit(EngineMsg& m) {
+    // per-key compressor from in-band kwargs (server.cc:228-257).
+    // Requires: sync mode, store already init-pushed dense f32, matching
+    // element count. Idempotent — every worker sends it.
+    KeyStore& ks = store_of(m.key);
+    bool ok = false;
+    {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      CompressorCfg cfg;
+      if (!async_ &&
+          CompressorCfg::Parse(
+              std::string((const char*)m.payload.data(), m.payload.size()),
+              &cfg) &&
+          ks.len == cfg.n * 4 && ks.dtype == F32) {
+        ok = true;
+        // idempotent re-registration (every worker sends it) MUST be a
+        // no-op — a reset here can race a peer's in-flight round and
+        // clear the captured randomk indices mid-aggregation
+        if (!(ks.comp == cfg)) {
+          ks.comp = cfg;
+          ks.wire_merged.assign(cfg.WireLen(), 0);
+          ks.scratch.resize(cfg.n);
+          ks.round_idx.clear();
+          // publish a compressed view of the current aggregate so a pull
+          // that precedes the first compressed round is answerable
+          ks.comp.Compress((const float*)ks.merged.data(),
+                           ks.wire_merged.data(), ks.completed_rounds,
+                           ks.round_idx);
+        }
+      }
+    }
+    MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key, 0, 0};
+    m.conn->send_msg(r, nullptr);
+  }
+
+  void DoPushCompressed(EngineMsg& m, KeyStore& ks) {
+    std::vector<ParkedPull> flush;
+    {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.payload.size() != ks.comp.WireLen() ||
+          !ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
+                              ks.scratch.data(),
+                              ks.recv_count == 0 ? &ks.round_idx : nullptr)) {
+        std::fprintf(stderr,
+                     "[bps-server] compressed push rejected key=%llu "
+                     "len=%zu want=%u\n",
+                     (unsigned long long)m.key, m.payload.size(),
+                     ks.comp.WireLen());
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
+      ks.total_pushes++;
+      if (m.sender < ks.worker_push_count.size())
+        ks.worker_push_count[m.sender]++;
+      float* accum = (float*)ks.accum.data();
+      if (ks.recv_count == 0) {
+        std::memcpy(accum, ks.scratch.data(),
+                    ks.comp.n * sizeof(float));
+      } else {
+        for (uint32_t i = 0; i < ks.comp.n; ++i)
+          accum[i] += ks.scratch[i];
+      }
+      ks.recv_count++;
+      if ((int)ks.recv_count >= num_workers_) {
+        // ALL_RECV: recompress the dense aggregate (server.cc:345-375 with
+        // the compression hook of server.cc:92-118); keep the dense view
+        // in `merged` too so diagnostics stay meaningful
+        std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
+        ks.comp.Compress(accum, ks.wire_merged.data(),
+                         ks.completed_rounds, ks.round_idx);
+        ks.recv_count = 0;
+        ks.completed_rounds++;
+        flush.swap(ks.parked_pulls);
+      }
+    }
+    MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+    m.conn->send_msg(r, nullptr);
+    for (auto& p : flush) AnswerPull(ks, p);
+  }
+
   void DoPush(EngineMsg& m) {
     std::vector<ParkedPull> flush;
     KeyStore& ks = store_of(m.key);
+    {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      bool has_comp = ks.comp.type != CompressorCfg::NONE;
+      bool is_comp = m.req == kCompressedPushPull;
+      if (has_comp != is_comp) {
+        // mixing dense and compressed pushes on one key would corrupt the
+        // accumulator (dense bytes vs decompressed f32 share it)
+        std::fprintf(stderr,
+                     "[bps-server] push mode mismatch key=%llu comp=%d "
+                     "req=%u\n",
+                     (unsigned long long)m.key, (int)has_comp, m.req);
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
+    }
+    if (m.req == kCompressedPushPull) {
+      DoPushCompressed(m, ks);
+      return;
+    }
     {
       std::lock_guard<std::mutex> lk(ks.mu);
       if (ks.len == 0 || m.payload.size() != ks.len) {
@@ -550,14 +936,15 @@ class Server {
   }
 
   void AnswerPull(KeyStore& ks, const ParkedPull& p) {
-    MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0, ks.len};
     // merged is stable between rounds; the copy races only with the next
     // round's ALL_RECV memcpy, which the key mutex serializes
     std::vector<uint8_t> snapshot;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
-      snapshot = ks.merged;
+      snapshot = p.compressed ? ks.wire_merged : ks.merged;
     }
+    MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
+                (uint32_t)snapshot.size()};
     p.conn->send_msg(r, snapshot.data());
   }
 
@@ -565,12 +952,14 @@ class Server {
     KeyStore& ks = store_of(m.key);
     bool ready;
     bool uninit = false;
+    bool comp = m.req == kCompressedPushPull;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
-      uninit = ks.len == 0;
+      uninit = ks.len == 0 ||
+               (comp && ks.comp.type == CompressorCfg::NONE);
       ready = !uninit && PullReady(ks, m.sender);
       if (!uninit && !ready) {
-        ks.parked_pulls.push_back({m.conn, m.rid, m.sender});
+        ks.parked_pulls.push_back({m.conn, m.rid, m.sender, comp});
       }
     }
     if (uninit) {
@@ -582,7 +971,7 @@ class Server {
       m.conn->send_msg(r, nullptr);
       return;
     }
-    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender});
+    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender, comp});
   }
 
   int port_;
@@ -761,6 +1150,13 @@ class Client {
     return r == ~0u ? -1 : 0;
   }
 
+  int CompInit(int server, uint64_t key, const char* kwargs) {
+    uint32_t r = conns_[server]->Request(COMP_INIT, key, 0, worker_id_,
+                                         kwargs, (uint32_t)strlen(kwargs),
+                                         nullptr, 0);
+    return r == ~0u ? -1 : 0;
+  }
+
   int Push(int server, uint64_t key, const void* data, uint32_t len,
            uint32_t cmd) {
     uint32_t r = conns_[server]->Request(PUSH, key, cmd, worker_id_, data,
@@ -841,6 +1237,11 @@ void* bps_client_create(const char* servers_csv, int worker_id) {
 int bps_client_init_key(void* c, int server, uint64_t key, const void* data,
                         uint32_t len, uint32_t cmd) {
   return ((bps::Client*)c)->InitKey(server, key, data, len, cmd);
+}
+
+int bps_client_comp_init(void* c, int server, uint64_t key,
+                         const char* kwargs) {
+  return ((bps::Client*)c)->CompInit(server, key, kwargs);
 }
 
 int bps_client_push(void* c, int server, uint64_t key, const void* data,

@@ -1,0 +1,293 @@
+"""Host-side (numpy) wire codecs for the compressed DCN PS path.
+
+The in-jit codecs (codecs.py) keep payloads as arrays for collectives; the
+PS path needs flat byte strings on the wire and a server that can
+decompress / sum / recompress (reference: server-side compressor mirror,
+server.cc:92-118,228-257). This module defines THE wire format — shared by
+three parties (signs/levels/indices bit-for-bit; reduction-derived scalars
+like the onebit scale and the dithering l2 norm may differ by an ulp across
+implementations, since summation order differs — tests compare those with
+rtol=1e-6):
+
+- this numpy implementation (worker host path + golden model for tests),
+- the portable jnp codecs in codecs.py (on-device compress; the Pallas
+  sublane-folded onebit layout is NOT wire format — PS codecs always use
+  the portable layout),
+- the C++ server (native/ps.cc CompressorCfg::{Compress,Decompress}).
+
+Wire layouts (little-endian):
+- onebit:    uint32 bits[ceil(n/32)], then f32 scale
+- topk:      int32 idx[k], then f32 val[k]
+- randomk:   int32 idx[k], then f32 val[k] (idx from shared xorshift128+)
+- dithering: int8 levels[n], then f32 norm
+
+Error feedback (vanilla) and momentum (nesterov) run worker-side only, as
+in the reference (the server skips momentum, compressor_registry.cc:39-56).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from .codecs import resolve_k
+from .rng import np_uniform, np_uniform_parallel
+
+
+class HostCodec:
+    """Base: compress(x, step) -> bytes; decompress(buf) -> f32[n]."""
+
+    n: int
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, buf: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def wire_bytes(self) -> int:
+        raise NotImplementedError
+
+    def kwargs_wire(self) -> str:
+        """Serialized config for the server (parsed by ps.cc); mirrors the
+        reference's in-band kwargs push (operations.cc:396-408)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class HostOnebit(HostCodec):
+    n: int
+    scaled: bool = True
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        scale = np.float32(np.mean(np.abs(x))) if self.scaled \
+            else np.float32(1.0)
+        pad = (-self.n) % 32
+        signs = np.empty(self.n + pad, np.uint32)
+        signs[: self.n] = (x >= 0)
+        signs[self.n:] = 1  # zero-pad compresses as +1 (codecs.py parity)
+        words = signs.reshape(-1, 32)
+        weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
+        bits = (words * weights[None, :]).sum(axis=1, dtype=np.uint32)
+        return bits.tobytes() + scale.tobytes()
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = np.frombuffer(buf, np.uint8)
+        bits = raw[:-4].view(np.uint32)
+        scale = raw[-4:].view(np.float32)[0]
+        weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
+        signs = (bits[:, None] & weights[None, :]) > 0
+        flat = np.where(signs, np.float32(1.0), np.float32(-1.0))
+        return (flat.reshape(-1)[: self.n] * scale).astype(np.float32)
+
+    def wire_bytes(self) -> int:
+        return ((self.n + 31) // 32) * 4 + 4
+
+    def kwargs_wire(self) -> str:
+        return (f"compressor=onebit;n={self.n};"
+                f"scaling={1 if self.scaled else 0}")
+
+
+@dataclasses.dataclass
+class HostTopk(HostCodec):
+    n: int
+    k: int
+
+    @staticmethod
+    def select(x: np.ndarray, k: int) -> np.ndarray:
+        """Top-k by (|x| desc, index asc) — the comparator the C++ server
+        uses, deterministic under ties."""
+        order = np.lexsort((np.arange(x.shape[0]), -np.abs(x)))
+        return np.sort(order[:k]).astype(np.int32)  # ascending index order
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        idx = self.select(x, self.k)
+        return idx.tobytes() + x[idx].astype(np.float32).tobytes()
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = np.frombuffer(buf, np.uint8)
+        idx = raw[: 4 * self.k].view(np.int32)
+        val = raw[4 * self.k:].view(np.float32)
+        out = np.zeros(self.n, np.float32)
+        out[idx] = val
+        return out
+
+    def wire_bytes(self) -> int:
+        return self.k * 8
+
+    def kwargs_wire(self) -> str:
+        return f"compressor=topk;n={self.n};k={self.k}"
+
+
+@dataclasses.dataclass
+class HostRandomk(HostCodec):
+    n: int
+    k: int
+    seed: int = 0
+
+    def indices(self, step: int) -> np.ndarray:
+        u = np_uniform(self.seed, self.k, mix=step)
+        return np.minimum((u * self.n).astype(np.int32), self.n - 1)
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        idx = self.indices(step)
+        return idx.tobytes() + x[idx].astype(np.float32).tobytes()
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = np.frombuffer(buf, np.uint8)
+        idx = raw[: 4 * self.k].view(np.int32)
+        val = raw[4 * self.k:].view(np.float32)
+        out = np.zeros(self.n, np.float32)
+        out[idx] = val
+        return out
+
+    def wire_bytes(self) -> int:
+        return self.k * 8
+
+    def kwargs_wire(self) -> str:
+        return f"compressor=randomk;n={self.n};k={self.k};seed={self.seed}"
+
+
+@dataclasses.dataclass
+class HostDithering(HostCodec):
+    n: int
+    s: int = 127
+    partition: str = "linear"
+    normalize: str = "max"
+    seed: int = 0
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        absx = np.abs(x)
+        if self.normalize == "max":
+            norm = absx.max(initial=np.float32(0))
+        else:
+            norm = np.float32(np.linalg.norm(x))
+        norm = np.float32(max(norm, 1e-30))
+        scaled = (absx / norm).astype(np.float32)
+        u = np_uniform_parallel(self.seed, self.n, mix=step)
+        if self.partition == "linear":
+            pos = scaled * np.float32(self.s)
+            floor = np.floor(pos)
+            level = floor + (u < (pos - floor))
+        else:
+            safe = np.maximum(scaled, np.float32(1e-30))
+            j = np.clip(np.floor(-np.log2(safe)), 0, 30).astype(np.float32)
+            low = np.exp2(-j - 1).astype(np.float32)
+            high = np.exp2(-j).astype(np.float32)
+            frac = (scaled - low) / (high - low)
+            exp = np.where(u < frac, j, j + 1)
+            level = np.where(scaled < np.exp2(np.float32(-31.0)),
+                             np.float32(0.0), exp + 1.0)
+            level = np.clip(level, 0, 126)
+        levels = (np.sign(x) * level).astype(np.int8)
+        return levels.tobytes() + np.float32(norm).tobytes()
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = np.frombuffer(buf, np.uint8)
+        lv = raw[: self.n].view(np.int8).astype(np.float32)
+        norm = raw[self.n: self.n + 4].view(np.float32)[0]
+        if self.partition == "linear":
+            mag = np.abs(lv) / np.float32(self.s)
+        else:
+            mag = np.where(lv == 0, np.float32(0.0),
+                           np.exp2(-(np.abs(lv) - 1.0)).astype(np.float32))
+        return (np.sign(lv) * mag * norm).astype(np.float32)
+
+    def wire_bytes(self) -> int:
+        return self.n + 4
+
+    def kwargs_wire(self) -> str:
+        return (f"compressor=dithering;n={self.n};s={self.s};"
+                f"partition_type={self.partition};"
+                f"normalize_type={self.normalize};seed={self.seed}")
+
+
+class HostErrorFeedback:
+    """Vanilla EF wrapper (error_feedback.cc:22-43): corrected = grad +
+    error; payload = compress(corrected); error = corrected -
+    decompress(payload). State persists across steps per tensor/partition.
+    """
+
+    def __init__(self, codec: HostCodec):
+        self.codec = codec
+        self.error = np.zeros(codec.n, np.float32)
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        corrected = x.astype(np.float32) + self.error
+        buf = self.codec.compress(corrected, step)
+        self.error = corrected - self.codec.decompress(
+            np.frombuffer(buf, np.uint8))
+        return buf
+
+    def decompress(self, buf) -> np.ndarray:
+        return self.codec.decompress(buf)
+
+    def wire_bytes(self) -> int:
+        return self.codec.wire_bytes()
+
+    def kwargs_wire(self) -> str:
+        return self.codec.kwargs_wire()
+
+
+class HostNesterovMomentum:
+    """Worker-side nesterov momentum pre-pass (momentum.h:25-45): m = mu*m
+    + g; compress(g + mu*m). Must replace framework momentum."""
+
+    def __init__(self, inner, mu: float = 0.9):
+        self.inner = inner
+        self.mu = np.float32(mu)
+        self.m = np.zeros(inner.codec.n if isinstance(inner, HostErrorFeedback)
+                          else inner.n, np.float32)
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        self.m = self.mu * self.m + x.astype(np.float32)
+        return self.inner.compress(x + self.mu * self.m, step)
+
+    def decompress(self, buf) -> np.ndarray:
+        return self.inner.decompress(buf)
+
+    def wire_bytes(self) -> int:
+        return self.inner.wire_bytes()
+
+    def kwargs_wire(self) -> str:
+        return self.inner.kwargs_wire()
+
+
+def make_host_codec(kwargs: Dict[str, str], n: int):
+    """Registry: kwargs dict -> (momentum ->) (EF ->) codec stack, same
+    lookup order as the reference (compressor_registry.cc:39-56) and same
+    parameter names as ops.compression.make_compressor."""
+    name = kwargs.get("compressor")
+    if name == "onebit":
+        scaled = str(kwargs.get("scaling", "true")).lower() in (
+            "1", "true", "yes")
+        codec: HostCodec = HostOnebit(n=n, scaled=scaled)
+    elif name == "topk":
+        codec = HostTopk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n))
+    elif name == "randomk":
+        codec = HostRandomk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n),
+                            seed=int(kwargs.get("seed", 0)))
+    elif name == "dithering":
+        codec = HostDithering(
+            n=n, s=int(kwargs.get("s", 127)),
+            partition=kwargs.get("partition_type", "linear"),
+            normalize=kwargs.get("normalize_type", "max"),
+            seed=int(kwargs.get("seed", 0)))
+    else:
+        raise ValueError(f"unknown compressor {name!r}")
+    stack = codec
+    if kwargs.get("ef") == "vanilla":
+        stack = HostErrorFeedback(stack)
+    if kwargs.get("momentum") == "nesterov":
+        if not isinstance(stack, HostErrorFeedback):
+            raise ValueError("momentum requires ef=vanilla (reference "
+                             "stacking order, compressor.h:28-52)")
+        stack = HostNesterovMomentum(stack,
+                                     mu=float(kwargs.get("momentum_mu", 0.9)))
+    return stack

@@ -38,6 +38,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.bps_client_push.argtypes = lib.bps_client_init_key.argtypes
     lib.bps_client_pull.restype = ctypes.c_int
     lib.bps_client_pull.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_comp_init.restype = ctypes.c_int
+    lib.bps_client_comp_init.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
     lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
@@ -130,6 +133,16 @@ class PSClient:
             self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
         if rc < 0:
             raise RuntimeError(f"pull failed key={key}")
+
+    def comp_init(self, server: int, key: int, kwargs_wire: str) -> None:
+        """Install a server-side compressor for ``key`` (the reference's
+        in-band kCompressedPushPull kwargs push, operations.cc:396-408)."""
+        rc = self._lib.bps_client_comp_init(
+            self._handle, server, key, kwargs_wire.encode())
+        if rc != 0:
+            raise RuntimeError(
+                f"comp_init failed key={key} kwargs={kwargs_wire!r} "
+                f"(is the store init-pushed as dense f32, sync mode?)")
 
     def barrier(self) -> None:
         if self._lib.bps_client_barrier(self._handle) != 0:

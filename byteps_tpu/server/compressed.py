@@ -1,0 +1,155 @@
+"""Compressed push_pull over the DCN PS.
+
+Per-tensor worker pipeline mirroring the reference's COMPRESS -> PUSH ->
+server decompress/sum/recompress -> PULL -> DECOMPRESS dataflow
+(core_loops.cc:498-648 + server.cc:92-118):
+
+- the tensor is partitioned exactly like the dense path (every <=N-byte
+  partition gets its own compressor instance, as the reference instantiates
+  per-partition compressors, operations.cc:283-414);
+- each partition's codec stack (momentum -> EF -> codec, host.py) runs
+  worker-side; the server mirrors only the base codec;
+- kwargs travel in-band per key (PSClient.comp_init);
+- the per-key step counter feeds randomk/dithering seeding and matches the
+  server's completed_rounds in sync mode.
+
+``min_compress_bytes``: partitions smaller than this skip compression and
+use the dense path (reference: BYTEPS_MIN_COMPRESS_BYTES,
+operations.cc:361-364).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.types import (
+    DataType, RequestType, TensorContext, get_command_type,
+)
+from ..ops.compression.host import make_host_codec
+from ..utils.logging import log
+
+CMD_COMP_F32 = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                                DataType.FLOAT32)
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+
+
+class CompressedTensor:
+    """Compressed PS round-trips for one named f32 tensor."""
+
+    def __init__(self, client, ctx: TensorContext, kwargs: Dict[str, str],
+                 num_workers: int, min_compress_bytes: int = 0):
+        if ctx.dtype != DataType.FLOAT32:
+            raise ValueError("compressed push_pull requires f32 gradients "
+                             "(the codecs are f32 transforms)")
+        self.client = client
+        self.ctx = ctx
+        self.num_workers = num_workers
+        self.step = 0
+        self._lock = threading.Lock()
+        # per-partition codec stacks; None = below min_compress_bytes,
+        # dense path
+        self.stacks = []
+        for p in ctx.partitions:
+            n = p.length // 4
+            if p.length < max(min_compress_bytes, 8):
+                self.stacks.append(None)
+            else:
+                self.stacks.append(make_host_codec(kwargs, n))
+        self._installed = False
+
+    def _install(self, flat: np.ndarray) -> None:
+        """Dense init-push (allocates the store, init barrier) then the
+        per-key kwargs push."""
+        self.client.init_tensor(self.ctx, np.zeros_like(flat))
+        for p, stack in zip(self.ctx.partitions, self.stacks):
+            if stack is not None:
+                self.client.comp_init(p.server, p.key, stack.kwargs_wire())
+        self._installed = True
+
+    def push_pull(self, flat: np.ndarray, average: bool = True) -> np.ndarray:
+        """One compressed aggregation round; returns the decompressed
+        cross-worker sum (mean when ``average``)."""
+        flat = np.ascontiguousarray(flat, np.float32)
+        if flat.nbytes != self.ctx.partitions[-1].offset + \
+                self.ctx.partitions[-1].length:
+            raise ValueError("tensor size changed; re-create the "
+                             "CompressedTensor (stale partitioning)")
+        with self._lock:
+            if not self._installed:
+                self._install(flat)
+            step = self.step
+            self.step += 1
+        out = np.empty_like(flat)
+        view = flat.view(np.uint8)
+        out_view = out.view(np.uint8)
+
+        def one(p, stack):
+            lo, hi = p.offset, p.offset + p.length
+            if stack is None:
+                buf = np.ascontiguousarray(view[lo:hi])
+                self.client.zpush(p.server, p.key, buf, CMD_F32)
+                dst = np.empty(p.length, np.uint8)
+                self.client.zpull(p.server, p.key, dst, CMD_F32)
+                res = dst.view(np.float32)
+                if average and self.num_workers > 1:
+                    res = res / self.num_workers
+                out_view[lo:hi] = res.view(np.uint8)
+                return
+            part = view[lo:hi].view(np.float32)
+            wire = np.frombuffer(stack.compress(part, step), np.uint8)
+            self.client.zpush(p.server, p.key, wire, CMD_COMP_F32)
+            reply = np.empty(stack.wire_bytes(), np.uint8)
+            self.client.zpull(p.server, p.key, reply, CMD_COMP_F32)
+            res = stack.decompress(reply)
+            if average and self.num_workers > 1:
+                res = res / self.num_workers
+            out_view[lo:hi] = res.view(np.uint8)
+
+        futures = [
+            self.client._pool.submit(one, p, s)
+            for p, s in zip(self.ctx.partitions, self.stacks)
+        ]
+        for f in futures:
+            f.result()
+        return out
+
+    def wire_bytes(self) -> int:
+        return sum(s.wire_bytes() if s is not None else p.length
+                   for p, s in zip(self.ctx.partitions, self.stacks))
+
+
+class CompressedRegistry:
+    """name -> CompressedTensor cache for a training loop (one per named
+    gradient, holding EF/momentum state across steps)."""
+
+    def __init__(self, client, num_workers: int,
+                 kwargs: Dict[str, str], min_compress_bytes: int = 0):
+        self.client = client
+        self.num_workers = num_workers
+        self.kwargs = dict(kwargs)
+        self.min_compress_bytes = min_compress_bytes
+        self._tensors: Dict[str, CompressedTensor] = {}
+        self._lock = threading.Lock()
+
+    def get(self, state, name: str, flat: np.ndarray) -> CompressedTensor:
+        from .client import get_or_init_ctx
+        with self._lock:
+            ct = self._tensors.get(name)
+            if ct is None or ct.ctx.partitions[-1].offset + \
+                    ct.ctx.partitions[-1].length != flat.nbytes:
+                ctx = get_or_init_ctx(state, name, flat)
+                ct = CompressedTensor(self.client, ctx, self.kwargs,
+                                      self.num_workers,
+                                      self.min_compress_bytes)
+                self._tensors[name] = ct
+            return ct
+
+    def push_pull(self, state, name: str, flat: np.ndarray,
+                  average: bool = True) -> np.ndarray:
+        ct = self.get(state, name, flat)
+        out = ct.push_pull(flat, average)
+        state.telemetry.record(ct.wire_bytes() * 2)
+        return out
