@@ -1,0 +1,187 @@
+"""Training-loop callbacks — Keras-adapter parity for the JAX loop.
+
+The reference ships Keras callbacks (byteps/_keras/callbacks.py:23-196):
+broadcast-on-start, cross-worker metric averaging, LR schedules and warmup.
+This module provides the same four behaviors as framework-neutral hooks a
+training loop drives; ``byteps_tpu.torch`` users can drive the same
+objects (they only touch the comm layer through push_pull/broadcast).
+
+LR control follows the optax idiom: wrap your optimizer with
+``optax.inject_hyperparams`` so the learning rate is a leaf in the
+optimizer state, and the LR callbacks rewrite that leaf
+(``apply_lr(opt_state)``) — the functional equivalent of the reference's
+``K.set_value(self.model.optimizer.lr, ...)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Callback", "CallbackList",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+]
+
+
+class Callback:
+    """Hook points mirroring the Keras surface the reference extends."""
+
+    def on_train_begin(self, state: Dict[str, Any]) -> None: ...
+
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None: ...
+
+    def on_batch_begin(self, batch: int, state: Dict[str, Any]) -> None: ...
+
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None: ...
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None: ...
+
+    # LR callbacks implement this; the loop applies it to the optimizer
+    # state after the hooks ran
+    def lr_scale(self) -> Optional[float]:
+        return None
+
+
+class CallbackList:
+    def __init__(self, callbacks: Sequence[Callback]):
+        self.callbacks = list(callbacks)
+
+    def _fire(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args)
+
+    def on_train_begin(self, state): self._fire("on_train_begin", state)
+
+    def on_epoch_begin(self, e, state): self._fire("on_epoch_begin", e, state)
+
+    def on_batch_begin(self, b, state): self._fire("on_batch_begin", b, state)
+
+    def on_batch_end(self, b, state): self._fire("on_batch_end", b, state)
+
+    def on_epoch_end(self, e, state): self._fire("on_epoch_end", e, state)
+
+    def lr_scale(self) -> float:
+        scale = 1.0
+        for cb in self.callbacks:
+            s = cb.lr_scale()
+            if s is not None:
+                scale *= s
+        return scale
+
+    def apply_lr(self, opt_state, base_lr: float):
+        """Rewrite the ``learning_rate`` hyperparam leaf (requires the
+        optimizer be wrapped in optax.inject_hyperparams)."""
+        if not hasattr(opt_state, "hyperparams"):
+            raise ValueError(
+                "apply_lr requires optax.inject_hyperparams(...) so the "
+                "learning rate is part of the optimizer state")
+        opt_state.hyperparams["learning_rate"] = base_lr * self.lr_scale()
+        return opt_state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial parameters from the root worker before training
+    (reference: _keras/callbacks.py:23-50, BroadcastGlobalVariablesHook).
+    The loop must put its params pytree in ``state['params']``."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, state: Dict[str, Any]) -> None:
+        if self._done:
+            return
+        from .jax import broadcast_parameters
+        state["params"] = broadcast_parameters(state["params"],
+                                               root_rank=self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics across workers after each epoch (reference:
+    _keras/callbacks.py:54-86). Metrics live in ``state['metrics']`` as a
+    name -> float dict."""
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None:
+        import byteps_tpu as bps
+
+        metrics = state.get("metrics")
+        if not metrics:
+            return
+        for name in sorted(metrics):
+            v = np.asarray([float(metrics[name])], np.float32)
+            out = bps.push_pull(v, name=f"metric/{name}", average=True)
+            metrics[name] = float(np.asarray(out)[0])
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the LR by ``multiplier`` (a float or an epoch->float
+    callable) within [start_epoch, end_epoch) (reference:
+    _keras/callbacks.py:90-147). ``staircase`` quantizes a callable
+    multiplier to integer epochs."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._multiplier = (multiplier if callable(multiplier)
+                            else (lambda e: multiplier))
+        self._epoch = 0.0
+        self._scale = 1.0
+
+    def _in_window(self) -> bool:
+        if self._epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or self._epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None:
+        self._epoch = float(epoch)
+        if self._in_window():
+            e = math.floor(self._epoch) if self.staircase else self._epoch
+            self._scale = float(self._multiplier(e))
+
+    def on_batch_begin(self, batch: int, state: Dict[str, Any]) -> None:
+        if self.staircase or not self.steps_per_epoch:
+            return
+        self._epoch = math.floor(self._epoch) + batch / self.steps_per_epoch
+        if self._in_window():
+            self._scale = float(self._multiplier(self._epoch))
+
+    def lr_scale(self) -> Optional[float]:
+        return self._scale if self._in_window() else None
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup of the LR multiplier from 1/size to 1.0 over
+    ``warmup_epochs`` (reference: _keras/callbacks.py:150-196 — 'Accurate,
+    Large Minibatch SGD' gradual warmup; the base lr is assumed already
+    scaled by size)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: bool = False,
+                 size: Optional[int] = None):
+        import byteps_tpu as bps
+
+        n = size if size is not None else bps.size()
+        self.verbose = verbose
+
+        def multiplier(epoch: float) -> float:
+            progress = min(epoch / warmup_epochs, 1.0) if warmup_epochs \
+                else 1.0
+            return 1.0 / n + (1.0 - 1.0 / n) * progress
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False, steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None:
+        if self.verbose and epoch + 1 == self.end_epoch:
+            from .utils.logging import log
+            log.info("warmup complete at epoch %d", epoch)

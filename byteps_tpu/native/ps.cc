@@ -453,6 +453,9 @@ struct CompressorCfg {
             float pos = scl * (float)s;
             float fl = std::floor(pos);
             level = fl + (u < (pos - fl) ? 1.0f : 0.0f);
+            // l2 norm can round below max|x| -> scl > 1; unclamped
+            // level s+1 would wrap the int8 cast at s=127
+            level = std::min(level, (float)s);
           } else {
             float safe = std::max(scl, 1e-30f);
             float j = std::floor(-std::log2f(safe));

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .rng import jnp_uniform, jnp_uniform_parallel
+from .rng import jnp_uniform_parallel
 
 
 def _pad_to(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
@@ -130,14 +130,18 @@ class TopkCodec(Codec):
 
 @dataclasses.dataclass(frozen=True)
 class RandomkCodec(Codec):
-    """k pseudo-random (index, value) pairs; xorshift128+ seeded by
-    (seed, step) so every party draws the same indices (randomk.cc:24-60)."""
+    """k pseudo-random (index, value) pairs seeded by (seed, step) so every
+    party draws the same indices (randomk.cc:24-60). Uses the counter-based
+    generator (murmur3 over (i, seed, step), rng.py): O(1) depth instead of
+    the O(k) sequential xorshift scan — at the reference's default k=1% of
+    a 4MB partition that scan would dwarf the compress itself — and the PS
+    server reuses the in-band indices, so only np/jnp parity is needed."""
 
     k: int = 1
     seed: int = 0
 
     def _indices(self, step) -> jnp.ndarray:
-        u = jnp_uniform(self.seed, self.k, mix=step)
+        u = jnp_uniform_parallel(self.seed, self.k, mix=step)
         return jnp.minimum((u * self.size).astype(jnp.int32), self.size - 1)
 
     def compress(self, x: jnp.ndarray, step: int = 0) -> Dict[str, Any]:
@@ -193,6 +197,9 @@ class DitheringCodec(Codec):
             floor = jnp.floor(pos)
             frac = pos - floor
             level = floor + (u < frac)                 # stochastic round
+            # l2 norm can round below max|x|, making scaled epsilon > 1;
+            # an unclamped level s+1 would wrap the int8 cast at s=127
+            level = jnp.minimum(level, float(self.s))
         else:  # natural: levels at 2^-j — quantize onto powers of two
             # j = number of halvings from full scale; level value = 2^-j.
             # Stored level is j+1 (so stored 0 unambiguously means zero).

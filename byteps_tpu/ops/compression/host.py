@@ -33,7 +33,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .codecs import resolve_k
-from .rng import np_uniform, np_uniform_parallel
+from .rng import np_uniform_parallel
 
 
 class HostCodec:
@@ -130,7 +130,9 @@ class HostRandomk(HostCodec):
     seed: int = 0
 
     def indices(self, step: int) -> np.ndarray:
-        u = np_uniform(self.seed, self.k, mix=step)
+        # counter-based generator (parity with RandomkCodec._indices):
+        # vectorized, no per-draw Python loop on the per-step hot path
+        u = np_uniform_parallel(self.seed, self.k, mix=step)
         return np.minimum((u * self.n).astype(np.int32), self.n - 1)
 
     def compress(self, x: np.ndarray, step: int = 0) -> bytes:
@@ -175,6 +177,9 @@ class HostDithering(HostCodec):
             pos = scaled * np.float32(self.s)
             floor = np.floor(pos)
             level = floor + (u < (pos - floor))
+            # l2 norm can round below max|x| -> scaled > 1 -> level s+1
+            # would wrap the int8 cast at s=127
+            level = np.minimum(level, np.float32(self.s))
         else:
             safe = np.maximum(scaled, np.float32(1e-30))
             j = np.clip(np.floor(-np.log2(safe)), 0, 30).astype(np.float32)

@@ -29,7 +29,6 @@ from ..core.types import (
     DataType, RequestType, TensorContext, get_command_type,
 )
 from ..ops.compression.host import make_host_codec
-from ..utils.logging import log
 
 CMD_COMP_F32 = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
                                 DataType.FLOAT32)

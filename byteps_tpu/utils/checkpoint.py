@@ -1,0 +1,137 @@
+"""Checkpoint / restore — delegated to orbax, consistency by broadcast.
+
+The reference has no checkpoint subsystem of its own: model/optimizer state
+lives on workers and cross-worker consistency is re-established by
+broadcast (SURVEY.md §5.4; reference: torch/__init__.py:261-459,
+keras/__init__.py:96-123). We keep exactly that split: orbax persists the
+pytrees, and ``restore(..., broadcast=True)`` broadcasts the restored
+state from the root worker so every worker resumes bit-identical — the
+reference's ``load_model`` + ``broadcast_parameters`` flow.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["save", "restore", "latest_step", "Checkpointer"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def _step_dir(path: str, step: int) -> str:
+    return os.path.join(path, f"step_{step:09d}")
+
+
+def save(path: str, state: Dict[str, Any], step: int,
+         keep: Optional[int] = None) -> str:
+    """Save a state pytree (e.g. {'params': ..., 'opt_state': ...}) for
+    ``step``. Only the root worker writes (workers hold replicated state —
+    the reference's broadcast model makes rank 0 authoritative); others
+    no-op. ``keep``: prune to the newest N checkpoints."""
+    import byteps_tpu as bps
+
+    if bps.rank() != 0:
+        return _step_dir(path, step)
+    os.makedirs(path, exist_ok=True)
+    target = _step_dir(path, step)
+    _checkpointer().save(target, jax.tree.map(np.asarray, state),
+                         force=True)
+    if keep:
+        steps = sorted(all_steps(path))
+        for s in steps[:-keep]:
+            import shutil
+            shutil.rmtree(_step_dir(path, s), ignore_errors=True)
+    return target
+
+
+def all_steps(path: str) -> list:
+    if not os.path.isdir(path):
+        return []
+    out = []
+    for name in os.listdir(path):
+        if name.startswith("step_"):
+            try:
+                out.append(int(name[5:]))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def latest_step(path: str) -> Optional[int]:
+    steps = all_steps(path)
+    return steps[-1] if steps else None
+
+
+def restore(path: str, step: Optional[int] = None,
+            example: Optional[Dict[str, Any]] = None,
+            broadcast: bool = True) -> Dict[str, Any]:
+    """Restore the checkpoint at ``step`` (default: latest). With
+    ``broadcast`` (and a multi-worker PS), the restored tree is broadcast
+    from worker 0 so a stale or missing local checkpoint on other workers
+    cannot fork the training state.
+
+    save() writes on rank 0 only, so on a non-shared filesystem the other
+    workers have NO local checkpoint: they must pass ``example`` (for the
+    tree structure/shapes) and receive rank 0's state entirely through the
+    broadcast (their zero contribution is summed away)."""
+    import byteps_tpu as bps
+
+    if step is None:
+        step = latest_step(path)
+    if step is None:
+        if broadcast and example is not None and bps.rank() != 0:
+            state = jax.tree.map(lambda leaf: np.zeros_like(np.asarray(leaf)),
+                                 example)
+        else:
+            raise FileNotFoundError(
+                f"no checkpoints under {path}"
+                + ("" if example is not None else
+                   " (non-root workers need example= to join the restore "
+                   "broadcast without a local checkpoint)"))
+    else:
+        state = _checkpointer().restore(_step_dir(path, step))
+        if example is not None:
+            # restored as plain nested dicts -> reshape onto the example
+            # treedef
+            leaves = jax.tree.leaves(state)
+            treedef = jax.tree.structure(example)
+            state = jax.tree.unflatten(treedef, leaves)
+    if broadcast:
+        from ..jax import broadcast_parameters
+        state = broadcast_parameters(state, root_rank=0)
+    return state
+
+
+class Checkpointer:
+    """Convenience wrapper: periodic save + latest-restore.
+
+    >>> ckpt = Checkpointer("/tmp/run1", every_steps=1000, keep=3)
+    >>> ckpt.maybe_save(step, {"params": params, "opt_state": opt})
+    >>> state = ckpt.restore_latest(example={"params": params,
+    ...                                      "opt_state": opt})
+    """
+
+    def __init__(self, path: str, every_steps: int = 1000,
+                 keep: Optional[int] = 3):
+        self.path = path
+        self.every_steps = every_steps
+        self.keep = keep
+
+    def maybe_save(self, step: int, state: Dict[str, Any]) -> Optional[str]:
+        if step % self.every_steps:
+            return None
+        return save(self.path, state, step, keep=self.keep)
+
+    def restore_latest(self, example: Optional[Dict[str, Any]] = None,
+                       broadcast: bool = True) -> Dict[str, Any]:
+        return restore(self.path, example=example, broadcast=broadcast)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.path)

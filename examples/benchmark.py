@@ -1,0 +1,129 @@
+"""Synthetic throughput benchmark — the reference's benchmark vehicle.
+
+Mirrors example/pytorch/benchmark_byteps.py:110-140: repeated timed batches,
+per-iter throughput lines, mean +- 1.96 sigma summary, scaled totals.
+Models: mlp | resnet50 | bert | llama | moe (byteps_tpu.models zoo).
+
+    python examples/benchmark.py --model llama --num-iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.models import bert, llama, mlp, moe, resnet
+
+
+def build(model: str, batch_size: int):
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    if model == "mlp":
+        cfg = mlp.MLPConfig()
+        params = mlp.init_params(key, cfg)
+        batch = {"x": jnp.asarray(rng.rand(batch_size, 784), jnp.float32),
+                 "y": jnp.asarray(rng.randint(0, 10, batch_size), jnp.int32)}
+        return params, batch, lambda p, b: mlp.loss_fn(p, b, cfg)
+    if model == "resnet50":
+        cfg = resnet.ResNetConfig.resnet50()
+        params, bn_state = resnet.init_params(key, cfg)
+        batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
+                                  jnp.float32),
+                 "y": jnp.asarray(rng.randint(0, 1000, batch_size),
+                                  jnp.int32)}
+        # fold the BN state through a has_aux loss
+        state_box = {"s": bn_state}
+
+        def loss(p, b):
+            l, new_state = resnet.loss_fn(p, state_box["s"], b, cfg)
+            return l
+
+        return params, batch, loss
+    if model == "bert":
+        cfg = bert.BertConfig.bert_large()
+        params = bert.init_params(key, cfg)
+        toks = rng.randint(0, cfg.vocab_size, (batch_size, 128))
+        labels = np.where(rng.rand(batch_size, 128) < 0.15,
+                          rng.randint(0, cfg.vocab_size, (batch_size, 128)),
+                          -1)
+        batch = {"tokens": jnp.asarray(toks, jnp.int32),
+                 "labels": jnp.asarray(labels, jnp.int32)}
+        return params, batch, lambda p, b: bert.loss_fn(p, b, cfg)
+    if model == "llama":
+        cfg = llama.LlamaConfig.small()
+        params = llama.init_params(key, cfg)
+        toks = rng.randint(0, cfg.vocab_size, (batch_size, 1025))
+        batch = {"tokens": jnp.asarray(toks, jnp.int32)}
+        return params, batch, lambda p, b: llama.loss_fn(p, b, cfg)
+    if model == "moe":
+        cfg = moe.MoEConfig.small()
+        params = moe.init_params(key, cfg)
+        toks = rng.randint(0, cfg.vocab_size, (batch_size, 513))
+        batch = {"tokens": jnp.asarray(toks, jnp.int32)}
+        return params, batch, lambda p, b: moe.loss_fn(p, b, cfg)
+    raise SystemExit(f"unknown model {model}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama",
+                    choices=["mlp", "resnet50", "bert", "llama", "moe"])
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-warmup-batches", type=int, default=3)
+    ap.add_argument("--num-batches-per-iter", type=int, default=5)
+    ap.add_argument("--num-iters", type=int, default=5)
+    args = ap.parse_args()
+
+    bps.init()
+
+    def log(s):
+        if bps.rank() == 0:
+            print(s, flush=True)
+
+    params, batch, loss_fn = build(args.model, args.batch_size)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    def train_step(p, o, b):
+        loss, g = jax.value_and_grad(loss_fn)(p, b)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    stepj = jax.jit(train_step, donate_argnums=(0, 1))
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size}")
+    log(f"Number of workers: {bps.size()}")
+
+    log("Running warmup...")
+    for _ in range(args.num_warmup_batches):
+        params, opt, loss = stepj(params, opt, batch)
+    float(loss)  # host readback: the only reliable sync on axon
+
+    log("Running benchmark...")
+    img_secs = []
+    for it in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt, loss = stepj(params, opt, batch)
+        float(loss)
+        dt = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        log(f"Iter #{it}: {img_sec:.1f} img/sec per worker")
+        img_secs.append(img_sec)
+
+    mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    log(f"Img/sec per worker: {mean:.1f} +-{conf:.1f}")
+    log(f"Total img/sec on {bps.size()} worker(s): "
+        f"{bps.size() * mean:.1f} +-{bps.size() * conf:.1f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

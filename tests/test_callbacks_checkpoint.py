@@ -1,0 +1,104 @@
+"""Callbacks (Keras-adapter parity) + checkpoint/restore subsystem."""
+
+import numpy as np
+import optax
+import pytest
+
+from byteps_tpu import callbacks as cbs
+
+
+def test_lr_schedule_window_and_staircase(bps):
+    cb = cbs.LearningRateScheduleCallback(lambda e: 0.1 ** e,
+                                          start_epoch=1, end_epoch=3)
+    cl = cbs.CallbackList([cb])
+    cl.on_epoch_begin(0, {})
+    assert cl.lr_scale() == 1.0            # before window: untouched
+    cl.on_epoch_begin(1, {})
+    assert cl.lr_scale() == pytest.approx(0.1)
+    cl.on_epoch_begin(2, {})
+    assert cl.lr_scale() == pytest.approx(0.01)
+    cl.on_epoch_begin(3, {})
+    assert cl.lr_scale() == 1.0            # window closed
+
+
+def test_lr_warmup_ramps_to_one(bps):
+    cb = cbs.LearningRateWarmupCallback(warmup_epochs=4, size=8,
+                                        steps_per_epoch=10)
+    cl = cbs.CallbackList([cb])
+    cl.on_epoch_begin(0, {})
+    assert cl.lr_scale() == pytest.approx(1 / 8)
+    cl.on_epoch_begin(2, {})
+    assert cl.lr_scale() == pytest.approx(1 / 8 + (1 - 1 / 8) * 0.5)
+    # fractional progress within an epoch
+    cl.on_batch_begin(5, {})
+    assert cl.lr_scale() == pytest.approx(1 / 8 + (1 - 1 / 8) * 0.625)
+    cl.on_epoch_begin(4, {})
+    assert cl.lr_scale() == 1.0
+
+
+def test_apply_lr_requires_inject_hyperparams(bps):
+    cl = cbs.CallbackList([cbs.LearningRateWarmupCallback(2, size=4)])
+    tx = optax.sgd(0.1)
+    state = tx.init({"w": np.zeros(3, np.float32)})
+    with pytest.raises(ValueError, match="inject_hyperparams"):
+        cl.apply_lr(state, base_lr=0.1)
+
+    txh = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+    sh = txh.init({"w": np.zeros(3, np.float32)})
+    cl.on_epoch_begin(0, {})
+    sh = cl.apply_lr(sh, base_lr=0.1)
+    assert float(sh.hyperparams["learning_rate"]) == pytest.approx(0.1 / 4)
+
+
+def test_metric_average_and_broadcast_callbacks(bps):
+    import jax
+    from byteps_tpu.models import mlp
+
+    cfg = mlp.MLPConfig(in_dim=4, hidden=(8,), n_classes=2)
+    params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+    state = {"params": params, "metrics": {"loss": 1.5}}
+    cl = cbs.CallbackList([
+        cbs.BroadcastGlobalVariablesCallback(root_rank=0),
+        cbs.MetricAverageCallback(),
+    ])
+    cl.on_train_begin(state)       # single worker: broadcast is identity
+    for a, b in zip(jax.tree.leaves(state["params"]),
+                    jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    cl.on_epoch_end(0, state)
+    assert state["metrics"]["loss"] == pytest.approx(1.5)
+
+
+def test_checkpoint_save_restore_roundtrip(bps, tmp_path):
+    import jax
+    from byteps_tpu.models import mlp
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    cfg = mlp.MLPConfig(in_dim=4, hidden=(8,), n_classes=2)
+    params = mlp.init_params(jax.random.PRNGKey(1), cfg)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    state = {"params": params, "opt_state": opt}
+
+    path = str(tmp_path / "run")
+    ckpt.save(path, state, step=10)
+    ckpt.save(path, state, step=20)
+    assert ckpt.latest_step(path) == 20
+
+    restored = ckpt.restore(path, example=state, broadcast=True)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_checkpointer_periodic_and_keep(bps, tmp_path):
+    import jax
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "run2")
+    c = ckpt.Checkpointer(path, every_steps=5, keep=2)
+    state = {"w": np.arange(6, dtype=np.float32)}
+    for step in range(1, 21):
+        c.maybe_save(step, state)
+    assert ckpt.all_steps(path) == [15, 20]
+    out = c.restore_latest(example=state)
+    np.testing.assert_array_equal(out["w"], state["w"])

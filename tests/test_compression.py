@@ -89,8 +89,8 @@ def test_randomk_matches_golden():
     x = rng.randn(n).astype(np.float32)
     codec = RandomkCodec(size=n, k=k, seed=seed)
     payload = jax.jit(lambda x, s: codec.compress(x, s))(x, jnp.int32(step))
-    # golden indices from the shared stream
-    u = bps_rng.np_uniform(seed, k, mix=step)
+    # golden indices from the shared counter-based stream
+    u = bps_rng.np_uniform_parallel(seed, k, mix=step)
     golden_idx = np.minimum((u * n).astype(np.int32), n - 1)
     np.testing.assert_array_equal(np.asarray(payload["indices"]), golden_idx)
     np.testing.assert_allclose(np.asarray(payload["values"]), x[golden_idx])
