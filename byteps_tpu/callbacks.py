@@ -73,14 +73,18 @@ class CallbackList:
         return scale
 
     def apply_lr(self, opt_state, base_lr: float):
-        """Rewrite the ``learning_rate`` hyperparam leaf (requires the
-        optimizer be wrapped in optax.inject_hyperparams)."""
+        """Return a new opt_state with the ``learning_rate`` hyperparam
+        leaf rewritten (requires the optimizer be wrapped in
+        optax.inject_hyperparams). Functional: the input state is not
+        mutated, so stashed references (checkpoints, rollback copies) keep
+        their recorded LR."""
         if not hasattr(opt_state, "hyperparams"):
             raise ValueError(
                 "apply_lr requires optax.inject_hyperparams(...) so the "
                 "learning rate is part of the optimizer state")
-        opt_state.hyperparams["learning_rate"] = base_lr * self.lr_scale()
-        return opt_state
+        hyper = dict(opt_state.hyperparams)
+        hyper["learning_rate"] = base_lr * self.lr_scale()
+        return opt_state._replace(hyperparams=hyper)
 
 
 class BroadcastGlobalVariablesCallback(Callback):

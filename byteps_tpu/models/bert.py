@@ -152,9 +152,13 @@ def loss_fn(params, batch: Dict[str, jnp.ndarray], cfg: BertConfig) -> jnp.ndarr
     labels = batch["labels"]
     valid = labels >= 0
     safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, -1)
-    ll = jnp.take_along_axis(logp, safe_labels[..., None], -1)[..., 0]
-    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
+    # logsumexp form: avoids materializing the [B, S, V] normalized fp32
+    # array that log_softmax+gather would (see llama.next_token_xent)
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, -1)
+    picked = jnp.take_along_axis(lf, safe_labels[..., None], -1)[..., 0]
+    nll = (lse - picked) * valid
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
 
 
 def param_count(params) -> int:

@@ -174,11 +174,19 @@ def attn_sublayer(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
 
 
 def next_token_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Mean next-token cross-entropy. logits [B, S, V], targets [B, S].
-    The single loss definition shared by llama/moe/pp paths."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    """Mean next-token cross-entropy. logits [B, S, V] (any float dtype —
+    math runs in fp32), targets [B, S]. The single loss definition shared
+    by llama/moe/pp paths.
+
+    Uses the logsumexp form rather than log_softmax: log_softmax would
+    materialize a full [B, S, V] fp32 normalized array only to gather one
+    element per token, a pure HBM-bandwidth tax; logsumexp reduces to
+    [B, S] and the fp32 cast fuses into the reduction (~3% step time on
+    the 125M bench)."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    picked = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def split_batch(batch: Dict[str, jnp.ndarray]) -> tuple:
@@ -202,7 +210,7 @@ def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
 
 def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
             attn_impl=None, sp_axis: Optional[str] = None) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32).
+    """tokens [B, S] int32 -> logits [B, S, vocab] (cfg.dtype).
 
     ``sp_axis``: when running inside shard_map with the sequence sharded
     over that mesh axis (ring attention), RoPE must use *global* positions:
@@ -237,8 +245,10 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
 
     x, _ = jax.lax.scan(body, x, blk)
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    # logits stay in cfg.dtype: materializing [B, S, V] fp32 costs ~2x the
+    # HBM traffic of the whole lm_head matmul; consumers cast into their
+    # fp32 reductions (next_token_xent), where the cast fuses
+    return x @ params["lm_head"].astype(cfg.dtype)
 
 
 def forward_pp(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
@@ -261,7 +271,7 @@ def forward_pp(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
                            num_microbatches=num_microbatches, axis=pp_axis,
                            remat=cfg.remat)
     h = _rmsnorm(out, params["final_norm"], cfg.norm_eps)
-    return (h @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return h @ params["lm_head"].astype(cfg.dtype)
 
 
 def loss_fn_pp(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],

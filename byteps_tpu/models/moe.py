@@ -236,8 +236,8 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
 
     x, auxes = jax.lax.scan(body, x, params["blocks"])
     x = L._rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    return logits, jnp.mean(auxes)
+    # cfg.dtype logits; next_token_xent does the fp32 math (llama.py)
+    return x @ params["lm_head"].astype(cfg.dtype), jnp.mean(auxes)
 
 
 EXPERT_LEAVES = ("w_gate", "w_up", "w_down")

@@ -430,15 +430,20 @@ struct CompressorCfg {
         break;
       }
       case DITHERING: {
-        float norm = 0.0f;
-        if (!l2) {
-          for (uint32_t i = 0; i < n; ++i)
-            norm = std::max(norm, std::fabs(in[i]));
-        } else {
+        float m = 0.0f;
+        for (uint32_t i = 0; i < n; ++i)
+          m = std::max(m, std::fabs(in[i]));
+        float norm = m;
+        if (l2) {
+          // scale-invariant two-pass l2 (host.py parity): raw x*x would
+          // overflow for |x| near float32 max
+          float safe_m = std::max(m, 1e-30f);
           double acc = 0;
-          for (uint32_t i = 0; i < n; ++i)
-            acc += (double)in[i] * (double)in[i];
-          norm = (float)std::sqrt(acc);
+          for (uint32_t i = 0; i < n; ++i) {
+            double r = (double)(in[i] / safe_m);
+            acc += r * r;
+          }
+          norm = safe_m * (float)std::sqrt(acc);
         }
         norm = std::max(norm, 1e-30f);
         uint64_t s0, s1;

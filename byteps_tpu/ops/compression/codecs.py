@@ -181,10 +181,14 @@ class DitheringCodec(Codec):
 
     def compress(self, x: jnp.ndarray, step: int = 0) -> Dict[str, Any]:
         absx = jnp.abs(x)
+        m = jnp.max(absx)
         if self.normalize == "max":
-            norm = jnp.max(absx)
+            norm = m
         else:
-            norm = jnp.linalg.norm(x)
+            # scale-invariant two-pass l2 (f32-safe for |x| near
+            # float32 max, where x*x overflows to inf)
+            safe_m = jnp.maximum(m, 1e-30)
+            norm = safe_m * jnp.sqrt(jnp.sum(jnp.square(absx / safe_m)))
         norm = jnp.maximum(norm, 1e-30)
         scaled = absx / norm                           # in [0, 1]
         # counter-based parallel uniforms: per-element noise needs no

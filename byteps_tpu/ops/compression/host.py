@@ -166,10 +166,15 @@ class HostDithering(HostCodec):
     def compress(self, x: np.ndarray, step: int = 0) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
         absx = np.abs(x)
+        m = absx.max(initial=np.float32(0))
         if self.normalize == "max":
-            norm = absx.max(initial=np.float32(0))
+            norm = m
         else:
-            norm = np.float32(np.linalg.norm(x))
+            # scale-invariant two-pass l2: |x| up to float32 max would
+            # overflow x*x to inf (and decompress to 0*inf = NaN)
+            safe_m = np.float32(max(m, 1e-30))
+            norm = safe_m * np.float32(
+                np.sqrt(np.sum(np.square(absx / safe_m))))
         norm = np.float32(max(norm, 1e-30))
         scaled = (absx / norm).astype(np.float32)
         u = np_uniform_parallel(self.seed, self.n, mix=step)

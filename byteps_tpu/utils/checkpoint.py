@@ -83,26 +83,52 @@ def restore(path: str, step: Optional[int] = None,
     broadcast (their zero contribution is summed away)."""
     import byteps_tpu as bps
 
+    from ..core.state import get_state
+
     if step is None:
         step = latest_step(path)
-    if step is None:
-        if broadcast and example is not None and bps.rank() != 0:
-            state = jax.tree.map(lambda leaf: np.zeros_like(np.asarray(leaf)),
-                                 example)
-        else:
-            raise FileNotFoundError(
-                f"no checkpoints under {path}"
-                + ("" if example is not None else
-                   " (non-root workers need example= to join the restore "
-                   "broadcast without a local checkpoint)"))
+
+    multi_worker = (get_state().ps_client is not None
+                    and get_state().config.num_workers > 1)
+    if broadcast and multi_worker:
+        # agree on the step FIRST: without this, a fresh run (no checkpoint
+        # anywhere) would raise on rank 0 while the other ranks enter the
+        # state broadcast and deadlock waiting for its contribution
+        flag = np.asarray(
+            [step + 1 if (step is not None and bps.rank() == 0) else 0],
+            np.int64)
+        agreed = int(np.asarray(bps.broadcast(
+            flag, root_rank=0, name="ckpt/restore_step"))[0])
+        step = agreed - 1 if agreed > 0 else None
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {path} on the "
+                                    f"root worker")
+        local = bps.rank() == 0 or step in all_steps(path)
     else:
-        state = _checkpointer().restore(_step_dir(path, step))
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {path}")
+        local = True
+
+    if local and step in all_steps(path):
         if example is not None:
-            # restored as plain nested dicts -> reshape onto the example
-            # treedef
-            leaves = jax.tree.leaves(state)
-            treedef = jax.tree.structure(example)
-            state = jax.tree.unflatten(treedef, leaves)
+            # restore INTO the example structure: orbax maps by tree path,
+            # so namedtuple field order / >9 chain indices can't permute
+            # (raw leaf-order reshaping would silently corrupt e.g.
+            # optax.MultiSteps state, whose field names do not sort
+            # alphabetically)
+            state = _checkpointer().restore(
+                _step_dir(path, step),
+                item=jax.tree.map(np.asarray, example))
+        else:
+            state = _checkpointer().restore(_step_dir(path, step))
+    else:
+        if example is None:
+            raise FileNotFoundError(
+                f"step {step} missing under {path} (non-root workers need "
+                f"example= to join the restore broadcast without a local "
+                f"checkpoint)")
+        state = jax.tree.map(lambda leaf: np.zeros_like(np.asarray(leaf)),
+                             example)
     if broadcast:
         from ..jax import broadcast_parameters
         state = broadcast_parameters(state, root_rank=0)

@@ -55,6 +55,7 @@ def main() -> None:
             out_specs=(P(), P(), P()), check_vma=False))
 
     step = make_step()
+    loss = jnp.zeros(())
     for i in range(args.steps_before):
         params, opt, loss = step(params, opt, x, y)
     print(f"[elastic] before suspend: step={args.steps_before} "

@@ -90,6 +90,27 @@ def test_checkpoint_save_restore_roundtrip(bps, tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
 
 
+def test_checkpoint_multisteps_state_not_permuted(bps, tmp_path):
+    """optax.MultiSteps state fields do NOT sort alphabetically in
+    declaration order — a leaf-order reshape would silently permute them;
+    restore(item=example) must map by tree path."""
+    import jax
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    params = {"w": np.arange(4, dtype=np.float32)}
+    tx = optax.MultiSteps(optax.adam(1e-3), every_k_schedule=4)
+    opt = tx.init(params)
+    # make the integer fields distinguishable from each other
+    opt = opt._replace(mini_step=np.int32(3), gradient_step=np.int32(17))
+    state = {"params": params, "opt_state": opt}
+
+    path = str(tmp_path / "ms")
+    ckpt.save(path, state, step=1)
+    restored = ckpt.restore(path, example=state, broadcast=False)
+    assert int(restored["opt_state"].mini_step) == 3
+    assert int(restored["opt_state"].gradient_step) == 17
+
+
 def test_checkpointer_periodic_and_keep(bps, tmp_path):
     import jax
     from byteps_tpu.utils import checkpoint as ckpt

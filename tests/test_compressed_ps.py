@@ -285,6 +285,45 @@ def test_compressed_ps_training(monkeypatch):
         GlobalState._instance = None
 
 
+def test_dithering_level_bound_invariant():
+    """|level| <= s for every implementation on adversarial inputs (huge
+    dynamic range, denormals, single dominant element). The linear-path
+    clamp guards the int8 cast at s=127 against any norm that rounds
+    below max|x|; no crafted float32 input reliably triggers that rounding
+    through np.linalg.norm, so the invariant is pinned property-style
+    across host, jnp, and the C++ server instead."""
+    import jax.numpy as jnp
+    from byteps_tpu.ops.compression.codecs import DitheringCodec
+
+    n = 64
+    cases = [
+        np.asarray([3.4e38] + [1e-40] * (n - 1), np.float32),
+        np.asarray([1.0] * n, np.float32),
+        np.concatenate([[7.3], np.full(n - 1, 1e-6)]).astype(np.float32),
+    ]
+    for norm_t in ("max", "l2"):
+        for x in cases:
+            h = host.HostDithering(n=n, s=127, normalize=norm_t, seed=1)
+            wire = np.frombuffer(h.compress(x, 0), np.uint8)
+            lv = wire[:n].view(np.int8)
+            assert np.abs(lv.astype(np.int32)).max() <= 127
+            assert np.all(np.isfinite(h.decompress(wire)))
+            j = DitheringCodec(size=n, s=127, normalize=norm_t, seed=1)
+            jlv = np.asarray(j.compress(jnp.asarray(x))["levels"])
+            assert np.abs(jlv.astype(np.int32)).max() <= 127
+
+    # server-side: push an all-dominant vector through the C++ mirror
+    port, t = _server(1)
+    c = PSClient([f"127.0.0.1:{port}"], worker_id=0)
+    kw = {"compressor": "dithering", "s": "127", "normalize_type": "l2"}
+    ct = CompressedTensor(c, _ctx("g", n * 4, 1), kw, 1)
+    out = ct.push_pull(cases[2], average=False)
+    assert np.all(np.isfinite(out))
+    assert np.sign(out[0]) >= 0
+    c.close()
+    t.join(timeout=10)
+
+
 def test_host_matches_jax_codecs():
     """The host wire codecs and the portable jnp codecs must agree — the
     on-device compressor's output is what actually hits the wire."""
