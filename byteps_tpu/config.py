@@ -69,6 +69,7 @@ class Config:
     server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
     key_hash_fn: str = "djb2"             # BYTEPS_KEY_HASH_FN
     enable_mixed_mode: bool = False       # BYTEPS_ENABLE_MIXED_MODE
+    mixed_mode_bound: int = 101           # BYTEPS_MIXED_MODE_BOUND
 
     # --- compression ---
     min_compress_bytes: int = DEFAULT_MIN_COMPRESS_BYTES
@@ -111,6 +112,7 @@ class Config:
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
             enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE"),
+            mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES",
                                         DEFAULT_MIN_COMPRESS_BYTES),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

@@ -187,15 +187,43 @@ class TensorRegistry:
         if num_servers == 1:
             return 0
         fn_name = self._config.key_hash_fn
-        if fn_name == "mixed":
-            # mixed: pick the least-loaded server (global.cc:566-596's
-            # load-aware variant).
+        if self._config.enable_mixed_mode:
+            server = self._hash_mixed_mode_locked(key)
+        elif fn_name == "mixed":
+            # "mixed" hash without mixed MODE: least-loaded assignment
+            # (deterministic across workers — every worker declares
+            # tensors in the same order, so the running loads agree)
             server = min(range(num_servers), key=lambda s: self._server_load[s])
         else:
             fn = _HASH_FNS.get(fn_name, _hash_djb2)
             server = fn(str(key)) % num_servers
         self._server_load[server] += length
         return server
+
+    def _hash_mixed_mode_locked(self, key: int) -> int:
+        """Colocated/non-colocated split (Hash_Mixed_Mode,
+        global.cc:566-596): the last ``num_workers`` servers are colocated
+        with workers; a djb2 double hash routes a computed fraction of keys
+        to the dedicated (non-colocated) servers so colocated hosts carry
+        a lighter share."""
+        num_servers = self._config.num_servers
+        num_workers = max(1, self._config.num_workers)
+        noncolo = num_servers - num_workers
+        bps_check(noncolo >= 1,
+                  "mixed mode needs num_servers > num_workers (every worker "
+                  "colocates one server plus dedicated servers)")
+        bound = self._config.mixed_mode_bound
+        bps_check(bound >= num_servers,
+                  f"BYTEPS_MIXED_MODE_BOUND {bound} < num_servers")
+        ratio = (2.0 * noncolo * (num_workers - 1)) / (
+            num_workers * (num_workers + noncolo) - 2 * noncolo)
+        bps_check(0 <= ratio <= 1,
+                  "mixed mode requires num_noncolocated <= num_workers")
+        threshold = ratio * bound
+        h = _hash_djb2(str(key)) % bound
+        if h < threshold:
+            return _hash_djb2(str(h)) % noncolo
+        return noncolo + _hash_djb2(str(h)) % num_workers
 
     def server_loads(self) -> List[int]:
         with self._lock:

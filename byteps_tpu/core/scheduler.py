@@ -253,11 +253,13 @@ class PipelineScheduler:
     """
 
     def __init__(self, client, num_threads: int = 8,
-                 credit_bytes: int = 0, tracer=None, telemetry=None):
+                 credit_bytes: int = 0, tracer=None, telemetry=None,
+                 config=None):
         self._client = client
         self._queue = ScheduledQueue(credit_bytes)
         self._tracer = tracer
         self._telemetry = telemetry
+        self._config = config
         self._threads = [
             threading.Thread(target=self._worker, name=f"bps-sched-{i}",
                              daemon=True)
@@ -274,6 +276,11 @@ class PipelineScheduler:
             name = task.ctx.name
             err = None
             try:
+                if self._config is not None:
+                    from ..utils.logging import debug_sample
+                    debug_sample(self._config, name,
+                                 f"PUSH.{task.partition.index}",
+                                 task.in_view, task.ctx.dtype.np_dtype)
                 if self._tracer:
                     self._tracer.begin(name, f"PUSH.{task.partition.index}")
                 self._client.zpush(task.partition.server, task.key,
@@ -285,6 +292,11 @@ class PipelineScheduler:
                                    task.out_view, task.cmd)
                 if self._tracer:
                     self._tracer.end(name, f"PULL.{task.partition.index}")
+                if self._config is not None:
+                    from ..utils.logging import debug_sample
+                    debug_sample(self._config, name,
+                                 f"PULL.{task.partition.index}",
+                                 task.out_view, task.ctx.dtype.np_dtype)
             except Exception as e:  # noqa: BLE001 - forwarded to waiter
                 err = e
             finally:

@@ -118,7 +118,8 @@ class GlobalState:
                 self.scheduler = PipelineScheduler(
                     self.ps_client,
                     credit_bytes=self.config.scheduling_credit,
-                    tracer=self.tracer, telemetry=self.telemetry)
+                    tracer=self.tracer, telemetry=self.telemetry,
+                    config=self.config)
                 self.handles = HandleManager()
             self.initialized = True
             self.suspended = False

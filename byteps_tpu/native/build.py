@@ -20,9 +20,24 @@ _LOCK = threading.Lock()
 CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
 
 
+def _sanitizer_flags() -> list:
+    """BYTEPS_SANITIZE=thread|address builds the native PS under
+    TSAN/ASAN — the sanitizer tier the reference never had (SURVEY.md
+    §5.2: no race detection in-tree). tests/test_sanitize.py runs the
+    loopback stress suite against these builds."""
+    san = os.environ.get("BYTEPS_SANITIZE", "")
+    if san == "thread":
+        return ["-fsanitize=thread", "-O1", "-g"]
+    if san == "address":
+        return ["-fsanitize=address", "-O1", "-g"]
+    return []
+
+
 def lib_path() -> str:
     with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h = hashlib.sha256(f.read())
+    h.update(" ".join(_sanitizer_flags()).encode())
+    digest = h.hexdigest()[:16]
     return os.path.join(_DIR, f"libbyteps_ps-{digest}.so")
 
 
@@ -32,7 +47,12 @@ def build(verbose: bool = False) -> str:
     with _LOCK:
         if os.path.exists(out):
             return out
-        cmd = ["g++", *CXXFLAGS, _SRC, "-o", out + ".tmp"]
+        flags = list(CXXFLAGS)
+        san = _sanitizer_flags()
+        if san:
+            # sanitizer flags override -O3 (listed later wins for -O)
+            flags += san
+        cmd = ["g++", *flags, _SRC, "-o", out + ".tmp"]
         if verbose:
             print("[byteps_tpu] building native PS:", " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)

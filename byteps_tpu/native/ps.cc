@@ -586,9 +586,10 @@ class EngineQueue {
 class Server {
  public:
   Server(int port, int num_workers, int num_engine_threads, bool async_mode,
-         bool enable_schedule)
+         bool enable_schedule, int64_t debug_key = -1)
       : port_(port), num_workers_(num_workers),
-        async_(async_mode), schedule_(enable_schedule) {
+        async_(async_mode), schedule_(enable_schedule),
+        debug_key_(debug_key) {
     for (int i = 0; i < num_engine_threads; ++i) {
       queues_.emplace_back(new EngineQueue(enable_schedule));
       engine_bytes_.push_back(0);
@@ -840,6 +841,8 @@ class Server {
       ks.total_pushes++;
       if (m.sender < ks.worker_push_count.size())
         ks.worker_push_count[m.sender]++;
+      DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
+                 ks.comp.n * 4, F32);
       float* accum = (float*)ks.accum.data();
       if (ks.recv_count == 0) {
         std::memcpy(accum, ks.scratch.data(),
@@ -854,6 +857,7 @@ class Server {
         // the compression hook of server.cc:92-118); keep the dense view
         // in `merged` too so diagnostics stay meaningful
         std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
+        DebugPrint("RECOMPRESS", m.key, ks.merged.data(), ks.len, F32);
         ks.comp.Compress(accum, ks.wire_merged.data(),
                          ks.completed_rounds, ks.round_idx);
         ks.recv_count = 0;
@@ -914,6 +918,8 @@ class Server {
         ks.completed_rounds++;
         flush.swap(ks.parked_pulls);
       } else {
+        DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV", m.key,
+                   m.payload.data(), (uint32_t)m.payload.size(), ks.dtype);
         if (ks.recv_count == 0) {
           std::memcpy(ks.accum.data(), m.payload.data(), m.payload.size());
         } else {
@@ -924,6 +930,7 @@ class Server {
         if ((int)ks.recv_count >= num_workers_) {
           // ALL_RECV: publish and flush parked pulls (server.cc:345-375)
           std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
+          DebugPrint("ALL_RECV", m.key, ks.merged.data(), ks.len, ks.dtype);
           ks.recv_count = 0;
           ks.completed_rounds++;
           flush.swap(ks.parked_pulls);
@@ -982,10 +989,24 @@ class Server {
     if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender, comp});
   }
 
+  // per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
+  // + BYTEPS_SERVER_DEBUG_KEY, server.cc:120-144)
+  void DebugPrint(const char* stage, uint64_t key, const void* data,
+                  uint32_t len, uint32_t dtype) {
+    if (debug_key_ < 0 || (uint64_t)debug_key_ != key) return;
+    double first = 0;
+    if (len >= 4 && dtype == F32) first = *(const float*)data;
+    else if (len >= 8 && dtype == F64) first = *(const double*)data;
+    else if (len >= 1) first = *(const uint8_t*)data;
+    std::fprintf(stderr, "[bps-server-debug] key=%llu stage=%s len=%u "
+                 "first=%g\n", (unsigned long long)key, stage, len, first);
+  }
+
   int port_;
   int num_workers_;
   bool async_;
   bool schedule_;
+  int64_t debug_key_ = -1;
   int listen_fd_ = -1;
   std::atomic<bool> shutting_down_{false};
   std::atomic<int> shutdown_count_{0};
@@ -1042,12 +1063,15 @@ class ServerConn {
   }
 
   void Close() {
+    // shutdown() wakes the recv thread without invalidating the fd; the
+    // close() must wait for the join — closing an fd another thread is
+    // blocked on is a race (and could close a reused descriptor)
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+    if (recv_thread_.joinable()) recv_thread_.join();
     if (fd_ >= 0) {
-      ::shutdown(fd_, SHUT_RDWR);
       ::close(fd_);
       fd_ = -1;
     }
-    if (recv_thread_.joinable()) recv_thread_.join();
   }
 
   // blocking request: returns got_len or ~0u on failure
@@ -1213,6 +1237,13 @@ void* bps_server_create(int port, int num_workers, int engine_threads,
                         int async_mode, int enable_schedule) {
   return new bps::Server(port, num_workers, engine_threads, async_mode != 0,
                          enable_schedule != 0);
+}
+
+void* bps_server_create_dbg(int port, int num_workers, int engine_threads,
+                            int async_mode, int enable_schedule,
+                            int64_t debug_key) {
+  return new bps::Server(port, num_workers, engine_threads, async_mode != 0,
+                         enable_schedule != 0, debug_key);
 }
 
 int bps_server_run(void* s) { return ((bps::Server*)s)->Run(); }

@@ -158,6 +158,9 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
             DataType.from_np(x.dtype))
         ctx.priority = priority
 
+    if name is not None:
+        from ..utils.logging import debug_sample
+        debug_sample(state.config, name, "INPUT", np.asarray(tensor))
     fn = _cached_push_pull(mesh, tuple(x.shape[1:]), str(x.dtype), average, axis)
     out = fn(x)
     state.telemetry.record(out.nbytes * n)
@@ -175,6 +178,9 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
         out = jnp.asarray(
             ps_round_trip(state, name, host, average).reshape(out.shape))
 
+    if name is not None:
+        from ..utils.logging import debug_sample
+        debug_sample(state.config, name, "OUTPUT", np.asarray(out))
     if state.tracer is not None and name is not None:
         state.tracer.instant(name, "push_pull")
     return out

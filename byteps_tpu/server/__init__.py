@@ -29,14 +29,21 @@ def run_server(port: Optional[int] = None,
         server_id = int(os.environ.get("BYTEPS_SERVER_ID", "0"))
         port = config.scheduler_port + server_id
     lib = ctypes.CDLL(build())
-    lib.bps_server_create.restype = ctypes.c_void_p
-    lib.bps_server_create.argtypes = [ctypes.c_int] * 5
+    lib.bps_server_create_dbg.restype = ctypes.c_void_p
+    lib.bps_server_create_dbg.argtypes = [ctypes.c_int] * 5 + [
+        ctypes.c_int64]
     lib.bps_server_run.argtypes = [ctypes.c_void_p]
     lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
-    srv = lib.bps_server_create(
+    # per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
+    # + BYTEPS_SERVER_DEBUG_KEY, server.cc:120-144,439-442)
+    debug_key = -1
+    if os.environ.get("BYTEPS_SERVER_DEBUG", "") in ("1", "true"):
+        debug_key = int(os.environ.get("BYTEPS_SERVER_DEBUG_KEY", "0"))
+    srv = lib.bps_server_create_dbg(
         port, max(1, config.num_workers), config.server_engine_threads,
         1 if config.enable_async else 0,
-        1 if config.server_enable_schedule else 0)
+        1 if config.server_enable_schedule else 0,
+        debug_key)
     rc = lib.bps_server_run(srv)
     lib.bps_server_destroy(srv)
     return rc

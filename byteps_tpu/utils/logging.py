@@ -42,6 +42,26 @@ def _make_logger() -> logging.Logger:
 log = _make_logger()
 
 
+def debug_sample(config, name: str, stage: str, arr, dtype=None) -> None:
+    """Per-stage tensor value sampling (reference: BYTEPS_DEBUG_SAMPLE_TENSOR,
+    core_loops.cc:37-67): when the configured substring matches ``name``,
+    print the first/last element at this pipeline stage. ``arr`` may be a
+    raw uint8 view; pass ``dtype`` (numpy dtype) to reinterpret."""
+    needle = getattr(config, "debug_sample_tensor", "")
+    if not needle or needle not in name:
+        return
+    import numpy as np
+
+    flat = np.asarray(arr).reshape(-1)
+    if dtype is not None and flat.dtype == np.uint8:
+        flat = flat.view(dtype)
+    if flat.size == 0:
+        log.info("[sample] %s @%s: <empty>", name, stage)
+        return
+    log.info("[sample] %s @%s: n=%d first=%s last=%s", name, stage,
+             flat.size, flat[0], flat[-1])
+
+
 def bps_check(cond: bool, msg: str = "") -> None:
     """Equivalent of BPS_CHECK: raise on failed invariant."""
     if not cond:

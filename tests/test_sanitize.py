@@ -1,0 +1,100 @@
+"""Sanitizer tier for the native PS (SURVEY.md §5.2: the reference ships
+no race detection; this build adds it).
+
+Builds ps.cc under -fsanitize=thread and runs a concurrent loopback stress
+(two clients hammering overlapping keys: dense, compressed, parked pulls,
+barrier) in a subprocess with the TSAN runtime preloaded. Any data race
+makes TSAN print a WARNING and exit nonzero (halt_on_error)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_STRESS = r"""
+import threading, numpy as np
+import os, sys
+sys.path.insert(0, os.environ["BPS_REPO"])
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+from byteps_tpu.server.compressed import CompressedTensor
+
+PORT = 24917
+cfg = Config(num_workers=2, num_servers=1)
+server = threading.Thread(target=run_server, args=(PORT, cfg), daemon=True)
+server.start()
+
+CMD = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+addr = [f"127.0.0.1:{PORT}"]
+clients = [PSClient(addr, worker_id=w) for w in range(2)]
+
+def reg():
+    return TensorRegistry(Config(num_workers=2, num_servers=1))
+
+def worker(w):
+    r = reg()
+    c = clients[w]
+    rng = np.random.RandomState(w)
+    # dense tensors (multi-partition) + compressed tensor, interleaved
+    ctxs = [r.init_tensor(f"t{i}", 3000 * 4, DataType.FLOAT32)
+            for i in range(4)]
+    for ctx in ctxs:
+        c.init_tensor(ctx, np.zeros(3000, np.float32))
+    ct = CompressedTensor(c, r.init_tensor("comp", 2048 * 4, DataType.FLOAT32),
+                          {"compressor": "onebit", "ef": "vanilla"}, 2)
+    for step in range(15):
+        for ctx in ctxs:
+            x = rng.randn(3000).astype(np.float32)
+            c.push_pull(ctx, x, average=True, num_workers=2)
+        ct.push_pull(rng.randn(2048).astype(np.float32))
+        c.barrier()
+
+threads = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
+for t in threads: t.start()
+for t in threads: t.join()
+clients[0].close(shutdown_servers=False)
+clients[1].close()
+server.join(timeout=20)
+print("STRESS_OK")
+"""
+
+
+@pytest.mark.slow
+def test_tsan_loopback_stress(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    libtsan = subprocess.run(
+        ["g++", "-print-file-name=libtsan.so"], capture_output=True,
+        text=True).stdout.strip()
+    if not os.path.isabs(libtsan) or not os.path.exists(libtsan):
+        pytest.skip("libtsan not available")
+
+    script = tmp_path / "stress.py"
+    script.write_text(_STRESS)
+    env = {
+        **os.environ,
+        "BPS_REPO": repo,
+        "BYTEPS_SANITIZE": "thread",
+        "LD_PRELOAD": libtsan,
+        "TSAN_OPTIONS": "halt_on_error=1 exitcode=66",
+        # jax under TSAN is hopeless; the stress uses numpy only
+        "JAX_PLATFORMS": "cpu",
+    }
+    # build the sanitized lib first (outside LD_PRELOAD, g++ subprocesses
+    # under TSAN preload are fine but slower)
+    subprocess.run(
+        [sys.executable, "-c",
+         "import sys, os; sys.path.insert(0, os.environ['BPS_REPO']); "
+         "from byteps_tpu.native.build import build; build(verbose=True)"],
+        env={**os.environ, "BPS_REPO": repo, "BYTEPS_SANITIZE": "thread"},
+        check=True, capture_output=True, timeout=300)
+
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=480)
+    out = proc.stdout + proc.stderr
+    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "STRESS_OK" in out, out[-4000:]
