@@ -18,7 +18,9 @@ rtol=1e-6):
 Wire layouts (little-endian):
 - onebit:    uint32 bits[ceil(n/32)], then f32 scale
 - topk:      int32 idx[k], then f32 val[k]
-- randomk:   int32 idx[k], then f32 val[k] (idx from shared xorshift128+)
+- randomk:   int32 idx[k], then f32 val[k] (idx from the counter-based
+             murmur3 generator ``np_uniform_parallel``, seeded by
+             (seed, step) so worker and server agree)
 - dithering: int8 levels[n], then f32 norm
 
 Error feedback (vanilla) and momentum (nesterov) run worker-side only, as

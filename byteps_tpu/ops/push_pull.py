@@ -160,7 +160,10 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
 
     if name is not None:
         from ..utils.logging import debug_sample
-        debug_sample(state.config, name, "INPUT", np.asarray(tensor))
+        # pass the raw array: debug_sample only materializes (np.asarray →
+        # device sync + D2H) after its needle check, keeping the hot
+        # collective path free of forced transfers when sampling is off
+        debug_sample(state.config, name, "INPUT", tensor)
     fn = _cached_push_pull(mesh, tuple(x.shape[1:]), str(x.dtype), average, axis)
     out = fn(x)
     state.telemetry.record(out.nbytes * n)
@@ -180,7 +183,7 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
 
     if name is not None:
         from ..utils.logging import debug_sample
-        debug_sample(state.config, name, "OUTPUT", np.asarray(out))
+        debug_sample(state.config, name, "OUTPUT", out)
     if state.tracer is not None and name is not None:
         state.tracer.instant(name, "push_pull")
     return out

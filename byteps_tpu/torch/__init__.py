@@ -334,8 +334,12 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
 class DistributedDataParallel(torch.nn.Module):
     """Module wrapper: broadcasts parameters from rank 0 at construction
     and push_pulls gradients via post-accumulate hooks; gradients are
-    guaranteed reduced after ``sync_gradients()`` (called automatically
-    when used together with DistributedOptimizer.step's synchronize)."""
+    reduced only after an explicit ``sync_gradients()`` call (typically
+    right before ``optimizer.step()``). Do NOT combine with
+    ``DistributedOptimizer`` — each wrapper registers its own hooks, so
+    combining double-pushes every gradient (as in the reference, where DDP
+    and DistributedOptimizer are alternative frontends,
+    parallel/distributed.py:13-287)."""
 
     def __init__(self, module: torch.nn.Module):
         super().__init__()
