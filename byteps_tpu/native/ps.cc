@@ -168,6 +168,76 @@ static void tune_socket(int fd) {
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
 
+// 16-bit float conversions for summation. The reference's fp16 path
+// converts to f32, adds, and rounds back per element (AVX F16C
+// vcvtph2ps/vcvtps2ph, cpu_reducer.cc:59-120, cpu_reducer.h:83-179);
+// these scalar versions implement the same round-to-nearest-even
+// semantics portably so worker (numpy/JAX) and server agree bit-for-bit.
+static inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;  // +-0
+    } else {     // subnormal: renormalize
+      exp = 113;  // 127 - 15 + 1
+      while ((man & 0x400u) == 0) { man <<= 1; exp--; }
+      f = sign | (exp << 23) | ((man & 0x3ffu) << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (man << 13);  // inf / nan
+  } else {
+    f = sign | ((exp + 112) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t float_to_half(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  uint32_t fexp = (f >> 23) & 0xffu;
+  uint32_t man = f & 0x7fffffu;
+  if (fexp == 0xff)  // inf / nan
+    return (uint16_t)(sign | 0x7c00u | (man ? 0x200u : 0));
+  int32_t exp = (int32_t)fexp - 127 + 15;
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;  // underflows to zero
+    man |= 0x800000u;                      // half subnormal, RNE
+    uint32_t shift = (uint32_t)(14 - exp);
+    uint32_t hman = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (hman & 1))) hman++;
+    return (uint16_t)(sign | hman);
+  }
+  uint16_t h = (uint16_t)(sign | ((uint32_t)exp << 10) | (man >> 13));
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (h & 1))) h++;  // RNE; carries
+  return h;  // into exp correctly (mantissa overflow increments exponent)
+}
+
+static inline float bf16_to_float(uint16_t h) {
+  uint32_t f = (uint32_t)h << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t float_to_bf16(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  if ((f & 0x7fffffffu) > 0x7f800000u)      // nan: keep quiet, don't round
+    return (uint16_t)((f >> 16) | 0x40u);
+  f += 0x7fffu + ((f >> 16) & 1);           // round-to-nearest-even
+  return (uint16_t)(f >> 16);
+}
+
 // dtype-aware summation: dst += src. Plain loops; -O3 auto-vectorizes
 // (the reference uses OpenMP SIMD pragmas, cpu_reducer.cc:59-120).
 static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
@@ -206,10 +276,37 @@ static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
       for (size_t i = 0; i < bytes; ++i) d[i] += s[i];
       break;
     }
+    case F16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      size_t n = bytes / 2;
+      for (size_t i = 0; i < n; ++i)
+        d[i] = float_to_half(half_to_float(d[i]) + half_to_float(s[i]));
+      break;
+    }
+    case BF16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      size_t n = bytes / 2;
+      for (size_t i = 0; i < n; ++i)
+        d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
+      break;
+    }
+    case U16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      size_t n = bytes / 2;
+      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      break;
+    }
     default:
+      // Unreachable from the wire: DoInit rejects out-of-enum dtypes with
+      // an error reply before a store exists, and pushes use the store's
+      // dtype. Kept as a log (not the reference's CHECK/abort) so a future
+      // internal misuse can't let one bad request kill a shared server.
       std::fprintf(stderr, "[bps-server] unsupported dtype %u for sum\n",
                    dtype);
-      std::abort();
+      break;
   }
 }
 
@@ -489,6 +586,9 @@ struct CompressorCfg {
 
 struct Conn {
   int fd;
+  ~Conn() {
+    if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
+  }
   std::mutex write_mu;
   bool send_msg(const MsgHeader& h, const void* payload) {
     std::lock_guard<std::mutex> lk(write_mu);
@@ -618,8 +718,24 @@ class Server {
       tune_socket(fd);
       auto conn = std::make_shared<Conn>();
       conn->fd = fd;
-      std::lock_guard<std::mutex> lk(conns_mu_);
-      conn_threads_.emplace_back([this, conn] { ConnLoop(conn); });
+      // Conn threads self-reap: detached, with a shared tracker Join()
+      // waits on. A worker that suspends (elastic close without SHUTDOWN,
+      // client.py close(shutdown_servers=False)) ends its conn thread while
+      // the server keeps serving — a joinable-until-Join thread would leak
+      // (finished, never reaped) for the server's whole lifetime. The
+      // tracker is a shared_ptr so the epilogue never touches `this` after
+      // its decrement (the Server may be destroyed right after Join()).
+      auto trk = conn_tracker_;
+      {
+        std::lock_guard<std::mutex> lk(trk->mu);
+        trk->live++;
+      }
+      std::thread([this, conn, trk] {
+        ConnLoop(conn);
+        std::lock_guard<std::mutex> lk(trk->mu);
+        trk->live--;
+        trk->cv.notify_all();
+      }).detach();
     }
     Join();
     return 0;
@@ -629,9 +745,8 @@ class Server {
     for (auto& q : queues_) q->stop();
     for (auto& t : engine_threads_)
       if (t.joinable()) t.join();
-    std::lock_guard<std::mutex> lk(conns_mu_);
-    for (auto& t : conn_threads_)
-      if (t.joinable()) t.join();
+    std::unique_lock<std::mutex> lk(conn_tracker_->mu);
+    conn_tracker_->cv.wait(lk, [this] { return conn_tracker_->live == 0; });
   }
 
  private:
@@ -737,6 +852,17 @@ class Server {
   void DoInit(EngineMsg& m) {
     // first push of a key allocates; reply withheld until every worker's
     // init push arrived (server.cc:266-295)
+    if (m.dtype > U16) {
+      // reject out-of-enum dtypes here, where the store would be created:
+      // a later steady-state push would hit sum_into's no-op default and
+      // silently publish the first worker's un-summed data as the
+      // aggregate (error-reply pattern as the length-mismatch path below)
+      std::fprintf(stderr, "[bps-server] init rejected key=%llu: unknown "
+                   "dtype %u\n", (unsigned long long)m.key, m.dtype);
+      MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+      m.conn->send_msg(r, nullptr);
+      return;
+    }
     std::vector<ParkedPull> release;
     std::vector<ParkedPull> stale;  // parked under the OLD length: error out
     {
@@ -1022,8 +1148,13 @@ class Server {
                           // per-key KeyStore::mu (finer than the
                           // reference's single handle_mu_, server.cc:208)
 
-  std::mutex conns_mu_;
-  std::vector<std::thread> conn_threads_;
+  struct ConnTracker {
+    std::mutex mu;
+    std::condition_variable cv;
+    int live = 0;
+  };
+  std::shared_ptr<ConnTracker> conn_tracker_ =
+      std::make_shared<ConnTracker>();
 
   std::mutex barrier_mu_;
   std::vector<ParkedPull> barrier_waiters_;

@@ -98,6 +98,81 @@ def test_two_workers_sum_and_parked_pull():
     c1.close()
 
 
+@pytest.mark.parametrize("dtype_name", ["float16", "bfloat16", "uint16"])
+def test_two_workers_16bit_sum(dtype_name):
+    """fp16/bf16/u16 summation on the server: the second worker's push hits
+    sum_into (the first is a COPY_FIRST memcpy), which the reference handles
+    with an AVX F16C convert-add-convert path (cpu_reducer.cc:59-120). Sums
+    must match numpy's same-dtype arithmetic bit-for-bit (both do f32
+    accumulate + round-to-nearest-even per element)."""
+    import ml_dtypes
+
+    if dtype_name == "float16":
+        npdt, wire_dt = np.float16, DataType.FLOAT16
+    elif dtype_name == "bfloat16":
+        npdt, wire_dt = ml_dtypes.bfloat16, DataType.BFLOAT16
+    else:
+        npdt, wire_dt = np.uint16, DataType.UINT16
+    cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL, wire_dt)
+
+    addrs, threads = start_servers(1, num_workers=2)
+    c0 = PSClient(addrs, worker_id=0)
+    c1 = PSClient(addrs, worker_id=1)
+    rng = np.random.RandomState(7)
+    if dtype_name == "uint16":
+        x0 = rng.randint(0, 30000, 512).astype(np.uint16)
+        x1 = rng.randint(0, 30000, 512).astype(np.uint16)
+        expect = (x0 + x1).view(np.uint16)
+    else:
+        # include subnormals, large values, and exact-halfway cases
+        x0 = (rng.randn(512) * 100).astype(npdt)
+        x1 = (rng.randn(512) * 100).astype(npdt)
+        x0[:4] = [npdt(6e-8), npdt(-6e-8), npdt(0), npdt(65000.0 if
+                  dtype_name == "float16" else 3e38)]
+        x1[:4] = [npdt(6e-8), npdt(6e-8), npdt(-0.0), npdt(65000.0 if
+                  dtype_name == "float16" else 3e38)]
+        expect = (x0 + x1).astype(npdt)
+
+    wire0 = x0.view(np.uint16)
+    wire1 = x1.view(np.uint16)
+    t = threading.Thread(
+        target=lambda: c1.init_key(0, 5, np.zeros(512, np.uint16), cmd))
+    t.start()
+    c0.init_key(0, 5, np.zeros(512, np.uint16), cmd)
+    t.join(timeout=10)
+
+    t = threading.Thread(target=lambda: c1.zpush(0, 5, wire1, cmd))
+    t.start()
+    c0.zpush(0, 5, wire0, cmd)
+    t.join(timeout=10)
+    out = np.empty(512, np.uint16)
+    c0.zpull(0, 5, out, cmd)
+    np.testing.assert_array_equal(out, expect.view(np.uint16))
+    c0.close()
+    c1.close()
+    for th in threads:
+        th.join(timeout=10)
+        assert not th.is_alive()
+
+
+def test_unknown_dtype_rejected_at_init():
+    """An out-of-enum wire dtype must be error-replied at init (before a
+    store exists) — otherwise a later steady-state push would no-op in
+    sum_into and silently publish un-summed data."""
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    bad_cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL, 99)
+    with pytest.raises(RuntimeError):
+        c.init_key(0, 11, np.zeros(16, np.float32), bad_cmd)
+    # the server survives and still serves valid traffic
+    c.init_key(0, 12, np.zeros(16, np.float32), CMD_F32)
+    c.zpush(0, 12, np.ones(16, np.float32), CMD_F32)
+    out = np.empty(16, np.float32)
+    c.zpull(0, 12, out, CMD_F32)
+    np.testing.assert_allclose(out, 1.0)
+    c.close()
+
+
 def test_multi_server_partitioned_tensor():
     """A 100KB tensor partitioned into 4KB keys spread across 3 servers
     through the registry's hashing, push_pulled at the tensor level."""
