@@ -88,6 +88,12 @@ class Config:
     telemetry_on: bool = True             # BYTEPS_TELEMETRY_ON
     debug_sample_tensor: str = ""         # BYTEPS_DEBUG_SAMPLE_TENSOR
 
+    # --- multi-process runtime (SURVEY §2.4: scheduler rendezvous ->
+    # jax.distributed coordination service) ---
+    num_processes: int = 1                # BYTEPS_NUM_PROCESS
+    process_id: int = 0                   # BYTEPS_PROCESS_ID (default: worker_id)
+    coord_port: int = 0                   # BYTEPS_COORD_PORT (0 = scheduler_port + 512)
+
     # --- TPU-specific (new) ---
     mesh_shape: str = ""                  # BYTEPS_TPU_MESH e.g. "dp=8" or "dp=4,tp=2"
     use_psum_scatter: bool = True         # hierarchical RS+AG instead of one psum
@@ -123,6 +129,10 @@ class Config:
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            num_processes=_env_int("BYTEPS_NUM_PROCESS", 1),
+            process_id=_env_int("BYTEPS_PROCESS_ID",
+                                _env_int("DMLC_WORKER_ID", 0)),
+            coord_port=_env_int("BYTEPS_COORD_PORT", 0),
             mesh_shape=_env_str("BYTEPS_TPU_MESH", ""),
             use_psum_scatter=_env_bool("BYTEPS_USE_PSUM_SCATTER", True),
         )

@@ -97,6 +97,20 @@ class GlobalState:
                 return
             refresh_level()
             self.config = config or Config.from_env()
+            # Multi-process topology: rendezvous at the coordination
+            # service (the reference's ps::StartPS + barrier,
+            # global.cc:283-297) before any device query.
+            if (self.config.num_processes > 1
+                    and self.config.role == "worker"):
+                from ..parallel import distributed as dist_mod
+                dist_mod.ensure_initialized(self.config)
+                # identity defaults follow the process grid when DMLC_*
+                # was not set (global-mesh mode has no "workers")
+                if self.config.num_workers <= 1:
+                    import dataclasses as _dc
+                    pid, pcount = dist_mod.process_identity()
+                    self.config = _dc.replace(
+                        self.config, num_workers=pcount, worker_id=pid)
             if self.registry is None:
                 self.registry = TensorRegistry(self.config)
             else:
@@ -104,11 +118,40 @@ class GlobalState:
                 # keep declaration order so keys stay stable
                 # (global.cc:431-436), but rebind the new config.
                 self.registry.redeclare_all(self.config)
-            self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
-                self.config.parsed_mesh() or None)
+            # PS mode with multiple processes: the mesh stays local to
+            # this process (ICI collectives intra-process; the DCN PS sums
+            # across processes — the reference's NCCL-intra + ps-lite-inter
+            # split). Global-mesh mode: one mesh over every process's
+            # devices, XLA collectives all the way.
+            if mesh is not None:
+                self.mesh = mesh
+            else:
+                local_only = (jax.process_count() > 1
+                              and self.config.num_servers > 0
+                              and self.config.role == "worker")
+                devices = jax.local_devices() if local_only else None
+                self.mesh = mesh_lib.make_mesh(
+                    self.config.parsed_mesh() or None, devices)
             if self.config.trace_on and self.tracer is None:
                 from ..utils.tracing import Tracer
                 self.tracer = Tracer(self.config)
+            if (self.config.num_servers > 0
+                    and self.config.role == "worker"
+                    and jax.process_count() > 1):
+                # PS mode must use a process-local mesh: a process-spanning
+                # mesh already sums across workers via XLA, and the PS
+                # round trip would sum the same values AGAIN (silent 2x
+                # gradients). Catches explicitly-passed meshes that bypass
+                # the local_only selection above.
+                me = jax.process_index()
+                if any(d.process_index != me
+                       for d in self.mesh.devices.flat):
+                    raise ValueError(
+                        "PS mode (num_servers > 0) requires a process-local "
+                        "mesh; the given mesh spans multiple processes, "
+                        "which would double-sum gradients (XLA collective "
+                        "+ PS). Use jax.local_devices() for the mesh, or "
+                        "set num_servers=0 for global-mesh mode.")
             if (not lazy and self.ps_client is None
                     and self.config.num_servers > 0
                     and self.config.role == "worker"):

@@ -41,6 +41,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -1226,8 +1227,44 @@ class ServerConn {
         return ~0u;
       }
     }
+    // Bounded wait: a live-but-silent server (e.g. a stale process from a
+    // previous job parked on an init barrier that can never complete)
+    // would otherwise wedge the worker forever. A dead connection already
+    // fails fast (RecvLoop's fail-all); this bounds the wedge case.
+    // BYTEPS_CLIENT_TIMEOUT_S <= 0 restores infinite waits.
+    static const long timeout_s = [] {
+      const char* e = ::getenv("BYTEPS_CLIENT_TIMEOUT_S");
+      return e && *e ? std::atol(e) : 600L;
+    }();
     std::unique_lock<std::mutex> lk(w->mu);
-    w->cv.wait(lk, [&] { return w->done; });
+    bool done;
+    if (timeout_s > 0) {
+      done = w->cv.wait_for(lk, std::chrono::seconds(timeout_s),
+                            [&] { return w->done; });
+    } else {
+      w->cv.wait(lk, [&] { return w->done; });
+      done = true;
+    }
+    if (!done) {
+      // abandon the request. Lock order: never take waiters_mu_ while
+      // holding w->mu (RecvLoop takes them in the other order).
+      lk.unlock();
+      bool still_ours;
+      {
+        std::lock_guard<std::mutex> lk2(waiters_mu_);
+        still_ours = waiters_.erase(rid) != 0;
+      }
+      lk.lock();
+      if (still_ours) {
+        std::fprintf(stderr, "[bps-client] request timeout op=%u key=%llu "
+                     "after %lds\n", op, (unsigned long long)key, timeout_s);
+        return ~0u;  // a late reply drains as unknown-rid junk
+      }
+      // RecvLoop claimed the waiter concurrently: the reply is being
+      // filled into `out` right now — must wait for done (imminent; a
+      // dying connection also sets it via fail-all).
+      w->cv.wait(lk, [&] { return w->done; });
+    }
     return w->ok ? w->got_len : ~0u;
   }
 

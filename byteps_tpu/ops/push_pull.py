@@ -108,6 +108,41 @@ def all_gather_tree(shard_tree: Any, shapes: Any, axis: str = DP_AXIS) -> Any:
 # eager Horovod-style API
 # ---------------------------------------------------------------------- #
 
+@functools.lru_cache(maxsize=64)
+def _mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh contains devices of more than one process
+    (global-mesh multi-process mode, parallel/distributed.py). Cached —
+    it's a pure function of the mesh and sits on the eager hot path."""
+    if jax.process_count() == 1:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _local_stack(tensor, mesh: Mesh, axis: str, stacked: bool, what: str):
+    """Assemble a process-spanning global array from this process's local
+    contribution: with ``stacked`` the input carries one slice per LOCAL
+    device; otherwise the local value is replicated onto the local devices.
+    Only the flat all-``axis`` mesh is supported eagerly — structured
+    layouts use the in-jit collectives directly."""
+    if tuple(mesh.axis_names) != (axis,):
+        raise ValueError(
+            f"multi-process eager {what} supports only a flat ('{axis}',) "
+            f"mesh, got {mesh.axis_names}")
+    n_local = sum(1 for d in mesh.devices.flat
+                  if d.process_index == jax.process_index())
+    xl = np.asarray(tensor)
+    if stacked:
+        if xl.ndim == 0 or xl.shape[0] != n_local:
+            raise ValueError(
+                f"stacked {what} expects leading dim {n_local} (local "
+                f"devices on '{axis}'), got shape {xl.shape}")
+    else:
+        xl = np.broadcast_to(xl, (n_local,) + xl.shape)
+    from ..parallel.distributed import global_batch
+    return global_batch(mesh, np.ascontiguousarray(xl), axis=axis)
+
+
 @functools.lru_cache(maxsize=512)
 def _cached_push_pull(mesh: Mesh, shape, dtype, average: bool, axis: str):
     """Build and cache a jitted shard_map that sums a (n_dev, *shape) stacked
@@ -143,14 +178,21 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
     mesh = state.mesh
     n = mesh.shape.get(axis, 1)
 
-    x = jnp.asarray(tensor)
-    if stacked:
-        if x.ndim == 0 or x.shape[0] != n:
-            raise ValueError(
-                f"stacked push_pull expects leading dim {n} (mesh '{axis}' "
-                f"size), got shape {x.shape}")
+    if _mesh_spans_processes(mesh):
+        # Global-mesh multi-process mode: this process contributes values
+        # for its own devices; the global array is assembled across
+        # processes (each worker feeds its minibatch) and the collective
+        # rides ICI/DCN via XLA.
+        x = _local_stack(tensor, mesh, axis, stacked, "push_pull")
     else:
-        x = jnp.broadcast_to(x, (n,) + x.shape)
+        x = jnp.asarray(tensor)
+        if stacked:
+            if x.ndim == 0 or x.shape[0] != n:
+                raise ValueError(
+                    f"stacked push_pull expects leading dim {n} (mesh "
+                    f"'{axis}' size), got shape {x.shape}")
+        else:
+            x = jnp.broadcast_to(x, (n,) + x.shape)
 
     if name is not None:
         ctx = state.registry.init_tensor(
@@ -206,14 +248,19 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
         raise RuntimeError("byteps_tpu.init() must be called before broadcast")
     mesh = state.mesh
     n = mesh.shape.get(axis, 1)
-    x = jnp.asarray(tensor)
-    if stacked:
-        if x.ndim == 0 or x.shape[0] != n:
-            raise ValueError(
-                f"stacked broadcast expects leading dim {n} (mesh '{axis}' "
-                f"size), got shape {x.shape}")
+    if _mesh_spans_processes(mesh):
+        # same local-stack contract as multi-process push_pull; root_rank
+        # indexes the GLOBAL device order on the axis
+        x = _local_stack(tensor, mesh, axis, stacked, "broadcast")
     else:
-        x = jnp.broadcast_to(x, (n,) + x.shape)
+        x = jnp.asarray(tensor)
+        if stacked:
+            if x.ndim == 0 or x.shape[0] != n:
+                raise ValueError(
+                    f"stacked broadcast expects leading dim {n} (mesh "
+                    f"'{axis}' size), got shape {x.shape}")
+        else:
+            x = jnp.broadcast_to(x, (n,) + x.shape)
     out = _cached_broadcast(mesh, root_rank % n, axis)(x)
 
     if state.ps_client is not None and state.config.num_workers > 1:
