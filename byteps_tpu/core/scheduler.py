@@ -139,13 +139,17 @@ class ScheduledQueue:
 
 class PartitionTask:
     """One partition of one push_pull — the reference's TensorTableEntry
-    (common.h:221-264) reduced to the DCN stages."""
+    (common.h:221-264) reduced to the DCN stages. ``stack`` (a host codec
+    stack, ops/compression/host.py) marks a compressed partition: it then
+    flows COMPRESS -> PUSH -> PULL -> DECOMPRESS instead of PUSH -> PULL,
+    exactly as the reference splices compression into the scheduled queue
+    list (operations.cc:199-204)."""
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
-                 "out_view", "group", "cmd")
+                 "out_view", "group", "cmd", "stack", "step", "wire")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
-                 group, cmd):
+                 group, cmd, stack=None, step=0):
         self.ctx: TensorContext = ctx
         self.partition: Partition = partition
         self.priority = priority
@@ -154,6 +158,9 @@ class PartitionTask:
         self.out_view = out_view   # np.uint8 view of the output slot
         self.group: "TaskGroup" = group
         self.cmd = cmd
+        self.stack = stack         # host codec stack or None (dense)
+        self.step = step           # compression round (seeds randomk/dither)
+        self.wire = None           # compressed wire bytes (COMPRESS output)
 
     @property
     def key(self) -> int:
@@ -244,79 +251,213 @@ class HandleManager:
 
 
 class PipelineScheduler:
-    """Stage-threaded push/pull pipeline over the PS client.
+    """Stage-pipelined push/pull over the PS client.
 
-    Each admitted partition runs PUSH then PULL on a pipeline worker; the
-    priority queue decides admission order and the credit bounds in-flight
-    bytes — so a high-priority (front-layer) gradient overtakes queued bulk
-    traffic exactly as in the reference's scheduler.
+    The priority queue decides admission order and the credit bounds
+    in-flight bytes; once admitted, a partition flows through independent
+    per-stage thread pools with continuation passing —
+
+        [COMPRESS ->] PUSH -> PULL [-> DECOMPRESS]
+
+    — so the PULL of partition k overlaps the PUSH of partition k+1 (the
+    reference runs PUSH and PULL as separate stage loops with callbacks,
+    core_loops.cc:538-618) and codec work never blocks a network thread
+    (COMPRESS/DECOMPRESS spliced into the pipeline as in
+    operations.cc:199-204). Credit is held from admission until PULL (and
+    DECOMPRESS, if any) completes.
     """
 
     def __init__(self, client, num_threads: int = 8,
                  credit_bytes: int = 0, tracer=None, telemetry=None,
                  config=None):
+        import concurrent.futures
+        import os
+
         self._client = client
         self._queue = ScheduledQueue(credit_bytes)
         self._tracer = tracer
         self._telemetry = telemetry
         self._config = config
-        self._threads = [
-            threading.Thread(target=self._worker, name=f"bps-sched-{i}",
-                             daemon=True)
-            for i in range(num_threads)
-        ]
-        for t in self._threads:
-            t.start()
+        n_codec = min(8, max(2, (os.cpu_count() or 4) // 2))
+        self._push_pool = concurrent.futures.ThreadPoolExecutor(
+            num_threads, thread_name_prefix="bps-push")
+        self._pull_pool = concurrent.futures.ThreadPoolExecutor(
+            num_threads, thread_name_prefix="bps-pull")
+        self._codec_pool = concurrent.futures.ThreadPoolExecutor(
+            n_codec, thread_name_prefix="bps-codec")
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self._inflight_cv = threading.Condition(self._inflight_mu)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch, name="bps-sched-dispatch", daemon=True)
+        self._dispatcher.start()
 
-    def _worker(self) -> None:
+    # ---- stage plumbing ------------------------------------------------ #
+
+    def _dispatch(self) -> None:
+        """Admission loop: the only consumer of the scheduled queue, so
+        credit+priority order is decided in one place; admitted tasks are
+        handed to the first stage pool and flow via continuations."""
         while True:
             task = self._queue.get_task()
             if task is None:
                 return
-            name = task.ctx.name
-            err = None
-            try:
-                if self._config is not None:
-                    from ..utils.logging import debug_sample
-                    debug_sample(self._config, name,
-                                 f"PUSH.{task.partition.index}",
-                                 task.in_view, task.ctx.dtype.np_dtype)
-                if self._tracer:
-                    self._tracer.begin(name, f"PUSH.{task.partition.index}")
-                self._client.zpush(task.partition.server, task.key,
-                                   task.in_view, task.cmd)
-                if self._tracer:
-                    self._tracer.end(name, f"PUSH.{task.partition.index}")
-                    self._tracer.begin(name, f"PULL.{task.partition.index}")
+            with self._inflight_mu:
+                self._inflight += 1
+            if task.stack is not None:
+                self._submit_stage(self._codec_pool, self._do_compress, task)
+            else:
+                self._submit_stage(self._push_pool, self._do_push, task)
+
+    def _submit_stage(self, pool, fn, task) -> None:
+        try:
+            fut = pool.submit(fn, task)
+        except RuntimeError as e:  # pool shut down mid-flight
+            self._finish(task, e)
+            return
+
+        def _on_done(f):
+            if f.cancelled():
+                self._finish(task, RuntimeError("scheduler stopped"))
+
+        fut.add_done_callback(_on_done)
+
+    def _span(self, task, stage):
+        return f"{stage}.{task.partition.index}"
+
+    def _do_compress(self, task: PartitionTask) -> None:
+        name = task.ctx.name
+        span = self._span(task, "COMPRESS")
+        if self._tracer:
+            self._tracer.begin(name, span)
+        try:
+            from ..server.compressed import compress_partition
+            task.wire = compress_partition(task.stack, task.in_view,
+                                           task.step)
+        except Exception as e:  # noqa: BLE001 - forwarded to waiter
+            self._finish(task, e)
+            return
+        finally:
+            if self._tracer:  # end in finally: no dangling span on error
+                self._tracer.end(name, span)
+        self._submit_stage(self._push_pool, self._do_push, task)
+
+    def _do_push(self, task: PartitionTask) -> None:
+        name = task.ctx.name
+        span = self._span(task, "PUSH")
+        try:
+            buf = task.wire if task.wire is not None else task.in_view
+            if self._config is not None and task.stack is None:
+                from ..utils.logging import debug_sample
+                debug_sample(self._config, name, span,
+                             task.in_view, task.ctx.dtype.np_dtype)
+        except Exception as e:  # noqa: BLE001
+            self._finish(task, e)
+            return
+        if self._tracer:
+            self._tracer.begin(name, span)
+        try:
+            self._client.zpush(task.partition.server, task.key, buf,
+                               task.cmd)
+        except Exception as e:  # noqa: BLE001
+            self._finish(task, e)
+            return
+        finally:
+            if self._tracer:
+                self._tracer.end(name, span)
+        self._submit_stage(self._pull_pool, self._do_pull, task)
+
+    def _do_pull(self, task: PartitionTask) -> None:
+        name = task.ctx.name
+        span = self._span(task, "PULL")
+        if self._tracer:
+            self._tracer.begin(name, span)
+        try:
+            if task.stack is not None:
+                reply = np.empty(task.stack.wire_bytes(), np.uint8)
+                self._client.zpull(task.partition.server, task.key, reply,
+                                   task.cmd)
+                task.wire = reply
+            else:
                 self._client.zpull(task.partition.server, task.key,
                                    task.out_view, task.cmd)
-                if self._tracer:
-                    self._tracer.end(name, f"PULL.{task.partition.index}")
-                if self._config is not None:
-                    from ..utils.logging import debug_sample
-                    debug_sample(self._config, name,
-                                 f"PULL.{task.partition.index}",
-                                 task.out_view, task.ctx.dtype.np_dtype)
-            except Exception as e:  # noqa: BLE001 - forwarded to waiter
-                err = e
-            finally:
-                self._queue.report_finish(task)
-                if self._telemetry:
-                    self._telemetry.record(task.nbytes * 2)
-                task.group.partition_done(err)
+        except Exception as e:  # noqa: BLE001
+            self._finish(task, e)
+            return
+        finally:
+            if self._tracer:
+                self._tracer.end(name, span)
+        if task.stack is None and self._config is not None:
+            try:
+                from ..utils.logging import debug_sample
+                debug_sample(self._config, name, span,
+                             task.out_view, task.ctx.dtype.np_dtype)
+            except Exception as e:  # noqa: BLE001
+                self._finish(task, e)
+                return
+        if task.stack is not None:
+            self._submit_stage(self._codec_pool, self._do_decompress, task)
+        else:
+            self._finish(task, None)
+
+    def _do_decompress(self, task: PartitionTask) -> None:
+        name = task.ctx.name
+        span = self._span(task, "DECOMPRESS")
+        if self._tracer:
+            self._tracer.begin(name, span)
+        try:
+            from ..server.compressed import decompress_partition
+            decompress_partition(task.stack, task.wire, task.out_view)
+        except Exception as e:  # noqa: BLE001
+            self._finish(task, e)
+            return
+        finally:
+            if self._tracer:
+                self._tracer.end(name, span)
+        self._finish(task, None)
+
+    def _finish(self, task: PartitionTask, err: Optional[Exception]) -> None:
+        self._queue.report_finish(task)
+        if self._telemetry:
+            if task.stack is not None:
+                self._telemetry.record(task.stack.wire_bytes() * 2)
+            else:
+                self._telemetry.record(task.nbytes * 2)
+        with self._inflight_mu:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_cv.notify_all()
+        task.group.partition_done(err)
+
+    # ---- submission ---------------------------------------------------- #
 
     def submit(self, ctx: TensorContext, flat_in: np.ndarray,
                handle: Handle, average: bool, num_workers: int,
-               version: int = 0, priority: Optional[int] = None) -> None:
+               version: int = 0, priority: Optional[int] = None,
+               comp=None) -> None:
         """Enqueue all partitions of one tensor; fills ``handle`` when the
         last partition completes. ``priority=None`` uses the layer-order
         default -declared_key (tensorflow/ops.cc:155-158); an explicit
-        value overrides it (higher = sooner)."""
+        value overrides it (higher = sooner).
+
+        ``comp``: a server.compressed.CompressedTensor — its partitions
+        then carry per-partition codec stacks through the COMPRESS/
+        DECOMPRESS stages (sub-min-compress-bytes partitions stay dense),
+        and the compression round counter seeds the stateful codecs.
+        """
         from .types import DataType, RequestType, get_command_type
 
-        self._client.ensure_init(ctx, flat_in.nbytes)
+        if comp is not None:
+            step = comp.begin_round()  # installs codecs on first call
+            flat_in = np.ascontiguousarray(flat_in, np.float32)
+        else:
+            step = 0
+            self._client.ensure_init(ctx, flat_in.nbytes)
         cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
                                DataType.from_np(flat_in.dtype))
+        cmd_comp = get_command_type(
+            RequestType.COMPRESSED_PUSH_PULL,
+            DataType.from_np(flat_in.dtype)) if comp is not None else cmd
         out = np.empty_like(flat_in)
         in_view = flat_in.view(np.uint8)
         out_view = out.view(np.uint8)
@@ -332,12 +473,14 @@ class PipelineScheduler:
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
         if priority is None:
             priority = -ctx.declared_key
-        for p in ctx.partitions:
+        for i, p in enumerate(ctx.partitions):
+            stack = comp.stacks[i] if comp is not None else None
             task = PartitionTask(
                 ctx, p, priority, version,
                 in_view[p.offset:p.offset + p.length],
                 out_view[p.offset:p.offset + p.length],
-                group, cmd)
+                group, cmd_comp if stack is not None else cmd,
+                stack=stack, step=step)
             try:
                 self._queue.add_task(task)
             except RuntimeError as e:
@@ -348,7 +491,13 @@ class PipelineScheduler:
     def stop(self) -> None:
         # stop() atomically flips the flag and fails queued-but-unstarted
         # tasks, so outstanding synchronize() callers get an error instead
-        # of waiting forever
+        # of waiting forever; then cancel not-yet-running stage work (the
+        # done-callback fails their tasks) and give in-flight network calls
+        # a bounded grace to drain before the caller frees the client.
         self._queue.stop()
-        for t in self._threads:
-            t.join(timeout=5)
+        self._dispatcher.join(timeout=5)
+        for pool in (self._codec_pool, self._push_pool, self._pull_pool):
+            pool.shutdown(wait=False, cancel_futures=True)
+        with self._inflight_cv:
+            self._inflight_cv.wait_for(lambda: self._inflight == 0,
+                                       timeout=5)

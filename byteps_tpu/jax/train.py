@@ -234,12 +234,21 @@ def make_ps_train_step(
         loss, grads = grad_fn(params, batch)
         if client is not None:
             paths, treedef = jax.tree_util.tree_flatten_with_path(grads)
-            hosts, names = [], []
+            names, leaves = [], []
             for path, leaf in paths:
                 names.append("grad/" + "/".join(
                     str(getattr(k, "key", getattr(k, "idx", k)))
                     for k in path))
-                hosts.append(np.asarray(leaf))
+                leaves.append(leaf)
+            # start ALL D2H copies now; each np.asarray below then only
+            # waits for ITS leaf, so the transfer of leaf k+1 rides the
+            # bus while leaf k is already in PUSH — the reference's
+            # per-partition COPYD2H/PUSH overlap (core_loops.cc:378-443)
+            # done with device_get futures instead of a D2H stage thread.
+            for leaf in leaves:
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            reg = None
             if compression is not None:
                 if comp_state["client"] is not client:
                     from ..server.compressed import CompressedRegistry
@@ -250,40 +259,43 @@ def make_ps_train_step(
                         client, state.config.num_workers, compression, mcb)
                     comp_state["client"] = client
                 reg = comp_state["registry"]
-                pool = _comp_pool()
-                futures = [
-                    pool.submit(
-                        reg.push_pull, state, name,
-                        h.reshape(-1).astype(np.float32, copy=False),
-                        True)
-                    for name, h in zip(names, hosts)
-                ]
-                results = [
-                    f.result().reshape(h.shape)
-                    for f, h in zip(futures, hosts)
-                ]
-            elif state.scheduler is not None:
-                # pipelined: all tensors' partitions enter the priority-
-                # scheduled queue at once; PUSH/PULL of different
-                # partitions overlap on the stage threads
-                import byteps_tpu as bps
-                handles = [
-                    bps.push_pull_async(h, name, average=True)
-                    for name, h in zip(names, hosts)
-                ]
-                results = [bps.synchronize(hd) for hd in handles]
-            else:
+            # one submit-as-ready loop for all three transports: dense or
+            # compressed partitions enter the priority-scheduled pipeline
+            # (compressed ones through COMPRESS/DECOMPRESS stages,
+            # operations.cc:199-204); the no-scheduler fallbacks overlap
+            # on a pool / run blocking.
+            import byteps_tpu as bps
+
+            def submit(name, flat):
+                if reg is not None:
+                    flat = flat.astype(np.float32, copy=False)
+                    if state.scheduler is not None:
+                        hd = reg.push_pull_async(state, name, flat, True)
+                        return lambda: bps.synchronize(hd)
+                    fut = _comp_pool().submit(
+                        reg.push_pull, state, name, flat, True)
+                    return fut.result
+                if state.scheduler is not None:
+                    hd = bps.push_pull_async(flat, name, average=True)
+                    return lambda: bps.synchronize(hd)
                 from ..server.client import ps_round_trip
-                results = [
-                    ps_round_trip(state, name, h.reshape(-1), average=True)
-                    .reshape(h.shape)
-                    for name, h in zip(names, hosts)
-                ]
+                out = ps_round_trip(state, name, flat, average=True)
+                return lambda: out
+
+            waiters, shapes = [], []
+            for name, leaf in zip(names, leaves):
+                h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
+                shapes.append(h.shape)
+                waiters.append(submit(name, h.reshape(-1)))
+            results = [w().reshape(shape)
+                       for w, shape in zip(waiters, shapes)]
             grads = treedef.unflatten(results)
         params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, loss
 
-    return step
+    # tick the Chrome-trace step counter: the PUSH/PULL/COMPRESS spans the
+    # scheduler records are windowed by step (BYTEPS_TRACE_START/END_STEP)
+    return _with_tracer_tick(step)
 
 
 def make_async_ps_train_step(
@@ -363,7 +375,7 @@ def make_async_ps_train_step(
         params = treedef.unflatten(pulled)
         return params, opt_state, loss
 
-    return step
+    return _with_tracer_tick(step)
 
 
 def init_zero_state(params, tx: optax.GradientTransformation, mesh: Mesh,

@@ -78,8 +78,21 @@ def get_or_init_ctx(state, name: str, host: np.ndarray) -> TensorContext:
 def ps_round_trip(state, name: str, host: np.ndarray,
                   average: bool) -> np.ndarray:
     """Shared get-or-declare + server round-trip for one flat host tensor:
-    used by both the eager push_pull PS tier and make_ps_train_step."""
+    used by both the eager push_pull PS tier and make_ps_train_step.
+
+    Fans the partitions out through the priority-scheduled pipeline when
+    one is running (so eager callers get the same credit/priority semantics
+    and PUSH/PULL stage overlap as the async API), falling back to the
+    client's blocking fan-out otherwise."""
     ctx = get_or_init_ctx(state, name, host)
+    host = np.ascontiguousarray(host)
+    if state.scheduler is not None and state.handles is not None:
+        handle = state.handles.allocate(name)
+        state.scheduler.submit(ctx, host, handle, average,
+                               state.config.num_workers,
+                               version=state.next_version(name))
+        # scheduler records telemetry per-partition on completion
+        return state.handles.wait_and_clear(handle.id)
     out = state.ps_client.push_pull(
         ctx, host, average=average, num_workers=state.config.num_workers)
     state.telemetry.record(host.nbytes * 2)

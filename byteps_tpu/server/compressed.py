@@ -35,6 +35,22 @@ CMD_COMP_F32 = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
 CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
 
 
+def compress_partition(stack, in_view_u8: np.ndarray,
+                       step: int) -> np.ndarray:
+    """One partition's wire payload: f32 view of the input bytes through the
+    codec stack. Shared by the blocking path and the scheduler's COMPRESS
+    stage so the wire format has exactly one producer."""
+    part = in_view_u8.view(np.float32)
+    return np.frombuffer(stack.compress(part, step), np.uint8)
+
+
+def decompress_partition(stack, reply_u8: np.ndarray,
+                         out_view_u8: np.ndarray) -> None:
+    """Decode one partition's reply payload into its output slot (f32
+    bytes). Shared by the blocking path and the DECOMPRESS stage."""
+    out_view_u8[:] = stack.decompress(reply_u8).view(np.uint8)
+
+
 class CompressedTensor:
     """Compressed PS round-trips for one named f32 tensor."""
 
@@ -47,6 +63,12 @@ class CompressedTensor:
         self.ctx = ctx
         self.num_workers = num_workers
         self.step = 0
+        # pinned scheduler priority: set on the first pipelined submit and
+        # reused for every later round — per-round priorities could reorder
+        # rounds of a STATEFUL codec (EF accumulators, randomk/dithering
+        # step seeds, the server's sync completed_rounds) in the admission
+        # heap, which same-key serialization alone does not prevent.
+        self.priority: Optional[int] = None
         self._lock = threading.Lock()
         # per-partition codec stacks; None = below min_compress_bytes,
         # dense path
@@ -59,14 +81,28 @@ class CompressedTensor:
                 self.stacks.append(make_host_codec(kwargs, n))
         self._installed = False
 
-    def _install(self, flat: np.ndarray) -> None:
+    def _install(self, nbytes: int) -> None:
         """Dense init-push (allocates the store, init barrier) then the
         per-key kwargs push."""
-        self.client.init_tensor(self.ctx, np.zeros_like(flat))
+        self.client.init_tensor(self.ctx,
+                                np.zeros(nbytes, np.uint8).view(np.float32))
         for p, stack in zip(self.ctx.partitions, self.stacks):
             if stack is not None:
                 self.client.comp_init(p.server, p.key, stack.kwargs_wire())
         self._installed = True
+
+    def begin_round(self) -> int:
+        """Claim the next compression round number (seeds the stateful
+        codecs and matches the server's completed_rounds in sync mode),
+        installing the server-side codecs on first use. Called by the
+        pipeline scheduler before enqueuing this tensor's partitions."""
+        with self._lock:
+            if not self._installed:
+                last = self.ctx.partitions[-1]
+                self._install(last.offset + last.length)
+            step = self.step
+            self.step += 1
+            return step
 
     def push_pull(self, flat: np.ndarray, average: bool = True) -> np.ndarray:
         """One compressed aggregation round; returns the decompressed
@@ -78,7 +114,7 @@ class CompressedTensor:
                              "CompressedTensor (stale partitioning)")
         with self._lock:
             if not self._installed:
-                self._install(flat)
+                self._install(flat.nbytes)
             step = self.step
             self.step += 1
         out = np.empty_like(flat)
@@ -97,15 +133,14 @@ class CompressedTensor:
                     res = res / self.num_workers
                 out_view[lo:hi] = res.view(np.uint8)
                 return
-            part = view[lo:hi].view(np.float32)
-            wire = np.frombuffer(stack.compress(part, step), np.uint8)
+            wire = compress_partition(stack, view[lo:hi], step)
             self.client.zpush(p.server, p.key, wire, CMD_COMP_F32)
             reply = np.empty(stack.wire_bytes(), np.uint8)
             self.client.zpull(p.server, p.key, reply, CMD_COMP_F32)
-            res = stack.decompress(reply)
+            decompress_partition(stack, reply, out_view[lo:hi])
             if average and self.num_workers > 1:
-                res = res / self.num_workers
-            out_view[lo:hi] = res.view(np.uint8)
+                res = out_view[lo:hi].view(np.float32)
+                res /= self.num_workers
 
         futures = [
             self.client._pool.submit(one, p, s)
@@ -152,3 +187,24 @@ class CompressedRegistry:
         out = ct.push_pull(flat, average)
         state.telemetry.record(ct.wire_bytes() * 2)
         return out
+
+    def push_pull_async(self, state, name: str, flat: np.ndarray,
+                        average: bool = True,
+                        priority: Optional[int] = None) -> int:
+        """Submit a compressed push_pull through the priority-scheduled
+        pipeline (COMPRESS -> PUSH -> PULL -> DECOMPRESS stages with credit
+        admission — the reference's scheduled-queue splice,
+        operations.cc:199-204); returns an async handle id for
+        ``bps.synchronize``. Telemetry is recorded per-partition by the
+        scheduler."""
+        flat = np.ascontiguousarray(flat, np.float32)
+        ct = self.get(state, name, flat)
+        if ct.priority is None:
+            ct.priority = (priority if priority is not None
+                           else -ct.ctx.declared_key)
+        handle = state.handles.allocate(name)
+        handle._shape = flat.shape
+        state.scheduler.submit(
+            ct.ctx, flat, handle, average, self.num_workers,
+            version=state.next_version(name), priority=ct.priority, comp=ct)
+        return handle.id

@@ -181,3 +181,25 @@ def test_async_api_end_to_end(monkeypatch):
         bps.shutdown()
         server.join(timeout=10)
         GlobalState._instance = None
+
+
+def test_queue_priority_with_compressed_tasks():
+    """Compressed partitions obey the same (priority desc, key asc)
+    admission order as dense ones — compression rides the scheduled queue,
+    it doesn't bypass it (operations.cc:199-204)."""
+    from byteps_tpu.ops.compression.host import make_host_codec
+
+    q = ScheduledQueue()
+    stack = make_host_codec({"compressor": "onebit"}, 64)
+
+    def mk(key, priority, stack=None):
+        t = mk_task(key, priority)
+        t.stack = stack
+        return t
+
+    q.add_task(mk(3, -3, stack))          # compressed, least urgent
+    q.add_task(mk(1, -1))                 # dense, most urgent
+    q.add_task(mk(2, -2, stack))          # compressed, middle
+    got = [q.get_task() for _ in range(3)]
+    assert [t.key for t in got] == [1, 2, 3]
+    assert got[1].stack is stack and got[0].stack is None
