@@ -1,10 +1,25 @@
 """Benchmark entry point — prints ONE JSON line.
 
-Measures flagship-model (Llama ~125M) training throughput on the available
-device: full train step (fwd + bwd + adam), bf16 compute, remat, donated
-buffers. Mirrors the reference's synthetic-throughput vehicle
+Primary metric: flagship-model (Llama ~125M) training throughput on the
+available device: full train step (fwd + bwd + adam), bf16 compute, remat,
+donated buffers. Mirrors the reference's synthetic-throughput vehicle
 (example/pytorch/benchmark_byteps.py:25-31,110-140: mean over repeated
 timed batches).
+
+Extra keys in the same line:
+
+- ``mfu`` — model-FLOPs utilization: achieved model FLOP/s (6*matmul
+  params + causal attention term) over the chip's bf16 peak
+  (BASELINE.md "maximize" north-star; the reference reports relative
+  speedups only, docs/performance.md:5-11).
+- ``pushpull_dense_gbps`` / ``pushpull_onebit_gbps`` — the push_pull
+  micro north-star (BASELINE.md "maximize GB/s/chip"): a 256MB gradient
+  set through the full pipelined PS path (priority scheduler -> native
+  TCP client -> C++ server on loopback), reported as gradient
+  bytes x 2 / wall; the onebit figure is the EFFECTIVE rate (dense-
+  equivalent bytes moved per second while the wire carries 1/32 the
+  volume). Reference vehicle: benchmark_byteps.py push_pulls every
+  gradient; here the loopback server stands in for the DCN tier.
 
 ``vs_baseline`` compares against a recorded naive-fp32 single-chip
 measurement of the same workload on the same v5e hardware (51,810
@@ -21,6 +36,8 @@ donated buffers.
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 
 import jax
@@ -33,8 +50,27 @@ from byteps_tpu.models import llama
 # Naive-fp32 anchor measured on v5e-1 (see module docstring).
 BASELINE_TOKENS_PER_SEC = 51810.0
 
+# bf16 peak of the bench chip (v5e). Override with BENCH_PEAK_FLOPS when
+# running on different hardware (v5p: 459e12, v4: 275e12).
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
-def measure(B: int = 16, S: int = 1024, steps: int = 10) -> float:
+
+def model_flops_per_token(cfg: "llama.LlamaConfig", S: int) -> float:
+    """Model FLOPs per trained token: 6 x matmul params (fwd 2 + bwd 4)
+    plus the causal attention score/value term (QK^T + AV are each
+    2*S*d fwd per token; causal halves the useful work; x3 for bwd)."""
+    d, L = cfg.dim, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    per_layer = (d * nh * hd          # wq
+                 + 2 * d * nkv * hd   # wk, wv
+                 + nh * hd * d        # wo
+                 + 3 * d * cfg.hidden_dim)  # w1, w3, w2
+    mat = L * per_layer + d * cfg.vocab_size  # + lm_head
+    attn = L * 6 * S * d  # 12*S*d full, /2 causal
+    return 6.0 * mat + attn
+
+
+def measure(B: int = 16, S: int = 1024, steps: int = 10):
     cfg = llama.LlamaConfig.small(vocab_size=32000)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     tx = optax.adam(1e-3)
@@ -58,16 +94,99 @@ def measure(B: int = 16, S: int = 1024, steps: int = 10) -> float:
         params, opt, loss = stepj(params, opt, tokens)
     float(loss)
     dt = time.perf_counter() - t0
-    return B * S * steps / dt
+    tps = B * S * steps / dt
+    mfu = tps * model_flops_per_token(cfg, S) / PEAK_FLOPS
+    return tps, mfu
+
+
+def measure_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
+                     steps: int = 3):
+    """push_pull GB/s/chip through the full worker pipeline against a
+    loopback C++ server: 256MB of f32 gradients, 4MB partitions, priority
+    scheduling, counted as gradient bytes x 2 (push + pull) per second.
+    Dense wire + onebit effective rate."""
+    from byteps_tpu.config import Config
+    from byteps_tpu.core.state import GlobalState
+    from byteps_tpu.server import run_server
+    from byteps_tpu.server.compressed import CompressedRegistry
+    from byteps_tpu.utils.net import free_port
+
+    port = free_port()
+    env = {
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    server = threading.Thread(
+        target=run_server, args=(port, Config(num_workers=1, num_servers=1)),
+        daemon=True)
+    server.start()
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        per = total_bytes // n_tensors // 4
+        rng = np.random.RandomState(0)
+        grads = [rng.randn(per).astype(np.float32) for _ in range(n_tensors)]
+        nbytes = sum(g.nbytes for g in grads)
+
+        def best_of(fn) -> float:
+            """Best per-round GB/s over `steps` rounds: the capability
+            number, robust to single-core scheduler jitter on shared CI
+            hosts (per-round spread there can exceed 50%)."""
+            fn()  # warmup: init-push / comp_init handshake + allocation
+            best_dt = float("inf")
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                fn()
+                best_dt = min(best_dt, time.perf_counter() - t0)
+            return nbytes * 2 / best_dt / 1e9
+
+        def round_trip():
+            hs = [bps.push_pull_async(g, f"bench_g{i}", average=False)
+                  for i, g in enumerate(grads)]
+            for h in hs:
+                bps.synchronize(h, timeout=300)
+
+        dense_gbps = best_of(round_trip)
+
+        state = bps.core.state.get_state()
+        reg = CompressedRegistry(state.ps_client, 1,
+                                 {"compressor": "onebit"})
+
+        def comp_round():
+            hs = [reg.push_pull_async(state, f"bench_c{i}", g,
+                                      average=False)
+                  for i, g in enumerate(grads)]
+            for h in hs:
+                bps.synchronize(h, timeout=300)
+
+        onebit_gbps = best_of(comp_round)
+        return dense_gbps, onebit_gbps
+    finally:
+        bps.shutdown()
+        server.join(timeout=20)
+        GlobalState._instance = None
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def main() -> None:
-    tps = measure()
+    tps, mfu = measure()
+    dense_gbps, onebit_gbps = measure_pushpull()
     print(json.dumps({
         "metric": "llama125m_train_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 4),
+        "mfu": round(mfu, 4),
+        "pushpull_dense_gbps": round(dense_gbps, 3),
+        "pushpull_onebit_gbps": round(onebit_gbps, 3),
     }))
 
 

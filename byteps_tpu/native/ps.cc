@@ -47,6 +47,9 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#if defined(__linux__)
+#include <malloc.h>  // mallopt (the call itself is #ifdef-guarded too)
+#endif
 #include <cstring>
 #include <functional>
 #include <map>
@@ -160,6 +163,19 @@ static bool send_msg_iov(int fd, const MsgHeader& h, const void* payload) {
   }
   return true;
 }
+
+// Multi-MB partition buffers churn every round; glibc's default
+// M_MMAP_THRESHOLD (128KB) services each one with mmap and returns it
+// with munmap, so every allocation re-faults ~1K pages — on a small-core
+// host that dominates the loopback hot path. Raising the threshold keeps
+// partition-sized blocks on the heap free-lists where they recycle.
+static const bool malloc_tuned = [] {
+#ifdef M_MMAP_THRESHOLD
+  ::mallopt(M_MMAP_THRESHOLD, 64 << 20);
+  ::mallopt(M_TRIM_THRESHOLD, 128 << 20);
+#endif
+  return true;
+}();
 
 static void tune_socket(int fd) {
   int one = 1;
@@ -608,7 +624,9 @@ struct KeyStore {
   std::mutex mu;                 // per-key lock: sums/copies of different
                                  // keys must not serialize each other
   std::vector<uint8_t> accum;    // receiving buffer for the current round
-  std::vector<uint8_t> merged;   // buffer served to pulls
+  std::vector<uint8_t> merged;   // async-mode authoritative weights
+                                 // (mutated in place per push; sync-mode
+                                 // pulls are served from `pub` instead)
   uint32_t len = 0;
   uint32_t dtype = F32;
   uint32_t init_count = 0;       // init pushes seen
@@ -620,9 +638,16 @@ struct KeyStore {
   uint64_t total_pushes = 0;     // for priority scheduling
   // compression mirror (server.cc:92-118): set by COMP_INIT
   CompressorCfg comp;
-  std::vector<uint8_t> wire_merged;   // compressed aggregate for pulls
   std::vector<int32_t> round_idx;     // randomk: this round's indices
   std::vector<float> scratch;         // decompress buffer
+  // Published aggregates (sync mode): swapped atomically under `mu` at
+  // ALL_RECV, NEVER mutated afterwards — pulls send straight from the
+  // shared buffer with no per-request copy (the reference caches per-key
+  // response buffers for the same reason, server.cc:39-80); the refcount
+  // keeps a buffer alive across an in-flight send when the next round
+  // publishes a replacement.
+  std::shared_ptr<const std::vector<uint8_t>> pub;       // dense
+  std::shared_ptr<const std::vector<uint8_t>> pub_wire;  // compressed
 };
 
 struct EngineMsg {
@@ -886,13 +911,14 @@ class Server {
         ks.dtype = m.dtype;
         ks.accum.assign(ks.len, 0);
         ks.merged = m.payload;  // init value (typically zeros or weights)
+        ks.pub = std::make_shared<std::vector<uint8_t>>(m.payload);
         ks.worker_push_count.assign(num_workers_, 0);
         ks.recv_count = 0;
         ks.completed_rounds = 0;
         // a resize invalidates any compressor (stale n): workers must
         // re-send COMP_INIT for the new length
         ks.comp = CompressorCfg();
-        ks.wire_merged.clear();
+        ks.pub_wire.reset();
         ks.round_idx.clear();
         ks.scratch.clear();
       }
@@ -933,14 +959,19 @@ class Server {
         // clear the captured randomk indices mid-aggregation
         if (!(ks.comp == cfg)) {
           ks.comp = cfg;
-          ks.wire_merged.assign(cfg.WireLen(), 0);
           ks.scratch.resize(cfg.n);
           ks.round_idx.clear();
+          // the dense ALL_RECV publishes by MOVING accum out; a key that
+          // ran dense rounds before COMP_INIT arrives here with an empty
+          // accum, and the compressed first-recv memcpys into it — make
+          // sure it is full-size again
+          if (ks.accum.size() != ks.len) ks.accum.assign(ks.len, 0);
           // publish a compressed view of the current aggregate so a pull
           // that precedes the first compressed round is answerable
-          ks.comp.Compress((const float*)ks.merged.data(),
-                           ks.wire_merged.data(), ks.completed_rounds,
-                           ks.round_idx);
+          auto w = std::make_shared<std::vector<uint8_t>>(cfg.WireLen());
+          ks.comp.Compress((const float*)ks.pub->data(), w->data(),
+                           ks.completed_rounds, ks.round_idx);
+          ks.pub_wire = std::move(w);
         }
       }
     }
@@ -970,6 +1001,11 @@ class Server {
         ks.worker_push_count[m.sender]++;
       DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
                  ks.comp.n * 4, F32);
+      // defensive resize: accum can be moved-out empty after a dense
+      // round on this key (ALL_RECV publish-by-move); the first recv of
+      // a compressed round writes the full dense length
+      if (ks.recv_count == 0 && ks.accum.size() != ks.len)
+        ks.accum.assign(ks.len, 0);
       float* accum = (float*)ks.accum.data();
       if (ks.recv_count == 0) {
         std::memcpy(accum, ks.scratch.data(),
@@ -981,12 +1017,26 @@ class Server {
       ks.recv_count++;
       if ((int)ks.recv_count >= num_workers_) {
         // ALL_RECV: recompress the dense aggregate (server.cc:345-375 with
-        // the compression hook of server.cc:92-118); keep the dense view
-        // in `merged` too so diagnostics stay meaningful
-        std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
-        DebugPrint("RECOMPRESS", m.key, ks.merged.data(), ks.len, F32);
-        ks.comp.Compress(accum, ks.wire_merged.data(),
+        // the compression hook of server.cc:92-118); publish the dense
+        // view by MOVING the accumulator (diagnostics + un-compressed
+        // pulls keep working), then restore a full-size accum for the
+        // next round's first scratch memcpy — stealing the previous
+        // published buffer when no in-flight send still references it
+        auto d = std::make_shared<std::vector<uint8_t>>(
+            std::move(ks.accum));
+        DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
+        auto w = std::make_shared<std::vector<uint8_t>>(ks.comp.WireLen());
+        ks.comp.Compress((const float*)d->data(), w->data(),
                          ks.completed_rounds, ks.round_idx);
+        if (ks.pub && ks.pub.use_count() == 1 &&
+            ks.pub->size() == ks.len) {
+          ks.accum = std::move(
+              *std::const_pointer_cast<std::vector<uint8_t>>(ks.pub));
+        } else {
+          ks.accum.assign(ks.len, 0);
+        }
+        ks.pub = std::move(d);
+        ks.pub_wire = std::move(w);
         ks.recv_count = 0;
         ks.completed_rounds++;
         flush.swap(ks.parked_pulls);
@@ -1048,16 +1098,24 @@ class Server {
         DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV", m.key,
                    m.payload.data(), (uint32_t)m.payload.size(), ks.dtype);
         if (ks.recv_count == 0) {
-          std::memcpy(ks.accum.data(), m.payload.data(), m.payload.size());
+          // first push of the round ADOPTS the payload buffer (no copy;
+          // the reference memcpys here, server.cc:329-333 — a buffer
+          // move is the TPU-host upgrade since the payload vector is
+          // already ours)
+          ks.accum = std::move(m.payload);
         } else {
           sum_into(ks.accum.data(), m.payload.data(), m.payload.size(),
                    ks.dtype);
         }
         ks.recv_count++;
         if ((int)ks.recv_count >= num_workers_) {
-          // ALL_RECV: publish and flush parked pulls (server.cc:345-375)
-          std::memcpy(ks.merged.data(), ks.accum.data(), ks.len);
-          DebugPrint("ALL_RECV", m.key, ks.merged.data(), ks.len, ks.dtype);
+          // ALL_RECV: publish by MOVING the accumulator into the shared
+          // published slot (no copy); accum is left empty — the next
+          // round's first push adopts its own payload buffer anyway
+          auto d = std::make_shared<std::vector<uint8_t>>(
+              std::move(ks.accum));
+          DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
+          ks.pub = std::move(d);
           ks.recv_count = 0;
           ks.completed_rounds++;
           flush.swap(ks.parked_pulls);
@@ -1078,16 +1136,37 @@ class Server {
   }
 
   void AnswerPull(KeyStore& ks, const ParkedPull& p) {
-    // merged is stable between rounds; the copy races only with the next
-    // round's ALL_RECV memcpy, which the key mutex serializes
-    std::vector<uint8_t> snapshot;
+    if (async_) {
+      // async: merged mutates in place on every push; snapshot under the
+      // key lock so the send reads a consistent weight vector
+      std::vector<uint8_t> snapshot;
+      {
+        std::lock_guard<std::mutex> lk(ks.mu);
+        snapshot = ks.merged;
+      }
+      MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
+                  (uint32_t)snapshot.size()};
+      p.conn->send_msg(r, snapshot.data());
+      return;
+    }
+    // sync: zero-copy — ALL_RECV swaps the published shared_ptr and never
+    // mutates the published bytes, so the send can read the buffer
+    // outside the key lock; the refcount pins it across the send even if
+    // the next round publishes a replacement (reference: cached per-key
+    // response buffers, server.cc:39-80)
+    std::shared_ptr<const std::vector<uint8_t>> snap;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
-      snapshot = p.compressed ? ks.wire_merged : ks.merged;
+      snap = p.compressed ? ks.pub_wire : ks.pub;
+    }
+    if (!snap) {  // defensive: pull answered before any init
+      MsgHeader r{kMagic, ACK, 1, 0, p.rid, 0, 0, 0};
+      p.conn->send_msg(r, nullptr);
+      return;
     }
     MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
-                (uint32_t)snapshot.size()};
-    p.conn->send_msg(r, snapshot.data());
+                (uint32_t)snap->size()};
+    p.conn->send_msg(r, snap->data());
   }
 
   void DoPull(EngineMsg& m) {
@@ -1329,68 +1408,100 @@ class Client {
   bool Connect(const std::vector<std::pair<std::string, int>>& servers,
                int worker_id) {
     worker_id_ = (uint16_t)worker_id;
-    conns_.resize(servers.size());
+    // Stripe traffic over several TCP connections per server: one stream
+    // serializes all partitions on one send mutex + one kernel TCP flow;
+    // K streams spread the copy/checksum work over cores and keep the
+    // pipe full while a peer stream waits on an ack (the reference gets
+    // the same effect from ps-lite's multi-connection van). Safe because
+    // the protocol is rid-multiplexed and the worker's per-key ordering
+    // comes from the blocking push-then-pull call sequence, not from
+    // connection FIFO.
+    int k = 4;
+    if (const char* e = ::getenv("BYTEPS_CLIENT_CONNS")) {
+      k = std::atoi(e);
+      if (k < 1) k = 1;
+      if (k > 16) k = 16;
+    }
+    groups_.clear();
     for (size_t i = 0; i < servers.size(); ++i) {
-      conns_[i] = std::make_unique<ServerConn>();
-      if (!conns_[i]->Connect(servers[i].first, servers[i].second))
-        return false;
+      auto g = std::make_unique<ConnGroup>();
+      for (int j = 0; j < k; ++j) {
+        auto c = std::make_unique<ServerConn>();
+        if (!c->Connect(servers[i].first, servers[i].second)) return false;
+        g->conns.push_back(std::move(c));
+      }
+      groups_.push_back(std::move(g));
     }
     return true;
   }
 
   void Close() {
-    for (auto& c : conns_)
-      if (c) c->Close();
+    for (auto& g : groups_)
+      for (auto& c : g->conns)
+        if (c) c->Close();
   }
 
   int InitKey(int server, uint64_t key, const void* data, uint32_t len,
               uint32_t cmd) {
-    uint32_t r = conns_[server]->Request(INIT_PUSH, key, cmd, worker_id_,
-                                         data, len, nullptr, 0);
+    uint32_t r = pick(server)->Request(INIT_PUSH, key, cmd, worker_id_,
+                                       data, len, nullptr, 0);
     return r == ~0u ? -1 : 0;
   }
 
   int CompInit(int server, uint64_t key, const char* kwargs) {
-    uint32_t r = conns_[server]->Request(COMP_INIT, key, 0, worker_id_,
-                                         kwargs, (uint32_t)strlen(kwargs),
-                                         nullptr, 0);
+    uint32_t r = pick(server)->Request(COMP_INIT, key, 0, worker_id_,
+                                       kwargs, (uint32_t)strlen(kwargs),
+                                       nullptr, 0);
     return r == ~0u ? -1 : 0;
   }
 
   int Push(int server, uint64_t key, const void* data, uint32_t len,
            uint32_t cmd) {
-    uint32_t r = conns_[server]->Request(PUSH, key, cmd, worker_id_, data,
-                                         len, nullptr, 0);
+    uint32_t r = pick(server)->Request(PUSH, key, cmd, worker_id_, data,
+                                       len, nullptr, 0);
     return r == ~0u ? -1 : 0;
   }
 
   int Pull(int server, uint64_t key, void* out, uint32_t out_len,
            uint32_t cmd) {
-    uint32_t r = conns_[server]->Request(PULL, key, cmd, worker_id_, nullptr,
-                                         0, out, out_len);
+    uint32_t r = pick(server)->Request(PULL, key, cmd, worker_id_, nullptr,
+                                       0, out, out_len);
     return r == ~0u ? -1 : (int)r;
   }
 
   int Barrier() {
     // barrier rides connection 0 (the root server coordinates)
-    uint32_t r = conns_[0]->Request(BARRIER, 0, 0, worker_id_, nullptr, 0,
-                                    nullptr, 0);
+    uint32_t r = groups_[0]->conns[0]->Request(BARRIER, 0, 0, worker_id_,
+                                               nullptr, 0, nullptr, 0);
     return r == ~0u ? -1 : 0;
   }
 
   int Shutdown() {
+    // exactly ONE shutdown per server per worker: the server counts
+    // SHUTDOWN messages against num_workers, so the stripe conns must
+    // not inflate the count (their sockets just close afterwards)
     int rc = 0;
-    for (auto& c : conns_) {
-      if (c->Request(SHUTDOWN, 0, 0, worker_id_, nullptr, 0, nullptr, 0) ==
-          ~0u)
+    for (auto& g : groups_) {
+      if (g->conns[0]->Request(SHUTDOWN, 0, 0, worker_id_, nullptr, 0,
+                               nullptr, 0) == ~0u)
         rc = -1;
     }
     return rc;
   }
 
  private:
+  struct ConnGroup {
+    std::vector<std::unique_ptr<ServerConn>> conns;
+    std::atomic<uint32_t> rr{0};
+  };
+
+  ServerConn* pick(int server) {
+    ConnGroup& g = *groups_[server];
+    return g.conns[g.rr.fetch_add(1) % g.conns.size()].get();
+  }
+
   uint16_t worker_id_ = 0;
-  std::vector<std::unique_ptr<ServerConn>> conns_;
+  std::vector<std::unique_ptr<ConnGroup>> groups_;
 };
 
 }  // namespace bps

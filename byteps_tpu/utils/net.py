@@ -1,0 +1,16 @@
+"""Small networking helpers shared by benchmarks, tests and launchers."""
+
+from __future__ import annotations
+
+import socket
+
+
+def free_port() -> int:
+    """An ephemeral loopback port (bind-probe then release). Subject to
+    the usual reuse race; callers that must be robust should retry on
+    bind failure."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

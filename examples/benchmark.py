@@ -4,7 +4,19 @@ Mirrors example/pytorch/benchmark_byteps.py:110-140: repeated timed batches,
 per-iter throughput lines, mean +- 1.96 sigma summary, scaled totals.
 Models: mlp | resnet50 | bert | llama | moe (byteps_tpu.models zoo).
 
+The timed step exercises the REAL communication path, exactly like the
+reference (benchmark_byteps.py push_pulls every gradient via
+DistributedOptimizer): gradients ride the in-jit mesh collective
+(distributed_optimizer inside make_train_step), and when a DCN PS is
+configured (DMLC_NUM_SERVER > 0) the step is make_ps_train_step — local
+ICI reduce, then the pipelined PUSH/PULL of every gradient through the
+server. ``--no-comm`` restores the old compute-only step for A/B-ing the
+communication overhead.
+
     python examples/benchmark.py --model llama --num-iters 5
+
+Scaling efficiency across real worker processes: see
+examples/benchmark_scaling.py (reference: README.md:34-40).
 """
 
 from __future__ import annotations
@@ -79,6 +91,9 @@ def main() -> None:
     ap.add_argument("--num-warmup-batches", type=int, default=3)
     ap.add_argument("--num-batches-per-iter", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--no-comm", action="store_true",
+                    help="compute-only step (no gradient push_pull) for "
+                         "A/B-ing the communication overhead")
     args = ap.parse_args()
 
     bps.init()
@@ -89,18 +104,42 @@ def main() -> None:
 
     params, batch, loss_fn = build(args.model, args.batch_size)
     tx = optax.adam(1e-3)
-    opt = tx.init(params)
 
-    def train_step(p, o, b):
-        loss, g = jax.value_and_grad(loss_fn)(p, b)
-        u, o = tx.update(g, o, p)
-        return optax.apply_updates(p, u), o, loss
+    from byteps_tpu.core.state import get_state
+    from byteps_tpu.jax import distributed_optimizer
+    from byteps_tpu.jax.train import make_ps_train_step, make_train_step
 
-    stepj = jax.jit(train_step, donate_argnums=(0, 1))
+    state = get_state()
+    if args.no_comm:
+        comm = "none (--no-comm)"
+        opt = tx.init(params)
+
+        def train_step(p, o, b):
+            loss, g = jax.value_and_grad(loss_fn)(p, b)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
+
+        stepj = jax.jit(train_step, donate_argnums=(0, 1))
+    elif state.ps_client is not None:
+        # DCN PS tier: every gradient leaves the chip and rides the
+        # pipelined PUSH/PULL through the server (the reference vehicle's
+        # actual dataflow, benchmark_byteps.py:110-140)
+        comm = "DCN PS (pipelined push_pull)"
+        opt = tx.init(params)
+        stepj = make_ps_train_step(loss_fn, tx, state.mesh)
+    else:
+        # in-jit mesh collective: distributed_optimizer's psum rides ICI;
+        # batch is sharded on dp inside make_train_step (each device gets
+        # batch/n_dev rows — per-worker batch semantics preserved)
+        comm = "mesh collective (psum in-jit)"
+        dtx = distributed_optimizer(tx)
+        opt = dtx.init(params)
+        stepj = make_train_step(loss_fn, dtx, state.mesh)
 
     log(f"Model: {args.model}")
     log(f"Batch size: {args.batch_size}")
     log(f"Number of workers: {bps.size()}")
+    log(f"Comm path: {comm}")
 
     log("Running warmup...")
     loss = None

@@ -1,0 +1,144 @@
+"""Scaling-efficiency harness: 1 vs N worker processes through the DCN PS.
+
+The reference's headline number is multi-worker scaling efficiency
+(README.md:34-40: BERT-large ~90% at 256 GPUs; throughput ~ min(server bw,
+worker bw), docs/best-practice.md:41-44). This harness measures the same
+quantity at laptop scale: it spawns a loopback C++ PS server plus 1 and
+then N real worker OS processes (each a CPU-device JAX runtime), times the
+same synchronous PS training step in both configs, and reports
+
+    efficiency = throughput_N / (N * throughput_1)
+
+Real hardware note: on a multi-host TPU pod each worker is one host and
+the servers sit on separate CPU nodes, so the processes here map 1:1 to
+the real deployment; loopback just removes the network. A single-core CI
+box under-reports efficiency (N workers contend for the same core) — the
+number is a regression tracker there, not an absolute.
+
+    python examples/benchmark_scaling.py --workers 2 --steps 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from byteps_tpu.utils.net import free_port  # noqa: E402
+
+_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ["BM_DEVICES"]))
+import numpy as np
+import jax.numpy as jnp
+import optax
+import byteps_tpu as bps
+from byteps_tpu.core.state import get_state
+from byteps_tpu.jax.train import make_ps_train_step
+from byteps_tpu.models import mlp
+
+bps.init()
+state = get_state()
+cfg = mlp.MLPConfig(in_dim=int(os.environ["BM_DIM"]),
+                    hidden=(int(os.environ["BM_HIDDEN"]),) * 2,
+                    n_classes=10)
+params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+tx = optax.sgd(0.01)
+opt = tx.init(params)
+rng = np.random.RandomState(bps.rank())
+B = int(os.environ["BM_BATCH"])
+batch = {"x": jnp.asarray(rng.rand(B, cfg.in_dim), jnp.float32),
+         "y": jnp.asarray(rng.randint(0, 10, B), jnp.int32)}
+step = make_ps_train_step(lambda p, b: mlp.loss_fn(p, b, cfg), tx, state.mesh)
+steps = int(os.environ["BM_STEPS"])
+for _ in range(3):
+    params, opt, loss = step(params, opt, batch)
+float(loss)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt, loss = step(params, opt, batch)
+float(loss)
+dt = time.perf_counter() - t0
+print("BM_RESULT", bps.rank(), B * steps / dt, flush=True)
+bps.shutdown()
+"""
+
+
+def run_config(n_workers: int, args) -> float:
+    """One measurement: a server + n synchronous workers over loopback;
+    returns total examples/sec across workers."""
+    port = free_port()
+    common = {
+        **os.environ,
+        "DMLC_NUM_WORKER": str(n_workers), "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+        "BYTEPS_CLIENT_TIMEOUT_S": "300",
+        "BM_DEVICES": str(args.devices), "BM_BATCH": str(args.batch_size),
+        "BM_STEPS": str(args.steps), "BM_DIM": str(args.dim),
+        "BM_HIDDEN": str(args.hidden),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    common.pop("XLA_FLAGS", None)
+    srv_env = {**common, "JAX_PLATFORMS": "cpu"}
+    srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
+                           env=srv_env, cwd=REPO,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.STDOUT)
+    time.sleep(0.5)
+    workers = []
+    try:
+        for i in range(n_workers):
+            env = {**common, "DMLC_WORKER_ID": str(i)}
+            env.pop("JAX_PLATFORMS", None)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER], env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        total = 0.0
+        for i, w in enumerate(workers):
+            out, _ = w.communicate(timeout=600)
+            if w.returncode != 0:
+                raise SystemExit(
+                    f"worker {i} failed (rc={w.returncode}):\n{out[-3000:]}")
+            for line in out.splitlines():
+                if line.startswith("BM_RESULT"):
+                    total += float(line.split()[2])
+        srv.wait(timeout=30)
+        return total
+    finally:
+        for p in [srv, *workers]:
+            if p.poll() is None:
+                p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=1,
+                    help="virtual CPU devices per worker")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=512)
+    args = ap.parse_args()
+
+    print(f"Measuring 1-worker baseline ({args.steps} steps)...", flush=True)
+    t1 = run_config(1, args)
+    print(f"1 worker:  {t1:.1f} examples/sec")
+    print(f"Measuring {args.workers}-worker config...", flush=True)
+    tn = run_config(args.workers, args)
+    eff = tn / (args.workers * t1) if t1 > 0 else 0.0
+    print(f"{args.workers} workers: {tn:.1f} examples/sec (total)")
+    print(f"Scaling efficiency: {100 * eff:.1f}% "
+          f"(= {tn:.1f} / {args.workers} x {t1:.1f})")
+
+
+if __name__ == "__main__":
+    main()

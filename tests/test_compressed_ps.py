@@ -419,3 +419,30 @@ def test_compressed_through_scheduler_pipeline(monkeypatch):
         bps.shutdown()
         server.join(timeout=10)
         GlobalState._instance = None
+
+
+def test_dense_rounds_then_compression_same_key():
+    """A key that ran dense rounds and then installs a compressor must
+    keep working: the dense ALL_RECV publishes the accumulator by moving
+    it out, and the compressed first-recv must re-size it, not memcpy
+    into a moved-out buffer (regression: heap corruption)."""
+    n = 1024
+    port, t = _server(1)
+    c = PSClient([f"127.0.0.1:{port}"], worker_id=0)
+    ctx = _ctx("g", n * 4, 1)
+    rng = np.random.RandomState(8)
+    x = rng.randn(n).astype(np.float32)
+    # dense rounds first (same keys the compressor will reuse)
+    c.init_tensor(ctx, np.zeros(n, np.float32))
+    out = c.push_pull(ctx, x.copy(), average=False)
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    # now install compression on the SAME key and run compressed rounds
+    kw = {"compressor": "onebit"}
+    ct = CompressedTensor(c, ctx, kw, 1)
+    out = ct.push_pull(x, average=False)
+    want = _golden_aggregate(kw, [x], n)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    out2 = ct.push_pull(x, average=False)  # second round exercises steal
+    np.testing.assert_allclose(out2, want, rtol=1e-6)
+    c.close()
+    t.join(timeout=10)
