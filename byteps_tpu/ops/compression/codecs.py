@@ -139,8 +139,19 @@ class RandomkCodec(Codec):
 
     k: int = 1
     seed: int = 0
+    use_pallas: bool = True
 
     def _indices(self, step) -> jnp.ndarray:
+        # the kernel pays one pallas launch and computes full 32K-lane
+        # blocks, so it only wins once k spans at least a block; small k
+        # (the common 1%-of-partition case) stays on the jnp elementwise
+        # path — both are bit-exact with the numpy golden
+        if self.use_pallas and self.k >= 32768 and _on_tpu():
+            from .pallas_kernels import randomk_indices
+            from .rng import uniform_base
+            return randomk_indices(
+                jnp.asarray(uniform_base(self.seed, step)),
+                jnp.int32(self.size), self.k)
         u = jnp_uniform_parallel(self.seed, self.k, mix=step)
         return jnp.minimum((u * self.size).astype(jnp.int32), self.size - 1)
 
@@ -168,6 +179,7 @@ class DitheringCodec(Codec):
     partition: str = "linear"     # or "natural"
     normalize: str = "max"        # or "l2"
     seed: int = 0
+    use_pallas: bool = True       # fused VPU quantize kernel on TPU
 
     def __post_init__(self):
         if not (1 <= self.s <= 127):
@@ -190,6 +202,14 @@ class DitheringCodec(Codec):
             safe_m = jnp.maximum(m, 1e-30)
             norm = safe_m * jnp.sqrt(jnp.sum(jnp.square(absx / safe_m)))
         norm = jnp.maximum(norm, 1e-30)
+        if self.use_pallas and _on_tpu():
+            # fused VPU pass: in-register counter RNG + quantize, one read
+            # of x and one write of the levels (pallas_kernels)
+            from .pallas_kernels import dithering_levels
+            from .rng import uniform_base
+            base = jnp.asarray(uniform_base(self.seed, step))
+            levels = dithering_levels(x, norm, base, self.s, self.partition)
+            return {"levels": levels, "norm": norm.astype(jnp.float32)}
         scaled = absx / norm                           # in [0, 1]
         # counter-based parallel uniforms: per-element noise needs no
         # sequential stream, and the O(n)-depth xorshift scan would dwarf

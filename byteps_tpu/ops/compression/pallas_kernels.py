@@ -115,3 +115,144 @@ def tpu_available() -> bool:
         return jax.devices()[0].platform == "tpu"
     except Exception:  # noqa: BLE001
         return False
+
+
+# ------------------------------------------------------------------ #
+# counter-based RNG codecs: dithering + randomk
+#
+# The per-element cost of these codecs is the murmur3 counter RNG plus
+# the quantization arithmetic (reference: impl/dithering.cc:25-80,
+# impl/randomk.cc:24-60 — OpenMP host loops). Here both fuse into one
+# VPU pass: the uniform is derived in-register from the element's global
+# index (rng.py np_uniform_parallel semantics, bit-exact), so compress
+# reads x once and writes the levels once — no separate RNG pass or
+# materialized uniforms in HBM.
+# ------------------------------------------------------------------ #
+
+_MM3_C1 = 0x85EBCA6B
+_MM3_C2 = 0xC2B2AE35
+_GOLDEN = 0x9E3779B1
+
+
+def _kernel_uniform(gidx_u32):
+    """murmur3-finalizer uniform in [0,1) from a uint32 counter; bit-exact
+    with rng.jnp_uniform_parallel (base already folded into the counter by
+    the caller)."""
+    h = gidx_u32
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(_MM3_C1)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(_MM3_C2)
+    h = h ^ (h >> jnp.uint32(16))
+    # Mosaic has no uint32->f32 cast; the top-24-bit value fits int32, so
+    # bitcast and convert from there (exact for [0, 2^24))
+    h24 = pltpu.bitcast(h >> jnp.uint32(8), jnp.int32)
+    return h24.astype(jnp.float32) / float(1 << 24)
+
+
+def _global_counter(base_u32, block_rows: int):
+    """uint32 counter i*GOLDEN + base for each element of this grid block
+    (row-major global element index)."""
+    rid = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 0)
+    lid = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 1)
+    gidx = (jnp.uint32(pl.program_id(0)) * jnp.uint32(block_rows) + rid) \
+        * jnp.uint32(_LANES) + lid
+    return gidx * jnp.uint32(_GOLDEN) + base_u32
+
+
+def _dither_linear_kernel(x_ref, fparams_ref, base_ref, out_ref):
+    x = x_ref[:]
+    norm, s = fparams_ref[0], fparams_ref[1]
+    u = _kernel_uniform(_global_counter(base_ref[0], _BLOCK_ROWS))
+    # identical op order to DitheringCodec.compress (linear) so levels
+    # stay bit-equal: scaled = |x|/norm; pos = scaled*s; stochastic round
+    pos = (jnp.abs(x) / norm) * s
+    floor = jnp.floor(pos)
+    level = floor + (u < (pos - floor)).astype(jnp.float32)
+    level = jnp.minimum(level, s)
+    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int32)
+
+
+def _dither_natural_kernel(x_ref, fparams_ref, base_ref, out_ref):
+    x = x_ref[:]
+    norm = fparams_ref[0]
+    u = _kernel_uniform(_global_counter(base_ref[0], _BLOCK_ROWS))
+    scaled = jnp.abs(x) / norm
+    safe = jnp.maximum(scaled, 1e-30)
+    j = jnp.clip(jnp.floor(-jnp.log2(safe)), 0.0, 30.0)
+    low = jnp.exp2(-j - 1.0)
+    high = jnp.exp2(-j)
+    frac = (scaled - low) / (high - low)
+    exp = jnp.where(u < frac, j, j + 1.0)
+    # literal 2^-31: a scalar jnp.exp2 constant trips Mosaic's math-dialect
+    # lowering (it expects a vector operand)
+    level = jnp.where(scaled < jnp.float32(2.0 ** -31), 0.0, exp + 1.0)
+    level = jnp.clip(level, 0.0, 126.0)
+    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def dithering_levels(x: jnp.ndarray, norm: jnp.ndarray, base: jnp.ndarray,
+                     s: int, partition: str = "linear",
+                     interpret: bool = False) -> jnp.ndarray:
+    """Fused stochastic quantization: flat f32 [n] -> int8 signed levels
+    [n]. ``norm`` is the (max or l2) scale computed by the caller; ``base``
+    is the uint32 RNG base (seed-state low word XOR step) so the uniforms
+    bit-match jnp_uniform_parallel(seed, n, mix=step)."""
+    n = x.shape[0]
+    rows = _padded_rows(n)
+    padded = jnp.zeros((rows * _LANES,), jnp.float32).at[:n].set(x)
+    x2d = padded.reshape(rows, _LANES)
+    fparams = jnp.stack([norm.astype(jnp.float32),
+                         jnp.float32(s)])
+    base_arr = jnp.asarray(base, jnp.uint32).reshape(1)
+    kernel = (_dither_linear_kernel if partition == "linear"
+              else _dither_natural_kernel)
+
+    levels = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d, fparams, base_arr)
+    return levels.reshape(-1)[:n].astype(jnp.int8)
+
+
+def _randomk_idx_kernel(base_ref, size_ref, out_ref):
+    u = _kernel_uniform(_global_counter(base_ref[0], _BLOCK_ROWS))
+    size = size_ref[0]
+    idx = (u * size.astype(jnp.float32)).astype(jnp.int32)
+    out_ref[:] = jnp.minimum(idx, size - 1)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def randomk_indices(base: jnp.ndarray, size: jnp.ndarray, k: int,
+                    interpret: bool = False):
+    """k pseudo-random indices in [0, size) from the counter RNG —
+    bit-exact with RandomkCodec._indices / HostRandomk.indices. ``base``
+    is the uint32 RNG base (rng.uniform_base(seed, step)); ``size`` the
+    uncompressed element count."""
+    rows = _padded_rows(k)
+    base_arr = jnp.asarray(base, jnp.uint32).reshape(1)
+    size_arr = jnp.asarray(size, jnp.int32).reshape(1)
+    idx = pl.pallas_call(
+        _randomk_idx_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(base_arr, size_arr)
+    return idx.reshape(-1)[:k]

@@ -115,6 +115,20 @@ def _np_mm3(h: np.ndarray) -> np.ndarray:
     return h
 
 
+def uniform_base(seed: int, mix=0):
+    """The uint32 counter base of the parallel uniform generator:
+    seed-state low word XOR mix. THE single definition — numpy golden, jnp
+    path and the Pallas kernels all derive their counters from this, and
+    worker/server randomk index agreement depends on them staying
+    identical. ``mix`` may be a traced scalar; the return is a jnp scalar
+    then."""
+    s0, _ = seed_state(seed)
+    low = s0 & 0xFFFFFFFF
+    if isinstance(mix, (int, np.integer)):
+        return np.uint32(low) ^ np.uint32(mix & 0xFFFFFFFF)
+    return jnp.uint32(low) ^ jnp.asarray(mix).astype(jnp.uint32)
+
+
 def np_uniform_parallel(seed: int, n: int, mix: int = 0,
                         dtype=np.float32) -> np.ndarray:
     """Counter-based parallel uniforms: murmur3 finalizer over
@@ -122,8 +136,7 @@ def np_uniform_parallel(seed: int, n: int, mix: int = 0,
     so it is the right generator for per-element noise (dithering's
     Bernoulli rounding) where no cross-party stream agreement is needed,
     only np/jnp bit-parity. Golden model."""
-    s0, _ = seed_state(seed)
-    base = np.uint32(s0 & 0xFFFFFFFF) ^ np.uint32(mix & 0xFFFFFFFF)
+    base = uniform_base(seed, mix)
     with np.errstate(over="ignore"):
         h = (np.arange(n, dtype=np.uint32) * np.uint32(0x9E3779B1) + base) \
             & np.uint32(0xFFFFFFFF)
@@ -134,8 +147,7 @@ def np_uniform_parallel(seed: int, n: int, mix: int = 0,
 def jnp_uniform_parallel(seed: int, n: int, mix=0,
                          dtype=jnp.float32) -> jnp.ndarray:
     """Bit-exact jnp twin of np_uniform_parallel; ``mix`` may be traced."""
-    s0, _ = seed_state(seed)
-    base = jnp.uint32(s0 & 0xFFFFFFFF) ^ jnp.asarray(mix).astype(jnp.uint32)
+    base = jnp.asarray(uniform_base(seed, mix))
     h = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x9E3779B1) + base
     h = h ^ (h >> jnp.uint32(16))
     h = h * jnp.uint32(0x85EBCA6B)
