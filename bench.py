@@ -30,7 +30,15 @@ with no single-chip equivalent.
 
 Tuning applied vs the anchor: bf16 activations/logits, logsumexp-form
 cross entropy (llama.next_token_xent), B=16 batch (MXU utilization),
-donated buffers.
+donated buffers, head_dim=128 attention layout (identical params/FLOPs;
+hd=64 wastes half of each 128-lane register tile — measured +40%), bf16
+adam first moment. Measured-but-rejected: Pallas flash attention (slower
+than XLA's fused dense attention at S=1024 on v5e), scan unroll, B=32.
+Ceiling context: bare bf16 matmuls at this model's shapes (K=768) reach
+112-148 TF/s on v5e (not the 197 headline, which needs K>=4096), so the
+shape-mix-achievable MFU is ~0.6-0.75; we measure ~0.34 end-to-end with
+the remainder going to attention softmax HBM traffic, rmsnorm/rope VPU
+work, remat recompute and the optimizer pass.
 """
 
 from __future__ import annotations
@@ -73,7 +81,9 @@ def model_flops_per_token(cfg: "llama.LlamaConfig", S: int) -> float:
 def measure(B: int = 16, S: int = 1024, steps: int = 10):
     cfg = llama.LlamaConfig.small(vocab_size=32000)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    tx = optax.adam(1e-3)
+    # bf16 first moment: halves adam's m-state HBM traffic; v is kept f32
+    # (variance needs the range), measured ~+1% step time on v5e
+    tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
     opt = tx.init(params)
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),

@@ -31,12 +31,12 @@ MAX_PARTITIONS = 1 << KEY_SHIFT
 
 
 def _hash_naive(s: str) -> int:
-    # Sum of the decimal digits of the key string (global.cc:600-607 analog:
-    # the reference hashes the stringified key; naive = atoi-style fold).
-    h = 0
-    for ch in s:
-        h = (h * 31 + ord(ch)) & 0xFFFFFFFF
-    return h
+    # The reference's Hash_Naive operates on the NUMERIC key:
+    # ((key >> 16) + (key % 65536)) * 9973 (global.cc:598-600) — so a
+    # cross-implementation deployment under BYTEPS_KEY_HASH_FN=naive picks
+    # identical servers.
+    key = int(s)
+    return (((key >> 16) + (key % 65536)) * 9973) & 0xFFFFFFFFFFFFFFFF
 
 
 def _hash_builtin(s: str) -> int:

@@ -38,6 +38,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32   # master weights
     remat: bool = True               # jax.checkpoint each block
+    # jax.checkpoint_policies name, e.g. "dots_with_no_batch_dims_saveable"
+    # (save projection outputs, recompute elementwise + attention einsums);
+    # None = full recompute. On the 125M bench both time the same; the
+    # policy trades activation memory back for recompute at larger scale.
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -56,9 +61,19 @@ class LlamaConfig:
 
     @staticmethod
     def small(vocab_size: int = 32000) -> "LlamaConfig":
-        """~125M benchmark config that fits one chip comfortably."""
+        """~125M benchmark config that fits one chip comfortably.
+
+        head_dim = 128 (6 heads), not the GPT-2-ish 64 (12 heads): the
+        TPU vector registers are 128 lanes wide, so hd=64 attention wastes
+        half of every lane-dim tile and measured 40% slower end-to-end on
+        v5e; parameter shapes and FLOPs are identical either way (wq is
+        (768, 768) and kv (768, 256) under both layouts). CAUTION: because
+        the shapes are identical, a checkpoint trained under the previous
+        12-head layout restores without error but is misinterpreted —
+        retrain or restore with an explicit LlamaConfig(n_heads=12,
+        n_kv_heads=4)."""
         return LlamaConfig(vocab_size=vocab_size, dim=768, n_layers=12,
-                           n_heads=12, n_kv_heads=4, hidden_dim=2048,
+                           n_heads=6, n_kv_heads=2, hidden_dim=2048,
                            max_seq_len=2048)
 
 
@@ -239,7 +254,10 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
     def body(x, layer_params):
         fn = _block
         if cfg.remat:
-            fn = jax.checkpoint(_block, static_argnums=(4, 5))
+            policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                      if cfg.remat_policy else None)
+            fn = jax.checkpoint(_block, static_argnums=(4, 5),
+                                policy=policy)
         # attn_impl is closed over (static); layer params come from scan
         return fn(x, layer_params, cos, sin, cfg, attn_impl), None
 

@@ -129,21 +129,15 @@ def tpu_available() -> bool:
 # materialized uniforms in HBM.
 # ------------------------------------------------------------------ #
 
-_MM3_C1 = 0x85EBCA6B
-_MM3_C2 = 0xC2B2AE35
-_GOLDEN = 0x9E3779B1
+_GOLDEN = 0x9E3779B1  # counter stride, must match rng.np_uniform_parallel
 
 
 def _kernel_uniform(gidx_u32):
     """murmur3-finalizer uniform in [0,1) from a uint32 counter; bit-exact
-    with rng.jnp_uniform_parallel (base already folded into the counter by
-    the caller)."""
-    h = gidx_u32
-    h = h ^ (h >> jnp.uint32(16))
-    h = h * jnp.uint32(_MM3_C1)
-    h = h ^ (h >> jnp.uint32(13))
-    h = h * jnp.uint32(_MM3_C2)
-    h = h ^ (h >> jnp.uint32(16))
+    with rng.jnp_uniform_parallel because it calls the same rng helper
+    (base already folded into the counter by the caller)."""
+    from .rng import mm3_finalize
+    h = mm3_finalize(gidx_u32)
     # Mosaic has no uint32->f32 cast; the top-24-bit value fits int32, so
     # bitcast and convert from there (exact for [0, 2^24))
     h24 = pltpu.bitcast(h >> jnp.uint32(8), jnp.int32)
@@ -170,7 +164,7 @@ def _dither_linear_kernel(x_ref, fparams_ref, base_ref, out_ref):
     floor = jnp.floor(pos)
     level = floor + (u < (pos - floor)).astype(jnp.float32)
     level = jnp.minimum(level, s)
-    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int32)
+    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int8)
 
 
 def _dither_natural_kernel(x_ref, fparams_ref, base_ref, out_ref):
@@ -188,7 +182,7 @@ def _dither_natural_kernel(x_ref, fparams_ref, base_ref, out_ref):
     # lowering (it expects a vector operand)
     level = jnp.where(scaled < jnp.float32(2.0 ** -31), 0.0, exp + 1.0)
     level = jnp.clip(level, 0.0, 126.0)
-    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int32)
+    out_ref[:] = (jnp.sign(x) * level).astype(jnp.int8)
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))
@@ -211,7 +205,7 @@ def dithering_levels(x: jnp.ndarray, norm: jnp.ndarray, base: jnp.ndarray,
 
     levels = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int8),
         grid=(rows // _BLOCK_ROWS,),
         in_specs=[
             pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
@@ -223,7 +217,7 @@ def dithering_levels(x: jnp.ndarray, norm: jnp.ndarray, base: jnp.ndarray,
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(x2d, fparams, base_arr)
-    return levels.reshape(-1)[:n].astype(jnp.int8)
+    return levels.reshape(-1)[:n]
 
 
 def _randomk_idx_kernel(base_ref, size_ref, out_ref):

@@ -105,6 +105,18 @@ def jnp_xorshift128p(seed: int, n: int, mix=0) -> Tuple[jnp.ndarray, jnp.ndarray
     return hi, lo
 
 
+def mm3_finalize(h):
+    """murmur3 finalizer over a uint32 jnp array — THE jnp definition,
+    shared by jnp_uniform_parallel and the Pallas kernels (plain jnp ops,
+    so Mosaic traces it directly); _np_mm3 below is the independent numpy
+    golden the parity tests check both against."""
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> jnp.uint32(16))
+
+
 def _np_mm3(h: np.ndarray) -> np.ndarray:
     with np.errstate(over="ignore"):
         h = h ^ (h >> np.uint32(16))
@@ -148,12 +160,8 @@ def jnp_uniform_parallel(seed: int, n: int, mix=0,
                          dtype=jnp.float32) -> jnp.ndarray:
     """Bit-exact jnp twin of np_uniform_parallel; ``mix`` may be traced."""
     base = jnp.asarray(uniform_base(seed, mix))
-    h = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x9E3779B1) + base
-    h = h ^ (h >> jnp.uint32(16))
-    h = h * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> jnp.uint32(13))
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> jnp.uint32(16))
+    h = mm3_finalize(jnp.arange(n, dtype=jnp.uint32)
+                     * jnp.uint32(0x9E3779B1) + base)
     return ((h >> jnp.uint32(8)).astype(jnp.float32) / float(1 << 24)).astype(dtype)
 
 

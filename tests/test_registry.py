@@ -103,3 +103,13 @@ def test_dtype_roundtrip():
     for dt in [DataType.FLOAT32, DataType.FLOAT16, DataType.INT32]:
         assert DataType.from_np(dt.np_dtype) == dt
     assert DataType.from_np(np.float32) == DataType.FLOAT32
+
+
+def test_hash_naive_matches_reference_formula():
+    """BYTEPS_KEY_HASH_FN=naive must reproduce the reference's
+    Hash_Naive(key) = ((key>>16) + (key%65536)) * 9973 (global.cc:598-600)
+    so mixed-implementation deployments pick the same servers."""
+    from byteps_tpu.core.registry import _hash_naive
+    for key in (0, 1, 65535, 65536, 1 << 16 | 5, 123456789):
+        want = (((key >> 16) + (key % 65536)) * 9973)
+        assert _hash_naive(str(key)) == want, key
