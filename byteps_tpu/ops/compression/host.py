@@ -68,22 +68,24 @@ class HostOnebit(HostCodec):
         scale = np.float32(np.mean(np.abs(x))) if self.scaled \
             else np.float32(1.0)
         pad = (-self.n) % 32
-        signs = np.empty(self.n + pad, np.uint32)
-        signs[: self.n] = (x >= 0)
+        signs = np.empty(self.n + pad, np.uint8)
+        np.greater_equal(x, 0, out=signs[: self.n])
         signs[self.n:] = 1  # zero-pad compresses as +1 (codecs.py parity)
-        words = signs.reshape(-1, 32)
-        weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
-        bits = (words * weights[None, :]).sum(axis=1, dtype=np.uint32)
+        # packbits(bitorder='little') is byte-identical to the u32-LE
+        # "bit i of word w = element w*32+i" wire layout (LE word bytes
+        # ARE the ascending bit groups) and runs at C memory speed —
+        # the explicit weights-multiply fold was 3x slower
+        bits = np.packbits(signs, bitorder="little")
         return bits.tobytes() + scale.tobytes()
 
     def decompress(self, buf) -> np.ndarray:
         raw = np.frombuffer(buf, np.uint8)
-        bits = raw[:-4].view(np.uint32)
         scale = raw[-4:].view(np.float32)[0]
-        weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
-        signs = (bits[:, None] & weights[None, :]) > 0
-        flat = np.where(signs, np.float32(1.0), np.float32(-1.0))
-        return (flat.reshape(-1)[: self.n] * scale).astype(np.float32)
+        signs = np.unpackbits(raw[:-4], bitorder="little",
+                              count=self.n)
+        # 2-entry LUT gather: 3x faster than np.where with scalar
+        # operands at multi-MB sizes
+        return np.array([-scale, scale], np.float32)[signs]
 
     def wire_bytes(self) -> int:
         return ((self.n + 31) // 32) * 4 + 4
@@ -233,6 +235,9 @@ class HostErrorFeedback:
     def compress(self, x: np.ndarray, step: int = 0) -> bytes:
         corrected = x.astype(np.float32) + self.error
         buf = self.codec.compress(corrected, step)
+        # the reference fuses this as FastUpdateError (onebit.cc:113-140);
+        # in numpy the "fused" form is the same unpack+gather+subtract
+        # passes, so the plain decompose keeps one wire parser
         self.error = corrected - self.codec.decompress(
             np.frombuffer(buf, np.uint8))
         return buf

@@ -1,0 +1,92 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the framework's two long-context strategies (the other is
+parallel/ring_attention.py). The reference has neither (SURVEY.md §5.7:
+sequence parallelism is green-field for the TPU build); this follows the
+DeepSpeed-Ulysses scheme (arXiv:2309.14509): with the sequence sharded
+over the ``sp`` mesh axis, two all-to-alls re-shard q/k/v from
+sequence-split to HEAD-split, every device then runs ordinary dense
+attention over the FULL sequence for its subset of heads, and a final
+all-to-all restores sequence sharding.
+
+Trade-off vs ring attention: Ulysses moves activations twice through
+all-to-all (cheap on ICI's all-to-all-friendly torus) and reuses the
+plain fused attention kernel — best when heads >= axis size and the
+sequence fits one device's memory for score blocks; ring attention
+streams KV around the ring with O(1) extra memory — best at extreme
+sequence lengths. Both are exact; pick per workload.
+
+Usage matches make_ring_attn: pass as ``attn_impl`` to models.llama
+forward/loss_fn with ``sp_axis`` set, inside shard_map with the batch
+pre-shifted and sequence-sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import SP_AXIS
+
+
+def _dense_causal(q, k, v, causal: bool):
+    """Plain attention over full sequence; q/k/v [B, S, H, D] (same head
+    count — GQA expansion happens before the all-to-all)."""
+    B, S, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis: str = SP_AXIS,
+                      causal: bool = True) -> jnp.ndarray:
+    """Exact attention with the sequence sharded over ``axis`` via head
+    re-sharding. q [B, S_local, H, D], k/v [B, S_local, Hkv, D] with
+    Hkv | H; H must be divisible by the axis size. Must run inside
+    shard_map with ``axis`` bound; returns [B, S_local, H, D].
+
+    Sequence chunks concatenate in device order along the axis, so RoPE
+    global positions (models.llama.forward's sp_axis slicing) line up
+    with the causal mask.
+    """
+    n = jax.lax.axis_size(axis)
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses requires n_heads ({H}) divisible by the '{axis}' "
+            f"axis size ({n}); use ring attention otherwise")
+    groups = H // k.shape[2]
+    if groups > 1:
+        # expand GQA groups so every device gets whole (q-head, kv-head)
+        # pairs after the head split; costs kv bandwidth — ring attention
+        # is the bandwidth-optimal choice for small-kv configs
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+
+    def seq_to_heads(x):
+        # [B, S/P, H, D] -> [B, S, H/P, D]
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    q = seq_to_heads(q)
+    k = seq_to_heads(k)
+    v = seq_to_heads(v)
+    o = _dense_causal(q, k, v, causal)
+    # [B, S, H/P, D] -> [B, S/P, H, D]
+    return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_attn(axis: str = SP_AXIS, causal: bool = True):
+    """Bind ulysses_attention as a models.llama ``attn_impl``."""
+
+    def impl(q, k, v):
+        return ulysses_attention(q, k, v, axis=axis, causal=causal)
+
+    return impl

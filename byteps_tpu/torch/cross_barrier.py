@@ -1,0 +1,303 @@
+"""Barrier-crossing scheduled optimizer for the torch adapter.
+
+Re-creation of the reference's ``_CrossBarrier`` (byteps/torch/
+cross_barrier.py:28-225, the ByteScheduler idea, SOSP'19): instead of one
+global synchronize() barrier in ``step()``, every parameter gets its own
+lock; a poller thread applies the per-parameter optimizer update the
+moment that parameter's push_pull lands, and pre-forward hooks on each
+leaf module block only on the locks of that module's own parameters — so
+the NEXT iteration's forward of layer k overlaps the still-in-flight
+push_pulls of layers k+1..N. Crossing the barrier this way composes with
+the priority scheduler: front-of-model gradients are both scheduled first
+AND unblocked first.
+
+Because the poller applies updates itself, only optimizers whose update
+math is replicated here are supported: SGD, Adam, RMSprop (same
+restriction as the reference, cross_barrier.py:172-180).
+
+Usage follows the reference convention: call ``step()`` once at
+parameter-broadcast time (broadcast_optimizer_state does this) BEFORE
+training — step 0 runs the plain optimizer eagerly; from step 1 on, the
+poller owns all updates. Note the scheme's inherent trade (also present
+in the reference): a parameter may be updated in place while the tail of
+the CURRENT backward still runs; on real models the push_pull round trip
+outlives backward so this never bites, but autograd's saved-tensor
+version counter can flag it on toy-scale models with loopback servers.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+
+import numpy as np
+import torch
+
+from ..core.state import get_state
+from . import _submit, size
+
+
+class CrossBarrier:
+    """Wrap a ``byteps_tpu.torch.DistributedOptimizer`` so push_pull
+    completion drives per-parameter updates without a global barrier.
+
+    Args:
+        model: the training model (forward hooks are registered on it).
+        byteps_opt: a DistributedOptimizer-wrapped torch optimizer.
+        num_steps: total training steps (the poller drains and exits at
+            the final step, cross_barrier.py:81-88).
+    """
+
+    def __init__(self, model: torch.nn.Module, byteps_opt,
+                 num_steps: int = 10 ** 6):
+        self._model = model
+        self._opt = byteps_opt
+        self._step = 0
+        self._final_step = num_steps
+        self._locks = {p: threading.Lock()
+                       for p in self._opt._all_params()}
+        self._inflight: dict = {}
+        self._pushed_at: dict = {}   # param -> step of its last submit
+        self._poller_error: Exception = None
+        self._distributed = size() > 1 or get_state().scheduler is not None
+        if self._distributed:
+            # replace the optimizer's own synchronize-at-step hooks with
+            # submit-and-lock hooks feeding the poller
+            for ref in self._opt._hook_refs:
+                ref.remove()
+            self._opt._hook_refs.clear()
+            self._register_grad_hooks()
+            self._register_forward_hooks()
+            self._event_queue: "queue.Queue" = queue.Queue()
+            self._poller = threading.Thread(target=self._poll,
+                                            name="bps-crossbarrier",
+                                            daemon=True)
+            self._poller.start()
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    # ---- gradient side ------------------------------------------------ #
+
+    def _register_grad_hooks(self) -> None:
+        for p in self._opt._all_params():
+            if p.requires_grad:
+                self._opt._hook_refs.append(
+                    p.register_post_accumulate_grad_hook(
+                        self._make_hook()))
+
+    def _make_hook(self):
+        opt = self._opt
+
+        def hook(p: torch.Tensor):
+            opt._passes[p] = opt._passes.get(p, 0) + 1
+            if opt._passes[p] < opt._backward_passes_per_step:
+                return
+            opt._passes[p] = 0
+            self._push_pull_async(p)
+
+        return hook
+
+    def _push_pull_async(self, p: torch.Tensor) -> None:
+        opt = self._opt
+        name = opt._param_name.get(p, f"param.{id(p)}")
+        grad = p.grad
+        if opt._backward_passes_per_step > 1:
+            grad = grad / opt._backward_passes_per_step
+        comp, ctx = opt._compression.compress(grad)
+        host = comp.detach().cpu().numpy()
+        self._locks[p].acquire()
+        self._pushed_at[p] = self._step
+        h = _submit(host, "grad/" + name, True, None)
+        self._inflight[p] = h
+        self._event_queue.put((p, h, ctx, host.shape))
+
+    def _poll(self) -> None:
+        """FIFO completion poller (cross_barrier.py:161-190): when a
+        parameter's push_pull lands, write the reduced gradient, apply
+        ITS optimizer update, zero its grad, release its lock."""
+        while True:
+            item = self._event_queue.get()
+            if item[0] is None:
+                return
+            p, h, ctx, wire_shape = item
+            if not h.done():
+                self._event_queue.put(item)
+                time.sleep(0.0005)
+                continue
+            try:
+                out = h.wait().reshape(wire_shape)
+                t = torch.from_numpy(np.ascontiguousarray(out))
+                t = self._opt._compression.decompress(t, ctx)
+                with torch.no_grad():
+                    p.grad.copy_(t.to(p.grad.dtype).reshape(p.grad.shape))
+                self._update_one(p)
+                p.grad.zero_()
+            except Exception as e:  # noqa: BLE001 - re-raised in step()
+                self._poller_error = e
+                self._inflight.pop(p, None)
+                self._locks[p].release()
+                return
+            self._inflight.pop(p, None)
+            self._locks[p].release()
+
+    # ---- forward side -------------------------------------------------- #
+
+    def _register_forward_hooks(self) -> None:
+        """Pre-forward hook per leaf module: block until every one of the
+        module's parameters finished its update (cross_barrier.py:192-225)."""
+        leaves = []
+        stack = list(self._model.children()) or [self._model]
+        while stack:
+            mod = stack.pop()
+            kids = list(mod.children())
+            if kids:
+                stack.extend(kids)
+            else:
+                leaves.append(mod)
+
+        def pre_forward(mod, _inputs):
+            for p in mod.parameters(recurse=False):
+                lock = self._locks.get(p)
+                if lock is not None:
+                    with lock:
+                        pass
+
+        for mod in leaves:
+            mod.register_forward_pre_hook(pre_forward)
+
+    # ---- optimizer surface --------------------------------------------- #
+
+    def step(self, closure=None):
+        if not self._distributed:
+            self._step += 1
+            return self._opt.step(closure)
+        # step 0 runs eagerly so parameter-broadcast-time step() calls
+        # behave (cross_barrier.py:94-97); afterwards the poller applies
+        # all updates and step() only submits whatever backward missed
+        if self._poller_error is not None:
+            raise self._poller_error
+        if self._step > 0:
+            # submit whatever backward missed this step (the reference's
+            # _synchronize missing_p sweep, cross_barrier.py:128-139)
+            for p in self._opt._all_params():
+                if (p.requires_grad and p.grad is not None
+                        and self._pushed_at.get(p, -1) != self._step):
+                    self._push_pull_async(p)
+            if self._step == self._final_step:
+                self.drain()
+            loss = closure() if closure is not None else None
+            self._step += 1
+            return loss
+        # step 0 (parameter-broadcast time): run the USER optimizer's own
+        # step, skipping the DistributedOptimizer synchronize override
+        # (cross_barrier.py:94-97)
+        super(type(self._opt), self._opt).step()
+        self._step += 1
+        return None
+
+    def zero_grad(self) -> None:
+        # the poller zeroes each grad after applying its update; a global
+        # zero would race in-flight parameters (cross_barrier.py:99-107)
+        if not (self._distributed and self._step > 0):
+            self._opt.zero_grad()
+
+    def drain(self) -> None:
+        """Block until every in-flight push_pull applied, then stop the
+        poller (the reference's final-step path)."""
+        if not self._distributed:
+            return
+        while self._inflight and self._poller_error is None:
+            time.sleep(0.001)
+        self._event_queue.put((None, None, None, None))
+        self._poller.join(timeout=30)
+        if self._poller_error is not None:
+            raise self._poller_error
+
+    # ---- per-parameter update math (cross_barrier.py:227-330) ---------- #
+
+    def _group_of(self, p):
+        for group in self._opt.param_groups:
+            if any(q is p for q in group["params"]):
+                return group
+        raise KeyError("parameter not in optimizer groups")
+
+    @torch.no_grad()
+    def _update_one(self, p: torch.Tensor) -> None:
+        opt = self._opt
+        group = self._group_of(p)
+        # exact class identity of the wrapped user optimizer (the dynamic
+        # DistributedOptimizer subclass's immediate base) — isinstance
+        # would silently accept subclasses with DIFFERENT update math
+        # (torch's AdamW subclasses Adam)
+        base = type(opt).__mro__[1]
+        if base is torch.optim.SGD:
+            self._sgd(p, group)
+        elif base is torch.optim.Adam:
+            self._adam(p, group, opt.state[p])
+        elif base is torch.optim.RMSprop:
+            self._rmsprop(p, group, opt.state[p])
+        else:
+            raise ValueError(
+                "CrossBarrier supports SGD, Adam and RMSprop only (the "
+                "per-parameter update math is replicated here)")
+
+    def _sgd(self, p, group) -> None:
+        d_p = p.grad
+        wd = group.get("weight_decay", 0)
+        momentum = group.get("momentum", 0)
+        dampening = group.get("dampening", 0)
+        nesterov = group.get("nesterov", False)
+        if wd:
+            d_p = d_p.add(p, alpha=wd)
+        if momentum:
+            state = self._opt.state[p]
+            buf = state.get("momentum_buffer")
+            if buf is None:
+                buf = torch.clone(d_p).detach()
+                state["momentum_buffer"] = buf
+            else:
+                buf.mul_(momentum).add_(d_p, alpha=1 - dampening)
+            d_p = d_p.add(buf, alpha=momentum) if nesterov else buf
+        p.add_(d_p, alpha=-group["lr"])
+
+    def _adam(self, p, group, state) -> None:
+        if len(state) == 0:
+            state["step"] = 0
+            state["exp_avg"] = torch.zeros_like(p)
+            state["exp_avg_sq"] = torch.zeros_like(p)
+        beta1, beta2 = group["betas"]
+        state["step"] += 1
+        step = state["step"]
+        grad = p.grad
+        if group.get("weight_decay", 0):
+            grad = grad.add(p, alpha=group["weight_decay"])
+        state["exp_avg"].mul_(beta1).add_(grad, alpha=1 - beta1)
+        state["exp_avg_sq"].mul_(beta2).addcmul_(grad, grad,
+                                                 value=1 - beta2)
+        bias1 = 1 - beta1 ** step
+        bias2 = 1 - beta2 ** step
+        denom = (state["exp_avg_sq"].sqrt() / math.sqrt(bias2)).add_(
+            group["eps"])
+        p.addcdiv_(state["exp_avg"], denom, value=-group["lr"] / bias1)
+
+    def _rmsprop(self, p, group, state) -> None:
+        if len(state) == 0:
+            state["square_avg"] = torch.zeros_like(p)
+            if group.get("momentum", 0):
+                state["momentum_buffer"] = torch.zeros_like(p)
+        alpha = group.get("alpha", 0.99)
+        grad = p.grad
+        if group.get("weight_decay", 0):
+            grad = grad.add(p, alpha=group["weight_decay"])
+        sq = state["square_avg"]
+        sq.mul_(alpha).addcmul_(grad, grad, value=1 - alpha)
+        avg = sq.sqrt().add_(group["eps"])
+        if group.get("momentum", 0):
+            buf = state["momentum_buffer"]
+            buf.mul_(group["momentum"]).addcdiv_(grad, avg)
+            p.add_(buf, alpha=-group["lr"])
+        else:
+            p.addcdiv_(grad, avg, value=-group["lr"])
