@@ -94,6 +94,40 @@ def profiler_step() -> None:
         tracer.step()
 
 
+def push_pull_rowsparse(tensor, name: str, average: bool = True):
+    """Row-sparse PS push_pull for embedding-style gradients: ``tensor``
+    is a dense [rows, width] f32 gradient whose rows are mostly zero
+    (how embedding grads come out of jax/torch autograd); only the
+    nonzero rows travel on the wire — [nrows][width][ids][rows] — and
+    the server scatter-adds them into the dense store
+    (kRowSparsePushPull: the request type the reference reserves,
+    common.h:267-271, but never implements). Returns the dense
+    cross-worker sum (mean when ``average``) of shape [rows, width].
+
+    Requires the DCN PS. Partitions are row-aligned automatically.
+    """
+    import numpy as np
+
+    state = get_state()
+    if state.ps_client is None:
+        raise RuntimeError("push_pull_rowsparse requires a connected PS "
+                           "(DMLC_NUM_SERVER > 0)")
+    host = np.ascontiguousarray(tensor, dtype=np.float32)
+    if host.ndim != 2:
+        raise ValueError(f"expected [rows, width], got shape {host.shape}")
+    from .core.types import DataType
+    ctx = state.registry.init_tensor(name, host.nbytes, DataType.FLOAT32,
+                                     align_bytes=host.shape[1] * 4)
+    out = state.ps_client.push_pull_rowsparse(
+        ctx, host, average=average, num_workers=state.config.num_workers)
+    # actual wire traffic: sparse push (headers + ids + nonzero rows) up,
+    # dense pull down — NOT the dense size both ways
+    nnz = int(np.any(host != 0, axis=1).sum())
+    push_wire = 8 * len(ctx.partitions) + nnz * (4 + host.shape[1] * 4)
+    state.telemetry.record(push_wire + out.nbytes)
+    return out
+
+
 def push_pull_async(tensor, name: str, average: bool = True,
                     priority: Optional[int] = None) -> int:
     """Asynchronous PS push_pull: returns an int handle immediately; the

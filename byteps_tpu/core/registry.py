@@ -135,23 +135,37 @@ class TensorRegistry:
     # ------------------------------------------------------------------ #
 
     def init_tensor(self, name: str, nbytes: int,
-                    dtype: Optional[DataType] = None) -> TensorContext:
+                    dtype: Optional[DataType] = None,
+                    align_bytes: Optional[int] = None) -> TensorContext:
         """Size-aware init: partition into <= partition_bytes keys and assign
         each partition to a server (operations.cc:283-414 minus the shm/ZPush
-        plumbing, which is owned by the transport layer here)."""
+        plumbing, which is owned by the transport layer here).
+
+        ``align_bytes``: round partition boundaries down to this multiple
+        (row-sparse tensors partition on whole rows so a row never
+        straddles two servers)."""
         ctx = self.declare(name, dtype or DataType.FLOAT32)
         if dtype is not None:
             ctx.dtype = dtype
         with self._lock:
-            if ctx.initialized and ctx.nbytes == nbytes:
+            if (ctx.initialized and ctx.nbytes == nbytes
+                    and getattr(ctx, "align_bytes", None) == align_bytes):
                 return ctx
-            self._partition_locked(ctx, nbytes)
+            self._partition_locked(ctx, nbytes, align_bytes)
+            ctx.align_bytes = align_bytes
             ctx.initialized = True
             return ctx
 
-    def _partition_locked(self, ctx: TensorContext, nbytes: int) -> None:
+    def _partition_locked(self, ctx: TensorContext, nbytes: int,
+                          align_bytes: Optional[int] = None) -> None:
         bps_check(nbytes > 0, f"tensor {ctx.name} has zero size")
         part_bytes = self._aligned_partition_bytes()
+        if align_bytes:
+            bps_check(nbytes % align_bytes == 0,
+                      f"{ctx.name}: size {nbytes} not a multiple of "
+                      f"align_bytes {align_bytes}")
+            part_bytes = max(align_bytes,
+                             part_bytes // align_bytes * align_bytes)
         # Re-init: retire the old partitions' load accounting first.
         for p in ctx.partitions:
             if p.server < len(self._server_load):

@@ -183,6 +183,7 @@ class TensorContext:
     priority: int = 0
     compressor_kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
     initialized: bool = False
+    align_bytes: Optional[int] = None   # row-sparse: partition row alignment
 
     @property
     def key_list(self) -> List[int]:

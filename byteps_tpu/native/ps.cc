@@ -59,6 +59,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace bps {
@@ -603,6 +604,9 @@ struct CompressorCfg {
 
 struct Conn {
   int fd;
+  // worker id observed on this connection's first message; -1 until then
+  // (failure detection: a worker is presumed dead when ALL its conns die)
+  std::atomic<int> sender{-1};
   ~Conn() {
     if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
   }
@@ -630,6 +634,9 @@ struct KeyStore {
   uint32_t len = 0;
   uint32_t dtype = F32;
   uint32_t init_count = 0;       // init pushes seen
+  bool init_done = false;        // the init barrier completed once: later
+                                 // same-length inits (elastic reconnect)
+                                 // ACK immediately instead of re-parking
   std::vector<ParkedPull> parked_inits;
   uint32_t recv_count = 0;       // pushes folded this round
   uint64_t completed_rounds = 0;
@@ -797,6 +804,15 @@ class Server {
         std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
         break;
       }
+      if (conn->sender.load() < 0) {
+        conn->sender.store((int)h.sender);
+        std::lock_guard<std::mutex> lk(worker_conns_mu_);
+        worker_conns_[(int)h.sender]++;
+        // a reconnect (elastic resume) clears the presumed-dead mark so
+        // the worker's new messages are processed again
+        departed_.erase((int)h.sender);
+        clean_exit_.erase((int)h.sender);
+      }
       EngineMsg m;
       m.op = h.op;
       m.key = h.key;
@@ -828,6 +844,70 @@ class Server {
       }
       queues_[ThreadForKey(h.key, h.len)]->push(std::move(m), prio);
     }
+    // Failure detection (beyond the reference, which has none —
+    // SURVEY.md §5.3): when the LAST connection of a worker closes and
+    // the server is not shutting down, presume the worker dead/suspended
+    // and fail every parked request immediately, so surviving workers
+    // get an error in milliseconds instead of wedging on a sync round
+    // that can never complete until their client timeout fires.
+    int snd = conn->sender.load();
+    if (snd >= 0) {
+      bool departed = false;
+      {
+        std::lock_guard<std::mutex> lk(worker_conns_mu_);
+        if (--worker_conns_[snd] == 0) {
+          worker_conns_.erase(snd);
+          // a worker that announced SHUTDOWN is exiting cleanly: its
+          // conn closures are expected, not a failure
+          if (!clean_exit_.count(snd)) {
+            departed_.insert(snd);
+            departed = true;
+          }
+        }
+      }
+      if (departed && !shutting_down_.load()) OnWorkerDeparted(snd);
+    }
+  }
+
+  bool IsDeparted(int sender) {
+    std::lock_guard<std::mutex> lk(worker_conns_mu_);
+    return departed_.count(sender) != 0;
+  }
+
+  void OnWorkerDeparted(int sender) {
+    std::fprintf(stderr,
+                 "[bps-server] worker %d departed (all connections "
+                 "closed); failing parked requests\n", sender);
+    std::vector<ParkedPull> victims;
+    {
+      std::lock_guard<std::mutex> lk(stores_mu_);
+      for (auto& [key, ks] : stores_) {
+        (void)key;
+        std::lock_guard<std::mutex> lk2(ks.mu);
+        for (auto& p : ks.parked_pulls) victims.push_back(p);
+        for (auto& p : ks.parked_inits) victims.push_back(p);
+        ks.parked_pulls.clear();
+        ks.parked_inits.clear();
+        // re-arm: the incomplete round's partial sum is dropped (next
+        // round's first push re-seeds the accumulator) and the init
+        // barrier restarts; push counts roll back to the last COMPLETED
+        // round so survivors' PullReady bookkeeping stays consistent
+        // when they retry after elastic resume.
+        ks.init_count = 0;
+        ks.recv_count = 0;
+        for (auto& c : ks.worker_push_count)
+          c = std::min(c, ks.completed_rounds);
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      for (auto& p : barrier_waiters_) victims.push_back(p);
+      barrier_waiters_.clear();
+    }
+    for (auto& p : victims) {
+      MsgHeader r{kMagic, ACK, 1, 0, p.rid, 0, 0, 0};  // flags=1: error
+      p.conn->send_msg(r, nullptr);
+    }
   }
 
   void HandleBarrier(EngineMsg&& m) {
@@ -846,6 +926,12 @@ class Server {
   }
 
   void HandleShutdown(EngineMsg&& m) {
+    {
+      // clean exit: the stripe conns of this worker will close right
+      // after the ACK; that must not read as a failure
+      std::lock_guard<std::mutex> lk(worker_conns_mu_);
+      clean_exit_.insert((int)m.sender);
+    }
     MsgHeader r{kMagic, ACK, 0, 0, m.rid, 0, 0, 0};
     m.conn->send_msg(r, nullptr);
     if (++shutdown_count_ >= num_workers_) {
@@ -859,6 +945,16 @@ class Server {
   void EngineLoop(int idx) {
     EngineMsg m;
     while (queues_[idx]->wait_pop(&m)) {
+      if (IsDeparted((int)m.sender)) {
+        // the worker was declared dead AFTER this message was queued:
+        // processing it would re-pollute the round state OnWorkerDeparted
+        // just rolled back (e.g. a stale push adopted as the first push
+        // of the re-armed round). Error-ACK — usually into a closed
+        // socket, which is fine.
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        continue;
+      }
       switch (m.op) {
         case INIT_PUSH: DoInit(m); break;
         case PUSH: DoPush(m); break;
@@ -907,6 +1003,7 @@ class Server {
         ks.parked_pulls.clear();
         ks.parked_inits.clear();
         ks.init_count = 0;
+        ks.init_done = false;
         ks.len = (uint32_t)m.payload.size();
         ks.dtype = m.dtype;
         ks.accum.assign(ks.len, 0);
@@ -922,11 +1019,20 @@ class Server {
         ks.round_idx.clear();
         ks.scratch.clear();
       }
-      ks.init_count++;
-      ks.parked_inits.push_back({m.conn, m.rid, m.sender});
-      if ((int)ks.init_count >= num_workers_) {
-        release.swap(ks.parked_inits);
-        ks.init_count = 0;  // allow re-init (elastic)
+      if (ks.init_done) {
+        // the cold-start barrier already completed for this store; a
+        // same-length init is an idempotent re-declaration (elastic
+        // reconnect after suspend or a peer's departure) — ACK now,
+        // survivors that never re-init must not be waited on
+        release.push_back({m.conn, m.rid, m.sender});
+      } else {
+        ks.init_count++;
+        ks.parked_inits.push_back({m.conn, m.rid, m.sender});
+        if ((int)ks.init_count >= num_workers_) {
+          release.swap(ks.parked_inits);
+          ks.init_count = 0;  // allow re-init (elastic)
+          ks.init_done = true;
+        }
       }
     }
     for (auto& w : stale) {
@@ -1047,9 +1153,91 @@ class Server {
     for (auto& p : flush) AnswerPull(ks, p);
   }
 
+  void DoPushSparse(EngineMsg& m, KeyStore& ks) {
+    // kRowSparsePushPull — the op the reference reserves but never
+    // implements (common.h:267-271, server.h:39-41). Self-describing
+    // payload: [u32 nrows][u32 width_f32s][i32 ids[nrows]]
+    // [f32 rows[nrows*width]]; the server scatter-adds the rows into the
+    // dense store, so sparse pushes (embedding gradients) and dense pulls
+    // compose with the normal round protocol — and with dense pushes
+    // from other workers in the same round.
+    std::vector<ParkedPull> flush;
+    bool ok = false;
+    {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      do {
+        if (ks.len == 0 || ks.dtype != F32) break;
+        if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
+        if (m.payload.size() < 8) break;
+        uint32_t nrows, width;
+        std::memcpy(&nrows, m.payload.data(), 4);
+        std::memcpy(&width, m.payload.data() + 4, 4);
+        if (width == 0) break;
+        size_t want = 8 + (size_t)nrows * 4 + (size_t)nrows * width * 4;
+        if (m.payload.size() != want) break;
+        uint64_t total_rows = ks.len / ((uint64_t)width * 4);
+        if (total_rows * width * 4 != ks.len) break;  // width mismatch
+        const int32_t* ids = (const int32_t*)(m.payload.data() + 8);
+        const float* vals =
+            (const float*)(m.payload.data() + 8 + (size_t)nrows * 4);
+        bool bad = false;  // validate BEFORE touching the store
+        for (uint32_t i = 0; i < nrows; ++i)
+          if (ids[i] < 0 || (uint64_t)ids[i] >= total_rows) { bad = true;
+            break; }
+        if (bad) break;
+        ks.total_pushes++;
+        if (m.sender < ks.worker_push_count.size())
+          ks.worker_push_count[m.sender]++;
+        if (async_) {
+          // async: fold rows straight into the authoritative weights
+          float* w = (float*)ks.merged.data();
+          for (uint32_t i = 0; i < nrows; ++i)
+            for (uint32_t j = 0; j < width; ++j)
+              w[(size_t)ids[i] * width + j] += vals[(size_t)i * width + j];
+          ks.completed_rounds++;
+          flush.swap(ks.parked_pulls);
+          ok = true;
+          break;
+        }
+        if (ks.recv_count == 0) {
+          // first push of the round: a previous ALL_RECV moved accum out
+          if (ks.accum.size() != ks.len) ks.accum.assign(ks.len, 0);
+          std::memset(ks.accum.data(), 0, ks.len);
+        }
+        float* accum = (float*)ks.accum.data();
+        for (uint32_t i = 0; i < nrows; ++i) {
+          float* dst = accum + (size_t)ids[i] * width;
+          const float* src = vals + (size_t)i * width;
+          for (uint32_t j = 0; j < width; ++j) dst[j] += src[j];
+        }
+        ks.recv_count++;
+        if ((int)ks.recv_count >= num_workers_) {
+          auto d = std::make_shared<std::vector<uint8_t>>(
+              std::move(ks.accum));
+          DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
+          ks.pub = std::move(d);
+          ks.recv_count = 0;
+          ks.completed_rounds++;
+          flush.swap(ks.parked_pulls);
+        }
+        ok = true;
+      } while (false);
+    }
+    if (!ok)
+      std::fprintf(stderr, "[bps-server] sparse push rejected key=%llu "
+                   "len=%zu\n", (unsigned long long)m.key, m.payload.size());
+    MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key, 0, 0};
+    m.conn->send_msg(r, nullptr);
+    for (auto& p : flush) AnswerPull(ks, p);
+  }
+
   void DoPush(EngineMsg& m) {
     std::vector<ParkedPull> flush;
     KeyStore& ks = store_of(m.key);
+    if (m.req == kRowSparsePushPull) {
+      DoPushSparse(m, ks);
+      return;
+    }
     {
       std::lock_guard<std::mutex> lk(ks.mu);
       bool has_comp = ks.comp.type != CompressorCfg::NONE;
@@ -1238,6 +1426,16 @@ class Server {
 
   std::mutex barrier_mu_;
   std::vector<ParkedPull> barrier_waiters_;
+
+  // failure detection: live connection count per worker id, workers
+  // presumed dead (their still-queued engine messages must be dropped —
+  // a stale push landing in a re-armed round would corrupt it), and
+  // workers that announced a clean SHUTDOWN (their conn closures are
+  // graceful, not failures)
+  std::mutex worker_conns_mu_;
+  std::unordered_map<int, int> worker_conns_;
+  std::unordered_set<int> departed_;
+  std::unordered_set<int> clean_exit_;
 };
 
 // ------------------------------------------------------------------ //

@@ -159,6 +159,31 @@ class HostRandomk(HostCodec):
         return f"compressor=randomk;n={self.n};k={self.k};seed={self.seed}"
 
 
+def _uniform_fast(seed: int, n: int, mix: int) -> np.ndarray:
+    """Bit-identical to rng.np_uniform_parallel (the golden model; a test
+    asserts equality) with in-place passes: the counter/murmur chain and
+    the [0,1) conversion allocate 2 arrays instead of ~8 — on the 4MB-
+    partition hot path the temp churn was most of the compress time.
+    (f32 divide by 2^24 is exact for 24-bit ints, so skipping the golden's
+    f64 intermediate cannot change the result.)"""
+    from .rng import uniform_base
+
+    h = np.arange(n, dtype=np.uint32)
+    t = np.empty(n, np.uint32)
+    with np.errstate(over="ignore"):
+        h *= np.uint32(0x9E3779B1)
+        h += uniform_base(seed, mix)
+        np.right_shift(h, 16, out=t); h ^= t
+        h *= np.uint32(0x85EBCA6B)
+        np.right_shift(h, 13, out=t); h ^= t
+        h *= np.uint32(0xC2B2AE35)
+        np.right_shift(h, 16, out=t); h ^= t
+        h >>= 8
+    u = h.astype(np.float32)
+    u /= np.float32(1 << 24)
+    return u
+
+
 @dataclasses.dataclass
 class HostDithering(HostCodec):
     n: int
@@ -180,16 +205,22 @@ class HostDithering(HostCodec):
             norm = safe_m * np.float32(
                 np.sqrt(np.sum(np.square(absx / safe_m))))
         norm = np.float32(max(norm, 1e-30))
-        scaled = (absx / norm).astype(np.float32)
-        u = np_uniform_parallel(self.seed, self.n, mix=step)
+        u = _uniform_fast(self.seed, self.n, step)
         if self.partition == "linear":
-            pos = scaled * np.float32(self.s)
+            # in-place chain, same op ORDER as the jnp codec (rounding
+            # parity): scaled = |x|/norm; pos = scaled*s; stochastic round
+            pos = absx            # reuse: absx is dead after norm
+            pos /= norm
+            pos *= np.float32(self.s)
             floor = np.floor(pos)
-            level = floor + (u < (pos - floor))
+            pos -= floor          # pos is now frac
+            level = floor
+            np.add(level, u < pos, out=level, casting="unsafe")
             # l2 norm can round below max|x| -> scaled > 1 -> level s+1
             # would wrap the int8 cast at s=127
-            level = np.minimum(level, np.float32(self.s))
+            np.minimum(level, np.float32(self.s), out=level)
         else:
+            scaled = (absx / norm).astype(np.float32)
             safe = np.maximum(scaled, np.float32(1e-30))
             j = np.clip(np.floor(-np.log2(safe)), 0, 30).astype(np.float32)
             low = np.exp2(-j - 1).astype(np.float32)

@@ -223,6 +223,56 @@ class PSClient:
         for f in futures:
             f.result()
 
+    def push_pull_rowsparse(self, ctx: TensorContext, host2d: np.ndarray,
+                            average: bool = True,
+                            num_workers: Optional[int] = None) -> np.ndarray:
+        """Row-sparse aggregation round (the op the reference reserves as
+        kRowSparsePushPull but leaves unimplemented): push only the NONZERO
+        rows of a [R, W] f32 gradient — [u32 nrows][u32 W][i32 ids]
+        [f32 rows] per partition — the server scatter-adds them into the
+        dense store, and the pull returns the dense aggregate. The tensor
+        must be declared with row-aligned partitions
+        (init_tensor(..., align_bytes=W*4))."""
+        if self._closed:
+            raise RuntimeError("push_pull_rowsparse on a closed PSClient")
+        host2d = np.ascontiguousarray(host2d, np.float32)
+        rows, width = host2d.shape
+        row_bytes = width * 4
+        self.ensure_init(ctx, host2d.nbytes)
+        cmd_sparse = get_command_type(RequestType.ROW_SPARSE_PUSH_PULL,
+                                      DataType.FLOAT32)
+        cmd_dense = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                     DataType.FLOAT32)
+        nz = np.flatnonzero(np.any(host2d != 0, axis=1)).astype(np.int32)
+        out = np.empty(rows * width, np.float32)
+
+        def one(p: Partition):
+            if p.offset % row_bytes or p.length % row_bytes:
+                raise ValueError(
+                    f"partition {p.index} of {ctx.name!r} not row-aligned; "
+                    f"declare with init_tensor(..., align_bytes={row_bytes})")
+            lo = p.offset // row_bytes
+            hi = (p.offset + p.length) // row_bytes
+            sel = nz[(nz >= lo) & (nz < hi)]
+            local_ids = (sel - lo).astype(np.int32)
+            payload = b"".join((
+                np.uint32(len(sel)).tobytes(),
+                np.uint32(width).tobytes(),
+                local_ids.tobytes(),
+                np.ascontiguousarray(host2d[sel]).tobytes(),
+            ))
+            buf = np.frombuffer(payload, np.uint8)
+            self.zpush(p.server, p.key, buf, cmd_sparse)
+            dst = out.view(np.uint8)[p.offset:p.offset + p.length]
+            self.zpull(p.server, p.key, dst, cmd_dense)
+
+        futures = [self._pool.submit(one, p) for p in ctx.partitions]
+        for f in futures:
+            f.result()
+        if average and num_workers and num_workers > 1:
+            out /= num_workers
+        return out.reshape(rows, width)
+
     def push_pull(self, ctx: TensorContext, flat: np.ndarray,
                   average: bool = True,
                   num_workers: Optional[int] = None) -> np.ndarray:

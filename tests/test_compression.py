@@ -226,3 +226,15 @@ def test_compressed_training_converges(bps):
         params, opt_state, loss = step(params, opt_state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_uniform_fast_matches_golden():
+    """host._uniform_fast (in-place hot-path generator) must stay
+    bit-identical to rng.np_uniform_parallel (the golden model)."""
+    import numpy as np
+    from byteps_tpu.ops.compression.host import _uniform_fast
+    from byteps_tpu.ops.compression.rng import np_uniform_parallel
+    for seed, n, mix in ((0, 100, 0), (11, 4096, 7), (123, 1 << 16, 42)):
+        np.testing.assert_array_equal(
+            _uniform_fast(seed, n, mix).view(np.uint32),
+            np_uniform_parallel(seed, n, mix).view(np.uint32))
