@@ -118,6 +118,14 @@ def push_pull_rowsparse(tensor, name: str, average: bool = True):
     from .core.types import DataType
     ctx = state.registry.init_tensor(name, host.nbytes, DataType.FLOAT32,
                                      align_bytes=host.shape[1] * 4)
+    if state.scheduler is not None and state.handles is not None:
+        # ride the priority pipeline like dense/compressed traffic; the
+        # scheduler records true wire-byte telemetry per partition
+        handle = state.handles.allocate(name)
+        state.scheduler.submit_rowsparse(
+            ctx, host, handle, average, state.config.num_workers,
+            version=state.next_version(name))
+        return state.handles.wait_and_clear(handle.id)
     out = state.ps_client.push_pull_rowsparse(
         ctx, host, average=average, num_workers=state.config.num_workers)
     # actual wire traffic: sparse push (headers + ids + nonzero rows) up,

@@ -146,10 +146,11 @@ class PartitionTask:
     list (operations.cc:199-204)."""
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
-                 "out_view", "group", "cmd", "stack", "step", "wire")
+                 "out_view", "group", "cmd", "stack", "step", "wire",
+                 "cmd_pull")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
-                 group, cmd, stack=None, step=0):
+                 group, cmd, stack=None, step=0, wire=None, cmd_pull=None):
         self.ctx: TensorContext = ctx
         self.partition: Partition = partition
         self.priority = priority
@@ -157,10 +158,11 @@ class PartitionTask:
         self.in_view = in_view     # np.uint8 view of this partition's input
         self.out_view = out_view   # np.uint8 view of the output slot
         self.group: "TaskGroup" = group
-        self.cmd = cmd
+        self.cmd = cmd             # PUSH command word
         self.stack = stack         # host codec stack or None (dense)
         self.step = step           # compression round (seeds randomk/dither)
-        self.wire = None           # compressed wire bytes (COMPRESS output)
+        self.wire = wire           # prebuilt/compressed push payload
+        self.cmd_pull = cmd if cmd_pull is None else cmd_pull
 
     @property
     def key(self) -> int:
@@ -347,7 +349,8 @@ class PipelineScheduler:
         span = self._span(task, "PUSH")
         try:
             buf = task.wire if task.wire is not None else task.in_view
-            if self._config is not None and task.stack is None:
+            if (self._config is not None and task.stack is None
+                    and task.in_view is not None):
                 from ..utils.logging import debug_sample
                 debug_sample(self._config, name, span,
                              task.in_view, task.ctx.dtype.np_dtype)
@@ -376,11 +379,11 @@ class PipelineScheduler:
             if task.stack is not None:
                 reply = np.empty(task.stack.wire_bytes(), np.uint8)
                 self._client.zpull(task.partition.server, task.key, reply,
-                                   task.cmd)
+                                   task.cmd_pull)
                 task.wire = reply
             else:
                 self._client.zpull(task.partition.server, task.key,
-                                   task.out_view, task.cmd)
+                                   task.out_view, task.cmd_pull)
         except Exception as e:  # noqa: BLE001
             self._finish(task, e)
             return
@@ -421,6 +424,9 @@ class PipelineScheduler:
         if self._telemetry:
             if task.stack is not None:
                 self._telemetry.record(task.stack.wire_bytes() * 2)
+            elif task.wire is not None:
+                # prebuilt sparse payload up, dense reply down
+                self._telemetry.record(len(task.wire) + task.nbytes)
             else:
                 self._telemetry.record(task.nbytes * 2)
         with self._inflight_mu:
@@ -486,6 +492,52 @@ class PipelineScheduler:
             except RuntimeError as e:
                 # scheduler stopped mid-submit: fail this partition so the
                 # handle resolves with an error instead of hanging
+                group.partition_done(e)
+
+    def submit_rowsparse(self, ctx: TensorContext, host2d: np.ndarray,
+                         handle: Handle, average: bool, num_workers: int,
+                         version: int = 0,
+                         priority: Optional[int] = None) -> None:
+        """Row-sparse push_pull through the priority pipeline: per
+        row-aligned partition, the nonzero rows become a prebuilt sparse
+        push payload ([nrows][width][ids][rows]) and the pull is dense —
+        same credit/priority semantics as dense and compressed traffic."""
+        from ..server.client import build_rowsparse_payload
+        from .types import DataType, RequestType, get_command_type
+
+        host2d = np.ascontiguousarray(host2d, np.float32)
+        rows, width = host2d.shape
+        self._client.ensure_init(ctx, host2d.nbytes)
+        cmd_sparse = get_command_type(RequestType.ROW_SPARSE_PUSH_PULL,
+                                      DataType.FLOAT32)
+        cmd_dense = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                     DataType.FLOAT32)
+        nz = np.flatnonzero(np.any(host2d != 0, axis=1)).astype(np.int32)
+        out = np.empty(rows * width, np.float32)
+        out_view = out.view(np.uint8)
+
+        def on_complete(err: Optional[Exception]) -> None:
+            if err is None and average and num_workers > 1:
+                np.divide(out, num_workers, out=out)
+            handle._finish(out.reshape(rows, width) if err is None else None,
+                           err)
+
+        group = TaskGroup(ctx, len(ctx.partitions), on_complete)
+        if priority is None:
+            priority = -ctx.declared_key
+        for p in ctx.partitions:
+            try:
+                wire = build_rowsparse_payload(p, nz, host2d)
+            except ValueError as e:
+                group.partition_done(e)
+                continue
+            task = PartitionTask(
+                ctx, p, priority, version, None,
+                out_view[p.offset:p.offset + p.length],
+                group, cmd_sparse, wire=wire, cmd_pull=cmd_dense)
+            try:
+                self._queue.add_task(task)
+            except RuntimeError as e:
                 group.partition_done(e)
 
     def stop(self) -> None:

@@ -607,6 +607,13 @@ struct Conn {
   // worker id observed on this connection's first message; -1 until then
   // (failure detection: a worker is presumed dead when ALL its conns die)
   std::atomic<int> sender{-1};
+  // set when this connection's recv loop exits, BEFORE the departure
+  // rollback runs. Engine handlers re-check it under the key lock, so a
+  // dead worker's still-queued message can never apply AFTER the
+  // rollback (mutex ordering: dead=true happens-before the rollback's
+  // ks.mu, which happens-before the handler's ks.mu). A reconnect is a
+  // NEW Conn, so retried messages pass.
+  std::atomic<bool> dead{false};
   ~Conn() {
     if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
   }
@@ -641,6 +648,10 @@ struct KeyStore {
   uint32_t recv_count = 0;       // pushes folded this round
   uint64_t completed_rounds = 0;
   std::vector<uint64_t> worker_push_count;  // per worker
+  // set per worker when a departure aborts a round that worker had
+  // already pushed: its next pull must error (retry) instead of being
+  // served the PREVIOUS round's aggregate as if it were the new one
+  std::vector<uint8_t> pull_abort;
   std::vector<ParkedPull> parked_pulls;
   uint64_t total_pushes = 0;     // for priority scheduling
   // compression mirror (server.cc:92-118): set by COMP_INIT
@@ -808,9 +819,9 @@ class Server {
         conn->sender.store((int)h.sender);
         std::lock_guard<std::mutex> lk(worker_conns_mu_);
         worker_conns_[(int)h.sender]++;
-        // a reconnect (elastic resume) clears the presumed-dead mark so
-        // the worker's new messages are processed again
-        departed_.erase((int)h.sender);
+        // a reconnect (elastic resume) clears the clean-exit mark; stale
+        // messages from before the death are fenced by their own (dead)
+        // Conn, not by worker id
         clean_exit_.erase((int)h.sender);
       }
       EngineMsg m;
@@ -850,6 +861,7 @@ class Server {
     // and fail every parked request immediately, so surviving workers
     // get an error in milliseconds instead of wedging on a sync round
     // that can never complete until their client timeout fires.
+    conn->dead.store(true);
     int snd = conn->sender.load();
     if (snd >= 0) {
       bool departed = false;
@@ -859,19 +871,11 @@ class Server {
           worker_conns_.erase(snd);
           // a worker that announced SHUTDOWN is exiting cleanly: its
           // conn closures are expected, not a failure
-          if (!clean_exit_.count(snd)) {
-            departed_.insert(snd);
-            departed = true;
-          }
+          if (!clean_exit_.count(snd)) departed = true;
         }
       }
       if (departed && !shutting_down_.load()) OnWorkerDeparted(snd);
     }
-  }
-
-  bool IsDeparted(int sender) {
-    std::lock_guard<std::mutex> lk(worker_conns_mu_);
-    return departed_.count(sender) != 0;
   }
 
   void OnWorkerDeparted(int sender) {
@@ -895,8 +899,17 @@ class Server {
         // when they retry after elastic resume.
         ks.init_count = 0;
         ks.recv_count = 0;
-        for (auto& c : ks.worker_push_count)
-          c = std::min(c, ks.completed_rounds);
+        if (ks.pull_abort.size() != ks.worker_push_count.size())
+          ks.pull_abort.assign(ks.worker_push_count.size(), 0);
+        for (size_t w = 0; w < ks.worker_push_count.size(); ++w) {
+          if (ks.worker_push_count[w] > ks.completed_rounds) {
+            // this worker already pushed the aborted round; its next
+            // pull must NOT be satisfied by the previous round's
+            // aggregate (PullReady would say ready after the rollback)
+            ks.pull_abort[w] = 1;
+            ks.worker_push_count[w] = ks.completed_rounds;
+          }
+        }
       }
     }
     {
@@ -945,12 +958,12 @@ class Server {
   void EngineLoop(int idx) {
     EngineMsg m;
     while (queues_[idx]->wait_pop(&m)) {
-      if (IsDeparted((int)m.sender)) {
-        // the worker was declared dead AFTER this message was queued:
-        // processing it would re-pollute the round state OnWorkerDeparted
-        // just rolled back (e.g. a stale push adopted as the first push
-        // of the re-armed round). Error-ACK — usually into a closed
-        // socket, which is fine.
+      if (m.conn->dead.load()) {
+        // queued behind a connection that already died: processing it
+        // would re-pollute the round state OnWorkerDeparted rolled back
+        // (e.g. a stale push adopted as the first push of the re-armed
+        // round). This dequeue-time check is the fast path; the handlers
+        // re-check under ks.mu to close the check-then-act window.
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
         continue;
@@ -990,6 +1003,11 @@ class Server {
     {
       KeyStore& ks = store_of(m.key);
       std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.conn->dead.load()) {  // fenced: see Conn::dead
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       if (ks.len != (uint32_t)m.payload.size()) {
         // fresh key, or re-init with a new length (tensor resize): reset
         // the whole aggregation state. Anything parked against the old
@@ -1010,6 +1028,7 @@ class Server {
         ks.merged = m.payload;  // init value (typically zeros or weights)
         ks.pub = std::make_shared<std::vector<uint8_t>>(m.payload);
         ks.worker_push_count.assign(num_workers_, 0);
+        ks.pull_abort.assign(num_workers_, 0);
         ks.recv_count = 0;
         ks.completed_rounds = 0;
         // a resize invalidates any compressor (stale n): workers must
@@ -1053,6 +1072,11 @@ class Server {
     bool ok = false;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.conn->dead.load()) {  // fenced: see Conn::dead
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       CompressorCfg cfg;
       if (!async_ &&
           CompressorCfg::Parse(
@@ -1089,6 +1113,11 @@ class Server {
     std::vector<ParkedPull> flush;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.conn->dead.load()) {  // fenced: see Conn::dead
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       if (m.payload.size() != ks.comp.WireLen() ||
           !ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
                               ks.scratch.data(),
@@ -1105,6 +1134,7 @@ class Server {
       ks.total_pushes++;
       if (m.sender < ks.worker_push_count.size())
         ks.worker_push_count[m.sender]++;
+      if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
       DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
                  ks.comp.n * 4, F32);
       // defensive resize: accum can be moved-out empty after a dense
@@ -1166,6 +1196,7 @@ class Server {
     {
       std::lock_guard<std::mutex> lk(ks.mu);
       do {
+        if (m.conn->dead.load()) break;  // fenced: see Conn::dead
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
         if (m.payload.size() < 8) break;
@@ -1188,6 +1219,7 @@ class Server {
         ks.total_pushes++;
         if (m.sender < ks.worker_push_count.size())
           ks.worker_push_count[m.sender]++;
+        if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
         if (async_) {
           // async: fold rows straight into the authoritative weights
           float* w = (float*)ks.merged.data();
@@ -1260,6 +1292,11 @@ class Server {
     }
     {
       std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.conn->dead.load()) {  // fenced: see Conn::dead
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       if (ks.len == 0 || m.payload.size() != ks.len) {
         // uninitialized OR size mismatch (stale partitioning after a
         // tensor resize): error-reply; memcpy/sum with the wrong length
@@ -1276,6 +1313,7 @@ class Server {
       ks.total_pushes++;
       if (m.sender < ks.worker_push_count.size())
         ks.worker_push_count[m.sender]++;
+      if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
       if (async_) {
         // async: sum straight into merged (server.cc:315-319)
         sum_into(ks.merged.data(), m.payload.data(), m.payload.size(),
@@ -1364,6 +1402,20 @@ class Server {
     bool comp = m.req == kCompressedPushPull;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
+      if (m.conn->dead.load()) {  // fenced: see Conn::dead
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
+      if (m.sender < ks.pull_abort.size() && ks.pull_abort[m.sender]) {
+        // this worker's round was aborted by a peer departure after it
+        // pushed: serving the previous round's aggregate would be a
+        // silent stale read — error so the worker retries the round
+        ks.pull_abort[m.sender] = 0;
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       uninit = ks.len == 0 ||
                (comp && ks.comp.type == CompressorCfg::NONE);
       ready = !uninit && PullReady(ks, m.sender);
@@ -1434,7 +1486,6 @@ class Server {
   // graceful, not failures)
   std::mutex worker_conns_mu_;
   std::unordered_map<int, int> worker_conns_;
-  std::unordered_set<int> departed_;
   std::unordered_set<int> clean_exit_;
 };
 

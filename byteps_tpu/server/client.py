@@ -75,6 +75,31 @@ def get_or_init_ctx(state, name: str, host: np.ndarray) -> TensorContext:
                                       DataType.from_np(host.dtype))
 
 
+def build_rowsparse_payload(p: Partition, nz: np.ndarray,
+                            host2d: np.ndarray) -> np.ndarray:
+    """One partition's row-sparse push payload
+    ([u32 nrows][u32 width][i32 local_ids][f32 rows]) — THE single wire
+    producer, shared by the blocking client path and the scheduler's
+    pipelined path (the server parser is ps.cc DoPushSparse). Raises if
+    the partition does not land on row boundaries."""
+    width = host2d.shape[1]
+    row_bytes = width * 4
+    if p.offset % row_bytes or p.length % row_bytes:
+        raise ValueError(
+            f"partition {p.index} not row-aligned; declare with "
+            f"init_tensor(..., align_bytes={row_bytes})")
+    lo = p.offset // row_bytes
+    hi = (p.offset + p.length) // row_bytes
+    sel = nz[(nz >= lo) & (nz < hi)]
+    payload = b"".join((
+        np.uint32(len(sel)).tobytes(),
+        np.uint32(width).tobytes(),
+        (sel - lo).astype(np.int32).tobytes(),
+        np.ascontiguousarray(host2d[sel]).tobytes(),
+    ))
+    return np.frombuffer(payload, np.uint8)
+
+
 def ps_round_trip(state, name: str, host: np.ndarray,
                   average: bool) -> np.ndarray:
     """Shared get-or-declare + server round-trip for one flat host tensor:
@@ -247,21 +272,7 @@ class PSClient:
         out = np.empty(rows * width, np.float32)
 
         def one(p: Partition):
-            if p.offset % row_bytes or p.length % row_bytes:
-                raise ValueError(
-                    f"partition {p.index} of {ctx.name!r} not row-aligned; "
-                    f"declare with init_tensor(..., align_bytes={row_bytes})")
-            lo = p.offset // row_bytes
-            hi = (p.offset + p.length) // row_bytes
-            sel = nz[(nz >= lo) & (nz < hi)]
-            local_ids = (sel - lo).astype(np.int32)
-            payload = b"".join((
-                np.uint32(len(sel)).tobytes(),
-                np.uint32(width).tobytes(),
-                local_ids.tobytes(),
-                np.ascontiguousarray(host2d[sel]).tobytes(),
-            ))
-            buf = np.frombuffer(payload, np.uint8)
+            buf = build_rowsparse_payload(p, nz, host2d)
             self.zpush(p.server, p.key, buf, cmd_sparse)
             dst = out.view(np.uint8)[p.offset:p.offset + p.length]
             self.zpull(p.server, p.key, dst, cmd_dense)

@@ -74,16 +74,18 @@ def main() -> None:
     params = train_state["params"]
     opt = tx.init(params)
 
-    per_step = args.batch_size * ndev
+    from byteps_tpu.data import ShardedDataset, prefetch_to_device
+
+    # per-worker sharded + device-prefetched input pipeline: every worker
+    # sees a disjoint slice per epoch, and batch N+1 transfers while batch
+    # N computes (byteps_tpu.data)
+    loader = ShardedDataset({"x": x, "y": y}, args.batch_size * ndev,
+                            seed=0)
     for epoch in range(args.epochs):
         cbs.on_epoch_begin(epoch, train_state)
-        perm = np.random.RandomState(epoch).permutation(len(x))
         losses = []
-        for i in range(0, len(x) - per_step + 1, per_step):
-            sel = perm[i:i + per_step]
-            params, opt, loss = step(params, opt,
-                                     jnp.asarray(x[sel]),
-                                     jnp.asarray(y[sel]))
+        for batch in prefetch_to_device(loader.epoch(epoch)):
+            params, opt, loss = step(params, opt, batch["x"], batch["y"])
             losses.append(float(loss))
         acc = float(mlp.accuracy(params, {"x": jnp.asarray(x),
                                           "y": jnp.asarray(y)}, cfg))

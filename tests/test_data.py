@@ -1,0 +1,111 @@
+"""Sharded input pipeline: disjoint per-rank coverage, deterministic
+epoch shuffling shared by ranks, batching edge cases, device prefetch."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.data import ShardedDataset, prefetch_to_device
+
+
+def _all_rows(ds_cls_kwargs, n_ranks, epoch):
+    seen = []
+    for r in range(n_ranks):
+        ds = ShardedDataset(rank=r, size=n_ranks, **ds_cls_kwargs)
+        for batch in ds.epoch(epoch):
+            seen.append(batch["x"])
+    return np.concatenate(seen) if seen else np.empty((0,))
+
+
+def test_shards_disjoint_and_cover():
+    n = 64
+    data = {"x": np.arange(n), "y": np.arange(n) * 10}
+    kw = dict(data=data, batch_size=4, seed=3, drop_last=False)
+    for epoch in (0, 1):
+        rows = _all_rows(kw, 4, epoch)
+        assert sorted(rows.tolist()) == list(range(n))  # exact cover
+    # different epochs shuffle differently
+    assert not np.array_equal(_all_rows(kw, 4, 0), _all_rows(kw, 4, 1))
+
+
+def test_same_seed_same_order_across_constructions():
+    data = {"x": np.arange(40)}
+    a = ShardedDataset(data, 5, rank=1, size=2, seed=9)
+    b = ShardedDataset(data, 5, rank=1, size=2, seed=9)
+    for ba, bb in zip(a.epoch(4), b.epoch(4)):
+        np.testing.assert_array_equal(ba["x"], bb["x"])
+
+
+def test_drop_last_and_len():
+    # 23 rows over 2 ranks -> every rank truncated to 11 (equal shard
+    # lengths keep synchronous push_pull rounds in lockstep)
+    data = {"x": np.arange(23)}
+    ds = ShardedDataset(data, 4, rank=0, size=2, drop_last=True)
+    batches = list(ds.epoch(0))
+    assert len(batches) == len(ds) == 2           # 8 of this rank's 11 rows
+    assert all(len(b["x"]) == 4 for b in batches)
+    ds2 = ShardedDataset(data, 4, rank=0, size=2, drop_last=False)
+    batches2 = list(ds2.epoch(0))
+    assert len(batches2) == len(ds2) == 3
+    assert sum(len(b["x"]) for b in batches2) == 11
+
+
+def test_equal_batches_across_ranks_when_indivisible():
+    """Every rank must produce the SAME number of batches even when the
+    dataset size is not divisible by the rank count (a rank with one
+    extra batch would desynchronize the sync PS rounds)."""
+    data = {"x": np.arange(149)}
+    counts = {r: len(list(ShardedDataset(data, 25, rank=r, size=2,
+                                         drop_last=True).epoch(0)))
+              for r in range(2)}
+    assert counts[0] == counts[1], counts
+
+
+def test_single_array_source():
+    ds = ShardedDataset(np.arange(16), 4, rank=0, size=1, shuffle=False)
+    first = next(iter(ds.epoch(0)))
+    np.testing.assert_array_equal(first, np.arange(4))
+
+
+def test_rejects_unequal_dims_and_tiny_datasets():
+    with pytest.raises(ValueError, match="unequal"):
+        ShardedDataset({"x": np.arange(4), "y": np.arange(5)}, 2,
+                       rank=0, size=1)
+    with pytest.raises(ValueError, match="cannot shard"):
+        ShardedDataset({"x": np.arange(2)}, 1, rank=0, size=4)
+
+
+def test_prefetch_to_device(devices):
+    import jax
+
+    data = {"x": np.arange(32).reshape(8, 4).astype(np.float32)}
+    ds = ShardedDataset(data, 2, rank=0, size=1, shuffle=False)
+    got = list(prefetch_to_device(ds.epoch(0), depth=2))
+    assert len(got) == 4
+    for b in got:
+        assert isinstance(b["x"], jax.Array)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["x"]) for b in got]), data["x"])
+
+
+def test_prefetch_with_sharding(bps, devices):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from byteps_tpu.core.state import get_state
+
+    mesh = get_state().mesh
+    sharding = NamedSharding(mesh, P("dp"))
+    data = {"x": np.arange(64).reshape(16, 4).astype(np.float32)}
+    ds = ShardedDataset(data, 8, rank=0, size=1, shuffle=False)
+    for b in prefetch_to_device(ds.epoch(0), sharding=sharding):
+        assert b["x"].sharding == sharding
+
+
+def test_prefetch_propagates_source_errors():
+    def bad():
+        yield {"x": np.zeros(2)}
+        raise RuntimeError("source exploded")
+
+    it = prefetch_to_device(bad(), depth=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="source exploded"):
+        next(it)

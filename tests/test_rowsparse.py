@@ -185,3 +185,45 @@ def test_rowsparse_public_api(monkeypatch):
         bps.shutdown()
         server.join(timeout=10)
         GlobalState._instance = None
+
+
+def test_rowsparse_through_scheduler_multipartition(monkeypatch):
+    """The public API rides the priority pipeline; multiple row-aligned
+    partitions fan out as scheduled tasks with prebuilt sparse payloads."""
+    from byteps_tpu.core.state import GlobalState
+
+    port = _PORT[0]
+    _PORT[0] += 1
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "8192")
+    server = threading.Thread(
+        target=run_server,
+        args=(port, Config(num_workers=1, num_servers=1)), daemon=True)
+    server.start()
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        from byteps_tpu.core.state import get_state
+        assert get_state().scheduler is not None
+        rows, width = 256, 32       # 32KB -> 4 partitions at 8KB
+        g = _sparse_grad(np.random.RandomState(5), rows, width, 30)
+        out = np.asarray(bps.push_pull_rowsparse(g, "emb/big",
+                                                 average=False))
+        np.testing.assert_allclose(out, g, rtol=1e-6)
+        ctx = get_state().registry.init_tensor(
+            "emb/big", rows * width * 4, None, align_bytes=width * 4)
+        assert len(ctx.partitions) > 1
+        # second round with a different pattern
+        g2 = _sparse_grad(np.random.RandomState(6), rows, width, 4)
+        out2 = np.asarray(bps.push_pull_rowsparse(g2, "emb/big",
+                                                  average=False))
+        np.testing.assert_allclose(out2, g2, rtol=1e-6)
+    finally:
+        bps.shutdown()
+        server.join(timeout=10)
+        GlobalState._instance = None
