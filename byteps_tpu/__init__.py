@@ -94,6 +94,23 @@ def profiler_step() -> None:
         tracer.step()
 
 
+def _rowsparse_submit(state, name: str, host2d, average: bool,
+                      handle) -> None:
+    """THE single rowsparse submit sequence (row-aligned declare +
+    scheduler enqueue), shared by push_pull_rowsparse, the torch adapter
+    and the jax PS train step so the semantics can't drift."""
+    import numpy as np
+
+    from .core.types import DataType
+
+    host2d = np.ascontiguousarray(host2d, np.float32)
+    ctx = state.registry.init_tensor(name, host2d.nbytes, DataType.FLOAT32,
+                                     align_bytes=host2d.shape[1] * 4)
+    state.scheduler.submit_rowsparse(
+        ctx, host2d, handle, average, state.config.num_workers,
+        version=state.next_version(name))
+
+
 def push_pull_rowsparse(tensor, name: str, average: bool = True):
     """Row-sparse PS push_pull for embedding-style gradients: ``tensor``
     is a dense [rows, width] f32 gradient whose rows are mostly zero
@@ -122,9 +139,7 @@ def push_pull_rowsparse(tensor, name: str, average: bool = True):
         # ride the priority pipeline like dense/compressed traffic; the
         # scheduler records true wire-byte telemetry per partition
         handle = state.handles.allocate(name)
-        state.scheduler.submit_rowsparse(
-            ctx, host, handle, average, state.config.num_workers,
-            version=state.next_version(name))
+        _rowsparse_submit(state, name, host, average, handle)
         return state.handles.wait_and_clear(handle.id)
     out = state.ps_client.push_pull_rowsparse(
         ctx, host, average=average, num_workers=state.config.num_workers)

@@ -161,6 +161,7 @@ def make_zero_train_step(
 
 
 _COMP_POOL = None
+_rowsparse_warned: set = set()  # names warned about dense fallback
 
 
 def _comp_pool():
@@ -183,6 +184,7 @@ def make_ps_train_step(
     axis: str = DP_AXIS,
     compression: Optional[dict] = None,
     min_compress_bytes: Optional[int] = None,
+    rowsparse_params: Optional[Tuple[str, ...]] = None,
 ):
     """Two-phase train step for the DCN PS path — the reference's actual
     architecture (docs/architecture.md "General Workflow"): the compiled
@@ -198,6 +200,12 @@ def make_ps_train_step(
     mirror (reference: BASELINE config 4 path; server.cc:92-118). EF and
     momentum state live worker-side per tensor. ``min_compress_bytes``
     gates small tensors onto the dense path (BYTEPS_MIN_COMPRESS_BYTES).
+
+    ``rowsparse_params``: substrings of gradient names (e.g.
+    ``("embed",)``) whose 2D gradients travel row-sparse — only nonzero
+    rows on the push wire (bps.push_pull_rowsparse; embedding gradients
+    are mostly zero rows). Takes precedence over ``compression`` for the
+    matching leaves.
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
     reads the PS client + registry from the global state at call time, so
@@ -266,6 +274,15 @@ def make_ps_train_step(
             # on a pool / run blocking.
             import byteps_tpu as bps
 
+            def submit_sparse(name, h2d, out_dtype):
+                from .. import _rowsparse_submit
+                handle = state.handles.allocate(name)
+                _rowsparse_submit(state, name,
+                                  h2d.astype(np.float32, copy=False),
+                                  True, handle)
+                return lambda: state.handles.wait_and_clear(
+                    handle.id).astype(out_dtype, copy=False)
+
             def submit(name, flat):
                 if reg is not None:
                     flat = flat.astype(np.float32, copy=False)
@@ -286,7 +303,22 @@ def make_ps_train_step(
             for name, leaf in zip(names, leaves):
                 h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
                 shapes.append(h.shape)
-                waiters.append(submit(name, h.reshape(-1)))
+                want_sparse = rowsparse_params and any(
+                    s in name for s in rowsparse_params)
+                if (want_sparse and state.scheduler is not None
+                        and h.ndim == 2):
+                    # non-f32 grads upcast for the wire, cast back below
+                    waiters.append(submit_sparse(name, h, h.dtype))
+                else:
+                    if want_sparse and name not in _rowsparse_warned:
+                        from ..utils.logging import log
+                        _rowsparse_warned.add(name)
+                        log.warning(
+                            "rowsparse_params matched %r but the "
+                            "gradient is not 2D (shape %s) or no "
+                            "scheduler is running — using the dense "
+                            "path", name, h.shape)
+                    waiters.append(submit(name, h.reshape(-1)))
             results = [w().reshape(shape)
                        for w, shape in zip(waiters, shapes)]
             grads = treedef.unflatten(results)

@@ -107,6 +107,24 @@ def _submit(host: np.ndarray, name: str, average: bool,
     return handle
 
 
+def _submit_rowsparse(host2d: np.ndarray, name: str,
+                      average: bool) -> Handle:
+    """Row-sparse submit: only the nonzero rows travel on the push wire
+    (embedding gradients; bps.push_pull_rowsparse semantics)."""
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError("byteps_tpu.torch: init() must be called first")
+    host2d = np.ascontiguousarray(host2d, np.float32)
+    handle = _handles.allocate(name)
+    handle._shape = host2d.shape
+    if state.scheduler is None:
+        handle._finish(host2d.copy(), None)
+        return handle
+    from .. import _rowsparse_submit
+    _rowsparse_submit(state, name, host2d, average, handle)
+    return handle
+
+
 def _wait(h: Handle, timeout: Optional[float] = None) -> np.ndarray:
     """Wait on a handle and release it from the manager."""
     return _handles.wait_and_clear(h.id, timeout)
@@ -263,6 +281,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._ctx: dict = {}
         self._wire_shape: dict = {}
         self._passes: dict = {}
+        self._sparse: set = set()   # params whose grads went row-sparse
         self._hook_refs = []
         if size() > 1 or get_state().scheduler is not None:
             self._register_hooks()
@@ -289,6 +308,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if self._backward_passes_per_step > 1:
                 # accumulated sum -> mean over passes
                 grad = grad / self._backward_passes_per_step
+            if grad.is_sparse and grad.dim() == 2:
+                # torch sparse gradients (nn.Embedding(sparse=True)):
+                # densify locally, ship only the nonzero rows
+                # (kRowSparsePushPull); the aggregated grad comes back
+                # dense, which every torch optimizer accepts
+                host2d = grad.coalesce().to_dense().detach().cpu().numpy()
+                h = _submit_rowsparse(host2d, "grad/" + name, True)
+                self._handles[p] = h
+                self._wire_shape[p] = host2d.shape
+                self._sparse.add(p)
+                return
+            if grad.is_sparse:
+                # non-2D sparse grads have no row structure for the wire
+                # format: densify and take the ordinary dense path
+                grad = grad.coalesce().to_dense()
             comp, ctx = self._compression.compress(grad)
             host = comp.detach().cpu().numpy()
             h = _submit(host, "grad/" + name, True, None)
@@ -302,11 +336,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p, h in list(self._handles.items()):
             out = _wait(h).reshape(self._wire_shape[p])
             t = torch.from_numpy(np.ascontiguousarray(out))
+            if p in self._sparse:
+                # the aggregate is dense; REPLACE the sparse grad object
+                with torch.no_grad():
+                    p.grad = t.to(p.dtype).reshape(p.shape)
+                continue
             t = self._compression.decompress(t, self._ctx[p])
             with torch.no_grad():
                 p.grad.copy_(t.to(p.grad.dtype).reshape(p.grad.shape))
         self._handles.clear()
         self._ctx.clear()
+        self._sparse.clear()
 
     def step(self, closure=None):
         self.synchronize()

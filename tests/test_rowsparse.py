@@ -227,3 +227,60 @@ def test_rowsparse_through_scheduler_multipartition(monkeypatch):
         bps.shutdown()
         server.join(timeout=10)
         GlobalState._instance = None
+
+
+def test_ps_train_step_rowsparse_params(monkeypatch):
+    """make_ps_train_step(rowsparse_params=("embed",)): the embedding
+    gradient travels row-sparse and training still converges to the same
+    trajectory as the dense path (1 worker => both are exact)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from byteps_tpu.core.state import GlobalState
+    from byteps_tpu.jax.train import make_ps_train_step
+    from byteps_tpu.models import llama
+
+    port = _PORT[0]
+    _PORT[0] += 1
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    server = threading.Thread(
+        target=run_server,
+        args=(port, Config(num_workers=1, num_servers=1)), daemon=True)
+    server.start()
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        from byteps_tpu.core.state import get_state
+        import dataclasses
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        tx = optax.sgd(0.1)
+
+        def run(**kw):
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            opt = tx.init(params)
+            step = make_ps_train_step(
+                lambda p, b: llama.loss_fn(p, b, cfg), tx,
+                get_state().mesh, **kw)
+            toks = jnp.asarray(np.arange(8 * 33).reshape(8, 33) % 13,
+                               jnp.int32)
+            for _ in range(3):
+                params, opt, loss = step(params, opt, {"tokens": toks})
+            return params, float(loss)
+
+        p_dense, l_dense = run()
+        p_sparse, l_sparse = run(rowsparse_params=("embed", "lm_head"))
+        assert np.isclose(l_dense, l_sparse, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p_dense), jax.tree.leaves(p_sparse)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+    finally:
+        bps.shutdown()
+        server.join(timeout=10)
+        GlobalState._instance = None

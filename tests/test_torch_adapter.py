@@ -218,3 +218,46 @@ def test_two_worker_mean(monkeypatch):
         bpt_mod.shutdown()
         server.join(timeout=10)
         _fresh_state()
+
+
+def test_sparse_embedding_gradients(bpt_ps):
+    """nn.Embedding(sparse=True) gradients ride the row-sparse wire; the
+    optimizer sees the aggregated DENSE gradient and training matches a
+    plain torch run (1 worker => identity aggregation)."""
+    import numpy as np
+
+    def build(seed):
+        torch.manual_seed(seed)
+        return torch.nn.Sequential(
+            torch.nn.Embedding(50, 8, sparse=True),
+            torch.nn.Flatten(),
+            torch.nn.Linear(8 * 4, 5))
+
+    ids = torch.from_numpy(
+        np.random.RandomState(0).randint(0, 50, (16, 4)))
+    y = torch.from_numpy(np.random.RandomState(1).randint(0, 5, 16))
+
+    ref = build(3)
+    # plain torch: sparse grads need dense optim only for SGD w/o momentum
+    ro = torch.optim.SGD(ref.parameters(), lr=0.1)
+    for _ in range(4):
+        ro.zero_grad()
+        torch.nn.functional.cross_entropy(ref(ids), y).backward()
+        ro.step()
+
+    model = build(3)
+    opt = bpt_ps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    for _ in range(4):
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(ids), y).backward()
+        opt.step()
+        assert model[0].weight.grad is None or \
+            not model[0].weight.grad.is_sparse  # replaced with dense
+
+    for (n1, p1), (n2, p2) in zip(ref.named_parameters(),
+                                  model.named_parameters()):
+        np.testing.assert_allclose(p1.detach().numpy(),
+                                   p2.detach().numpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=n1)
