@@ -133,14 +133,15 @@ def push_pull_rowsparse(tensor, name: str, average: bool = True):
     if host.ndim != 2:
         raise ValueError(f"expected [rows, width], got shape {host.shape}")
     from .core.types import DataType
-    ctx = state.registry.init_tensor(name, host.nbytes, DataType.FLOAT32,
-                                     align_bytes=host.shape[1] * 4)
     if state.scheduler is not None and state.handles is not None:
         # ride the priority pipeline like dense/compressed traffic; the
         # scheduler records true wire-byte telemetry per partition
+        # (_rowsparse_submit declares the tensor itself)
         handle = state.handles.allocate(name)
         _rowsparse_submit(state, name, host, average, handle)
         return state.handles.wait_and_clear(handle.id)
+    ctx = state.registry.init_tensor(name, host.nbytes, DataType.FLOAT32,
+                                     align_bytes=host.shape[1] * 4)
     out = state.ps_client.push_pull_rowsparse(
         ctx, host, average=average, num_workers=state.config.num_workers)
     # actual wire traffic: sparse push (headers + ids + nonzero rows) up,

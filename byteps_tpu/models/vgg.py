@@ -1,0 +1,109 @@
+"""VGG for image classification, TPU-first.
+
+VGG-16 is the reference's second headline benchmark model — the one where
+BytePS posts its largest dense-DP wins (+100% over Horovod on 20 Gbps TCP,
++17% worst case; reference: docs/performance.md:9,22) because VGG's 138M
+parameters are dominated by the fc layers and stress gradient bandwidth.
+That makes it the natural stress vehicle for the push_pull tier here too.
+
+Functional params; NHWC layout (TPU-native); bf16 compute with fp32
+master params; convs padded SAME, 2x2 max-pool between stages; classifier
+is the classic 4096-4096-n_classes stack. No BatchNorm (matching the
+torchvision ``vgg16`` the reference benchmarks with).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    # channels per conv layer, "M" = 2x2 max-pool (torchvision config "D")
+    plan: Tuple[Any, ...] = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                             512, 512, 512, "M", 512, 512, 512, "M")
+    fc_width: int = 4096
+    n_classes: int = 1000
+    image_size: int = 224
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @staticmethod
+    def vgg16() -> "VGGConfig":
+        return VGGConfig()
+
+    @staticmethod
+    def vgg11() -> "VGGConfig":
+        return VGGConfig(plan=(64, "M", 128, "M", 256, 256, "M",
+                               512, 512, "M", 512, 512, "M"))
+
+    @staticmethod
+    def tiny(n_classes: int = 10) -> "VGGConfig":
+        return VGGConfig(plan=(16, "M", 32, "M"), fc_width=64,
+                         n_classes=n_classes, image_size=32)
+
+
+def init_params(rng: jax.Array, cfg: VGGConfig) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, len(cfg.plan) + 4))
+    params: Dict[str, Any] = {}
+    cin = 3
+    for i, c in enumerate(cfg.plan):
+        if c == "M":
+            continue
+        fan_in = 3 * 3 * cin
+        params[f"conv{i}"] = {
+            "w": jax.random.normal(next(keys), (3, 3, cin, c), pd)
+            * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((c,), pd),
+        }
+        cin = c
+    # spatial extent after the pools (224 -> 7 for the full plan)
+    spatial = cfg.image_size // (2 ** sum(1 for c in cfg.plan if c == "M"))
+    flat = cin * spatial * spatial
+    for j, (fin, fout) in enumerate(
+            [(flat, cfg.fc_width), (cfg.fc_width, cfg.fc_width),
+             (cfg.fc_width, cfg.n_classes)]):
+        params[f"fc{j}"] = {
+            "w": jax.random.normal(next(keys), (fin, fout), pd)
+            * np.sqrt(2.0 / fin),
+            "b": jnp.zeros((fout,), pd),
+        }
+    return params
+
+
+def forward(params, x: jnp.ndarray, cfg: VGGConfig) -> jnp.ndarray:
+    """x [B,H,W,3] -> logits [B,n_classes] fp32."""
+    h = x.astype(cfg.dtype)
+    for i, c in enumerate(cfg.plan):
+        if c == "M":
+            h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max,
+                                      (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+            continue
+        p = params[f"conv{i}"]
+        h = jax.lax.conv_general_dilated(
+            h, p["w"].astype(h.dtype), window_strides=(1, 1),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = jax.nn.relu(h + p["b"].astype(h.dtype))
+    h = h.reshape(h.shape[0], -1)
+    for j in range(3):
+        p = params[f"fc{j}"]
+        h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+        if j < 2:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: VGGConfig):
+    logits = forward(params, batch["x"], cfg)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.mean(jnp.take_along_axis(logp, batch["y"][:, None], -1))
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

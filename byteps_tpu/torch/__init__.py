@@ -339,7 +339,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if p in self._sparse:
                 # the aggregate is dense; REPLACE the sparse grad object
                 with torch.no_grad():
-                    p.grad = t.to(p.dtype).reshape(p.shape)
+                    p.grad = t.to(p.device, p.dtype).reshape(p.shape)
                 continue
             t = self._compression.decompress(t, self._ctx[p])
             with torch.no_grad():
