@@ -10,11 +10,13 @@
 // Protocol (little-endian, same-arch assumption documented in server/README):
 //   MsgHeader { magic u32; op u8; flags u8; sender u16; rid u32; key u64;
 //               cmd u32; len u32 }  -- 28 bytes, then len payload bytes.
-// Ops: INIT_PUSH, PUSH, PULL, BARRIER, SHUTDOWN from workers;
+// Ops: INIT_PUSH, PUSH, PULL, BARRIER, SHUTDOWN, IPC_HELLO from workers;
 //      ACK, PULL_REPLY from the server. Every request carries a worker-side
 //      request id (rid) echoed in the reply, so one connection multiplexes
 //      concurrent blocking calls from many scheduler threads (the ps-lite
-//      callback model, flattened to promise/wait).
+//      callback model, flattened to promise/wait). IPC_HELLO upgrades a
+//      loopback connection to the colocated shm transport (see the
+//      "Colocated shm transport" section below).
 //
 // Aggregation protocol per key (sync mode, mirrors server.cc:296-409):
 //   - INIT_PUSH allocates the page-aligned store; the reply is withheld
@@ -33,15 +35,24 @@
 // is enabled (reference: server/queue.h:31-105).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
+#if defined(__linux__)
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#endif
 
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <climits>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -75,6 +86,7 @@ enum Op : uint8_t {
   ACK = 6,
   PULL_REPLY = 7,
   COMP_INIT = 8,  // per-key compressor kwargs (operations.cc:396-408)
+  IPC_HELLO = 9,  // colocated shm-transport upgrade (BYTEPS_ENABLE_IPC)
 };
 
 enum ReqType : uint32_t {
@@ -184,6 +196,240 @@ static void tune_socket(int fd) {
   int buf = 8 << 20;  // 8 MB socket buffers for multi-MB partitions
   ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+// ------------------------------------------------------------------ //
+// Colocated shm transport (IPC upgrade)
+//
+// The reference's ps-lite offers an IPC shortcut for workers colocated
+// with a server (BYTEPS_ENABLE_IPC, docs/best-practice.md:32) so loopback
+// traffic skips the NIC/TCP stack. Same idea here, TPU-host grounded: a
+// client connecting to a loopback server offers a POSIX shm segment
+// holding two byte-stream rings (client->server, server->client) via an
+// in-band IPC_HELLO; on ACK both sides move ALL protocol traffic to the
+// rings. A message then costs one user-space copy per side instead of
+// two kernel crossings + TCP, which on a small-core PS host roughly
+// doubles attainable push_pull GB/s. The TCP connection stays open,
+// silent, as the liveness signal: either side's death surfaces as EOF,
+// observed by the ring reader's bounded futex waits, so the failure
+// detection and shutdown semantics of the TCP path carry over unchanged.
+// Wakeups are shared futexes (no syscalls in the streaming steady state:
+// wake only when the peer registered as waiting); non-Linux builds fall
+// back to short timed waits through the same code path.
+
+static constexpr uint32_t kIpcMagic = 0xB17E51DC;
+
+#if defined(__linux__)
+static void futex_wait_u32(std::atomic<uint32_t>* addr, uint32_t expect,
+                           long timeout_ns) {
+  timespec ts{timeout_ns / 1000000000L, timeout_ns % 1000000000L};
+  ::syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT,
+            expect, &ts, nullptr, 0);
+}
+static void futex_wake_u32(std::atomic<uint32_t>* addr) {
+  ::syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE,
+            INT_MAX, nullptr, nullptr, 0);
+}
+#else
+static void futex_wait_u32(std::atomic<uint32_t>*, uint32_t, long t_ns) {
+  ::usleep((useconds_t)(t_ns / 1000 > 500 ? 500 : t_ns / 1000));
+}
+static void futex_wake_u32(std::atomic<uint32_t>*) {}
+#endif
+
+// One direction of the channel: an SPSC byte-stream ring (the writer side
+// is serialized by the connection's write mutex). head/tail are monotonic
+// byte positions; futex words signal "data arrived" / "space freed".
+struct alignas(64) IpcRing {
+  std::atomic<uint64_t> head;
+  char pad0[56];
+  std::atomic<uint64_t> tail;
+  char pad1[56];
+  std::atomic<uint32_t> data_seq;
+  std::atomic<uint32_t> data_waiters;
+  std::atomic<uint32_t> space_seq;
+  std::atomic<uint32_t> space_waiters;
+  char pad2[48];
+};
+
+struct IpcShm {
+  uint32_t magic;
+  uint32_t ring_size;
+  IpcRing c2s;
+  IpcRing s2c;
+  // followed by: uint8_t c2s_data[ring_size], s2c_data[ring_size]
+};
+
+static_assert(std::atomic<uint64_t>::is_always_lock_free &&
+              std::atomic<uint32_t>::is_always_lock_free,
+              "shm ring atomics must be address-free");
+
+class IpcChan {
+ public:
+  // Takes ownership of the mapping (munmaps on destruction), NOT of fd.
+  IpcChan(void* base, size_t map_len, int fd, bool is_server)
+      : base_(base), map_len_(map_len), fd_(fd) {
+    IpcShm* s = reinterpret_cast<IpcShm*>(base);
+    size_ = s->ring_size;
+    uint8_t* d0 = reinterpret_cast<uint8_t*>(base) + sizeof(IpcShm);
+    if (is_server) {
+      rx_ = &s->c2s; rx_data_ = d0;
+      tx_ = &s->s2c; tx_data_ = d0 + size_;
+    } else {
+      tx_ = &s->c2s; tx_data_ = d0;
+      rx_ = &s->s2c; rx_data_ = d0 + size_;
+    }
+  }
+  ~IpcChan() {
+    if (base_) ::munmap(base_, map_len_);
+  }
+
+  // Writer: serialized externally (connection write mutex) -> header and
+  // payload land contiguously in the byte stream.
+  bool send_msg(const MsgHeader& h, const void* payload) {
+    if (!send(&h, sizeof(h))) return false;
+    return h.len == 0 || send(payload, h.len);
+  }
+
+  bool send(const void* p, size_t n) {
+    const uint8_t* src = static_cast<const uint8_t*>(p);
+    while (n) {
+      // fail fast once the channel is dead (peer EOF seen by the recv
+      // loop, or teardown) — otherwise a send into a ring nobody reads
+      // "succeeds" and the caller wedges until its request timeout,
+      // where the TCP path would have errored in milliseconds
+      if (broken_.load()) return false;
+      uint64_t head = tx_->head.load(std::memory_order_relaxed);
+      uint64_t tail = tx_->tail.load(std::memory_order_acquire);
+      uint64_t free = size_ - (head - tail);
+      if (free == 0) {
+        if (!wait(tx_, &tx_->space_seq, &tx_->space_waiters,
+                  [&] { return size_ - (tx_->head.load(std::memory_order_relaxed) -
+                                        tx_->tail.load(std::memory_order_acquire)) != 0; },
+                  /*check_peer=*/false))
+          return false;
+        continue;
+      }
+      size_t chunk = n < free ? n : (size_t)free;
+      size_t off = (size_t)(head % size_);
+      size_t first = chunk < size_ - off ? chunk : size_ - off;
+      std::memcpy(tx_data_ + off, src, first);
+      std::memcpy(tx_data_, src + first, chunk - first);
+      tx_->head.store(head + chunk, std::memory_order_release);
+      tx_->data_seq.fetch_add(1, std::memory_order_release);
+      if (tx_->data_waiters.load() != 0) futex_wake_u32(&tx_->data_seq);
+      src += chunk;
+      n -= chunk;
+    }
+    return true;
+  }
+
+  // Reader: single thread per channel (the connection's recv loop).
+  bool recv(void* p, size_t n) {
+    uint8_t* dst = static_cast<uint8_t*>(p);
+    while (n) {
+      uint64_t head = rx_->head.load(std::memory_order_acquire);
+      uint64_t tail = rx_->tail.load(std::memory_order_relaxed);
+      uint64_t avail = head - tail;
+      if (avail == 0) {
+        if (!wait(rx_, &rx_->data_seq, &rx_->data_waiters,
+                  [&] { return rx_->head.load(std::memory_order_acquire) !=
+                               rx_->tail.load(std::memory_order_relaxed); },
+                  /*check_peer=*/true))
+          return false;
+        continue;
+      }
+      size_t chunk = n < avail ? n : (size_t)avail;
+      size_t off = (size_t)(tail % size_);
+      size_t first = chunk < size_ - off ? chunk : size_ - off;
+      std::memcpy(dst, rx_data_ + off, first);
+      std::memcpy(dst + first, rx_data_, chunk - first);
+      rx_->tail.store(tail + chunk, std::memory_order_release);
+      rx_->space_seq.fetch_add(1, std::memory_order_release);
+      if (rx_->space_waiters.load() != 0) futex_wake_u32(&rx_->space_seq);
+      dst += chunk;
+      n -= chunk;
+    }
+    return true;
+  }
+
+  // Unblocks every waiter on both rings (local threads AND the peer —
+  // the peer then notices EOF on its fd). Used on Close/teardown.
+  void mark_broken() {
+    broken_.store(true);
+    for (IpcRing* r : {tx_, rx_}) {
+      r->data_seq.fetch_add(1);
+      futex_wake_u32(&r->data_seq);
+      r->space_seq.fetch_add(1);
+      futex_wake_u32(&r->space_seq);
+    }
+  }
+  bool broken() const { return broken_.load(); }
+
+ private:
+  template <typename Pred>
+  bool wait(IpcRing*, std::atomic<uint32_t>* seq,
+            std::atomic<uint32_t>* waiters, Pred ready, bool check_peer) {
+    for (int i = 0; i < 32; ++i) {  // brief pre-futex window
+      if (ready()) return true;
+      if (broken_.load()) return false;
+      ::sched_yield();
+    }
+    while (true) {
+      if (ready()) return true;
+      if (broken_.load()) return false;
+      if (check_peer && !peer_alive()) {
+        mark_broken();
+        return false;
+      }
+      waiters->fetch_add(1);
+      uint32_t s = seq->load();
+      if (ready() || broken_.load()) {
+        waiters->fetch_sub(1);
+        continue;
+      }
+      futex_wait_u32(seq, s, 5'000'000);  // 5ms: liveness granularity
+      waiters->fetch_sub(1);
+    }
+  }
+
+  // After the upgrade the TCP fd is silent; readable-with-EOF or HUP
+  // means the peer died (or closed cleanly without SHUTDOWN — elastic
+  // suspend), which the TCP path would have seen as recv_all failing.
+  bool peer_alive() {
+    pollfd pfd{fd_, POLLIN, 0};
+    if (::poll(&pfd, 1, 0) <= 0) return !(pfd.revents & (POLLERR | POLLNVAL));
+    if (pfd.revents & (POLLHUP | POLLERR | POLLNVAL)) return false;
+    if (pfd.revents & POLLIN) {
+      char junk[64];
+      ssize_t r = ::recv(fd_, junk, sizeof(junk), MSG_DONTWAIT);
+      if (r == 0) return false;  // EOF
+    }
+    return true;
+  }
+
+  void* base_;
+  size_t map_len_;
+  int fd_;
+  uint64_t size_;
+  IpcRing* tx_;
+  IpcRing* rx_;
+  uint8_t* tx_data_;
+  uint8_t* rx_data_;
+  std::atomic<bool> broken_{false};
+};
+
+static bool ipc_enabled() {
+  const char* e = ::getenv("BYTEPS_ENABLE_IPC");
+  return !(e && (e[0] == '0' || e[0] == 'f' || e[0] == 'F'));
+}
+
+static size_t ipc_ring_bytes() {
+  if (const char* e = ::getenv("BYTEPS_IPC_RING_BYTES")) {
+    long v = std::atol(e);
+    if (v >= (64 << 10)) return (size_t)v;
+  }
+  return 8 << 20;
 }
 
 // 16-bit float conversions for summation. The reference's fp16 path
@@ -618,9 +864,16 @@ struct Conn {
     if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
   }
   std::mutex write_mu;
+  // shm transport after an IPC_HELLO upgrade; null = plain TCP
+  std::unique_ptr<IpcChan> ipc;
   bool send_msg(const MsgHeader& h, const void* payload) {
     std::lock_guard<std::mutex> lk(write_mu);
+    if (ipc) return ipc->send_msg(h, payload);
     return send_msg_iov(fd, h, payload);
+  }
+  bool recv_bytes(void* p, size_t n) {  // conn-loop thread only
+    if (ipc) return ipc->recv(p, n);
+    return recv_all(fd, p, n);
   }
 };
 
@@ -810,7 +1063,7 @@ class Server {
 
   void ConnLoop(std::shared_ptr<Conn> conn) {
     MsgHeader h;
-    while (recv_all(conn->fd, &h, sizeof(h))) {
+    while (conn->recv_bytes(&h, sizeof(h))) {
       if (h.magic != kMagic) {
         std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
         break;
@@ -836,7 +1089,11 @@ class Server {
       m.dtype = dtype;
       if (h.len) {
         m.payload.resize(h.len);
-        if (!recv_all(conn->fd, m.payload.data(), h.len)) break;
+        if (!conn->recv_bytes(m.payload.data(), h.len)) break;
+      }
+      if (h.op == IPC_HELLO) {
+        HandleIpcHello(conn, h.rid, m.payload);
+        continue;
       }
       if (h.op == BARRIER) {
         HandleBarrier(std::move(m));
@@ -861,6 +1118,7 @@ class Server {
     // and fail every parked request immediately, so surviving workers
     // get an error in milliseconds instead of wedging on a sync round
     // that can never complete until their client timeout fires.
+    if (conn->ipc) conn->ipc->mark_broken();  // fail engine sends too
     conn->dead.store(true);
     int snd = conn->sender.load();
     if (snd >= 0) {
@@ -920,6 +1178,49 @@ class Server {
     for (auto& p : victims) {
       MsgHeader r{kMagic, ACK, 1, 0, p.rid, 0, 0, 0};  // flags=1: error
       p.conn->send_msg(r, nullptr);
+    }
+  }
+
+  void HandleIpcHello(const std::shared_ptr<Conn>& conn, uint32_t rid,
+                      const std::vector<uint8_t>& payload) {
+    // Client offered a shm segment (its first message on this conn; no
+    // requests are in flight). Map + validate, ACK over TCP, THEN switch
+    // the conn to the rings — the ACK must not ride the ring the client
+    // only trusts after seeing it. Any failure error-ACKs and the conn
+    // simply stays TCP.
+    std::string name(reinterpret_cast<const char*>(payload.data()),
+                     payload.size());
+    bool ok = false;
+    int sfd = name.empty() ? -1 : ::shm_open(name.c_str(), O_RDWR, 0);
+    if (sfd >= 0) {
+      struct stat st {};
+      void* base = MAP_FAILED;
+      if (::fstat(sfd, &st) == 0 && st.st_size > (off_t)sizeof(IpcShm)) {
+        base = ::mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, sfd, 0);
+      }
+      ::close(sfd);
+      if (base != MAP_FAILED) {
+        IpcShm* s = reinterpret_cast<IpcShm*>(base);
+        if (s->magic == kIpcMagic && s->ring_size >= (64 << 10) &&
+            (size_t)st.st_size ==
+                sizeof(IpcShm) + 2 * (size_t)s->ring_size) {
+          MsgHeader r{kMagic, ACK, 0, 0, rid, 0, 0, 0};
+          conn->send_msg(r, nullptr);  // still TCP: ipc not yet set
+          conn->ipc.reset(
+              new IpcChan(base, (size_t)st.st_size, conn->fd, true));
+          ok = true;
+        } else {
+          ::munmap(base, (size_t)st.st_size);
+        }
+      }
+    }
+    if (!ok) {
+      std::fprintf(stderr,
+                   "[bps-server] ipc upgrade declined (shm %s)\n",
+                   name.c_str());
+      MsgHeader r{kMagic, ACK, 1, 0, rid, 0, 0, 0};
+      conn->send_msg(r, nullptr);
     }
   }
 
@@ -1505,7 +1806,7 @@ struct Waiter {
 
 class ServerConn {
  public:
-  bool Connect(const std::string& host, int port) {
+  bool Connect(const std::string& host, int port, uint16_t sender) {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
@@ -1514,6 +1815,14 @@ class ServerConn {
     for (int attempt = 0; attempt < 200; ++attempt) {
       if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
         tune_socket(fd_);
+        // loopback => same machine: offer the shm transport before any
+        // other traffic (so the upgrade handshake never races in-flight
+        // requests). Falls back to TCP if the server declines. The hello
+        // must carry the real worker id — the server latches a conn's
+        // owner from its FIRST message (failure detection counts live
+        // conns per worker).
+        if (ipc_enabled() && ntohl(addr.sin_addr.s_addr) >> 24 == 127)
+          TryIpcUpgrade(sender);
         recv_thread_ = std::thread([this] { RecvLoop(); });
         return true;
       }
@@ -1522,10 +1831,15 @@ class ServerConn {
     return false;
   }
 
+  bool ipc_active() const { return chan_ != nullptr; }
+
   void Close() {
     // shutdown() wakes the recv thread without invalidating the fd; the
     // close() must wait for the join — closing an fd another thread is
-    // blocked on is a race (and could close a reused descriptor)
+    // blocked on is a race (and could close a reused descriptor). For an
+    // ipc conn, mark_broken unblocks a recv parked in a futex wait and
+    // the fd shutdown doubles as the death signal to the server.
+    if (chan_) chan_->mark_broken();
     if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
     if (recv_thread_.joinable()) recv_thread_.join();
     if (fd_ >= 0) {
@@ -1549,7 +1863,9 @@ class ServerConn {
     MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
     {
       std::lock_guard<std::mutex> lk(send_mu_);
-      if (!send_msg_iov(fd_, h, data)) {
+      bool sent = chan_ ? chan_->send_msg(h, data)
+                        : send_msg_iov(fd_, h, data);
+      if (!sent) {
         std::lock_guard<std::mutex> lk2(waiters_mu_);
         waiters_.erase(rid);
         return ~0u;
@@ -1597,9 +1913,54 @@ class ServerConn {
   }
 
  private:
+  bool rx(void* p, size_t n) {
+    return chan_ ? chan_->recv(p, n) : recv_all(fd_, p, n);
+  }
+
+  // Offer a fresh shm segment over the just-established TCP conn and wait
+  // for the verdict synchronously (no recv thread yet, no other traffic).
+  // Any failure cleans up and leaves the conn plain TCP.
+  void TryIpcUpgrade(uint16_t sender) {
+    static std::atomic<uint32_t> seq{0};
+    char name[64];
+    std::snprintf(name, sizeof(name), "/bps-ipc-%d-%u", (int)::getpid(),
+                  seq.fetch_add(1));
+    size_t ring = ipc_ring_bytes();
+    size_t total = sizeof(IpcShm) + 2 * ring;
+    int sfd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (sfd < 0) return;
+    if (::ftruncate(sfd, (off_t)total) != 0) {
+      ::close(sfd);
+      ::shm_unlink(name);
+      return;
+    }
+    void* base = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                        sfd, 0);
+    ::close(sfd);
+    if (base == MAP_FAILED) {
+      ::shm_unlink(name);
+      return;
+    }
+    IpcShm* s = reinterpret_cast<IpcShm*>(base);  // pages arrive zeroed
+    s->ring_size = (uint32_t)ring;
+    s->magic = kIpcMagic;
+    MsgHeader h{kMagic, IPC_HELLO, 0, sender, 0, 0, 0,
+                (uint32_t)std::strlen(name)};
+    MsgHeader r{};
+    bool ok = send_msg_iov(fd_, h, name) && recv_all(fd_, &r, sizeof(r)) &&
+              r.op == ACK && (r.flags & 1) == 0;
+    ::shm_unlink(name);  // server has it mapped (or declined): name gone
+    if (!ok) {
+      ::munmap(base, total);
+      std::fprintf(stderr, "[bps-client] ipc upgrade declined, using TCP\n");
+      return;
+    }
+    chan_.reset(new IpcChan(base, total, fd_, false));
+  }
+
   void RecvLoop() {
     MsgHeader h;
-    while (recv_all(fd_, &h, sizeof(h))) {
+    while (rx(&h, sizeof(h))) {
       std::shared_ptr<Waiter> w;
       {
         std::lock_guard<std::mutex> lk(waiters_mu_);
@@ -1611,16 +1972,16 @@ class ServerConn {
       }
       if (!w) {  // unknown rid: drain payload
         std::vector<uint8_t> junk(h.len);
-        if (h.len && !recv_all(fd_, junk.data(), h.len)) break;
+        if (h.len && !rx(junk.data(), h.len)) break;
         continue;
       }
       bool ok = true;
       if (h.len) {
         if (w->out && h.len <= w->out_len) {
-          ok = recv_all(fd_, w->out, h.len);
+          ok = rx(w->out, h.len);
         } else {
           std::vector<uint8_t> junk(h.len);
-          ok = recv_all(fd_, junk.data(), h.len);
+          ok = rx(junk.data(), h.len);
         }
       }
       bool server_err = (h.flags & 1) != 0;
@@ -1645,6 +2006,7 @@ class ServerConn {
   }
 
   int fd_ = -1;
+  std::unique_ptr<IpcChan> chan_;  // set before recv_thread_ spawns
   std::mutex send_mu_;
   std::thread recv_thread_;
   std::mutex waiters_mu_;
@@ -1676,7 +2038,8 @@ class Client {
       auto g = std::make_unique<ConnGroup>();
       for (int j = 0; j < k; ++j) {
         auto c = std::make_unique<ServerConn>();
-        if (!c->Connect(servers[i].first, servers[i].second)) return false;
+        if (!c->Connect(servers[i].first, servers[i].second, worker_id_))
+          return false;
         g->conns.push_back(std::move(c));
       }
       groups_.push_back(std::move(g));
@@ -1723,6 +2086,20 @@ class Client {
     uint32_t r = groups_[0]->conns[0]->Request(BARRIER, 0, 0, worker_id_,
                                                nullptr, 0, nullptr, 0);
     return r == ~0u ? -1 : 0;
+  }
+
+  int IpcConns() const {
+    int n = 0;
+    for (auto& g : groups_)
+      for (auto& c : g->conns)
+        if (c && c->ipc_active()) n++;
+    return n;
+  }
+
+  int TotalConns() const {
+    int n = 0;
+    for (auto& g : groups_) n += (int)g->conns.size();
+    return n;
   }
 
   int Shutdown() {
@@ -1822,6 +2199,12 @@ int bps_client_pull(void* c, int server, uint64_t key, void* out,
 }
 
 int bps_client_barrier(void* c) { return ((bps::Client*)c)->Barrier(); }
+
+int bps_client_ipc_conns(void* c) { return ((bps::Client*)c)->IpcConns(); }
+
+int bps_client_total_conns(void* c) {
+  return ((bps::Client*)c)->TotalConns();
+}
 
 int bps_client_shutdown(void* c) { return ((bps::Client*)c)->Shutdown(); }
 

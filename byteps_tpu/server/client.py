@@ -43,6 +43,10 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
+    lib.bps_client_ipc_conns.argtypes = [ctypes.c_void_p]
+    lib.bps_client_ipc_conns.restype = ctypes.c_int
+    lib.bps_client_total_conns.argtypes = [ctypes.c_void_p]
+    lib.bps_client_total_conns.restype = ctypes.c_int
     lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
     lib.bps_client_shutdown.restype = ctypes.c_int
     lib.bps_client_destroy.argtypes = [ctypes.c_void_p]
@@ -137,6 +141,11 @@ class PSClient:
             raise RuntimeError(
                 f"failed to connect to PS servers {servers!r}")
         self._servers = list(servers)
+        n_ipc = self._lib.bps_client_ipc_conns(self._handle)
+        if n_ipc:
+            log.info("PS client: %d/%d connections upgraded to shm IPC "
+                     "transport (BYTEPS_ENABLE_IPC)", n_ipc,
+                     self._lib.bps_client_total_conns(self._handle))
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=num_threads, thread_name_prefix="bps-pushpull")
         self._closed = False
@@ -145,6 +154,11 @@ class PSClient:
         # (server-side initialization is per-store, distinct from registry
         # declaration; a resize needs a fresh init push)
         self._inited_keys: dict = {}
+
+    @property
+    def ipc_conns(self) -> int:
+        """Connections riding the colocated shm transport (0 = all TCP)."""
+        return int(self._lib.bps_client_ipc_conns(self._handle))
 
     # ------------------------------------------------------------ #
     # raw per-key ops (ZPush/ZPull)
