@@ -1,0 +1,17 @@
+"""Optional training utilities beyond the core framework surface.
+
+The reference ships ``byteps/misc/imagenet18`` — a half-precision
+distributed-optimizer variant used for its fast-ImageNet training recipe
+(reference: byteps/misc/imagenet18/__init__.py:39). The TPU equivalent is
+:mod:`byteps_tpu.misc.mixed_precision`: policy-driven half-precision
+training (bf16 natively, fp16 with dynamic loss scaling) that composes
+with ``byteps_tpu.jax.distributed_optimizer``.
+"""
+
+from .mixed_precision import (  # noqa: F401
+    MixedPrecisionPolicy,
+    cast_to_compute,
+    cast_to_param,
+    dynamic_loss_scaling,
+    mixed_precision_optimizer,
+)

@@ -158,6 +158,8 @@ class PSClient:
     @property
     def ipc_conns(self) -> int:
         """Connections riding the colocated shm transport (0 = all TCP)."""
+        if self._closed:
+            raise RuntimeError("PSClient is closed")
         return int(self._lib.bps_client_ipc_conns(self._handle))
 
     # ------------------------------------------------------------ #

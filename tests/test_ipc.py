@@ -70,72 +70,66 @@ def test_ipc_disabled_falls_back_to_tcp(monkeypatch):
         t.join(timeout=10)
 
 
-def test_ipc_two_workers_sum_matches_tcp():
+def test_ipc_two_workers_sum_matches_tcp(monkeypatch):
     """Same 2-worker aggregation, once over shm and once over TCP: the
     transports must be numerically indistinguishable."""
     results = {}
     for label, env in (("ipc", None), ("tcp", "0")):
         if env is None:
-            os.environ.pop("BYTEPS_ENABLE_IPC", None)
+            monkeypatch.delenv("BYTEPS_ENABLE_IPC", raising=False)
         else:
-            os.environ["BYTEPS_ENABLE_IPC"] = env
-        try:
-            addrs, threads = start_servers(1, num_workers=2)
-            cs = [PSClient(addrs, worker_id=w) for w in range(2)]
-            want_ipc = env is None
-            assert all((c.ipc_conns > 0) == want_ipc for c in cs)
-            rng = np.random.RandomState(7)
-            xs = [rng.randn(8192).astype(np.float32) for _ in range(2)]
-            # init blocks until BOTH workers' init pushes arrive: parallel
-            its = [threading.Thread(
-                target=lambda c=c: c.init_key(0, 11, np.zeros_like(xs[0]),
-                                              CMD_F32)) for c in cs]
-            for t in its:
-                t.start()
-            for t in its:
-                t.join(timeout=60)
-            outs = [np.empty_like(xs[0]) for _ in range(2)]
+            monkeypatch.setenv("BYTEPS_ENABLE_IPC", env)
+        addrs, threads = start_servers(1, num_workers=2)
+        cs = [PSClient(addrs, worker_id=w) for w in range(2)]
+        want_ipc = env is None
+        assert all((c.ipc_conns > 0) == want_ipc for c in cs)
+        rng = np.random.RandomState(7)
+        xs = [rng.randn(8192).astype(np.float32) for _ in range(2)]
+        # init blocks until BOTH workers' init pushes arrive: parallel
+        its = [threading.Thread(
+            target=lambda c=c: c.init_key(0, 11, np.zeros_like(xs[0]),
+                                          CMD_F32)) for c in cs]
+        for t in its:
+            t.start()
+        for t in its:
+            t.join(timeout=60)
+        outs = [np.empty_like(xs[0]) for _ in range(2)]
 
-            def round_trip(w):
-                cs[w].zpush(0, 11, xs[w], CMD_F32)
-                cs[w].zpull(0, 11, outs[w], CMD_F32)
+        def round_trip(w):
+            cs[w].zpush(0, 11, xs[w], CMD_F32)
+            cs[w].zpull(0, 11, outs[w], CMD_F32)
 
-            ts = [threading.Thread(target=round_trip, args=(w,))
-                  for w in range(2)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join(timeout=60)
-            results[label] = outs[0].copy()
-            np.testing.assert_array_equal(outs[0], outs[1])
-            for c in cs:
-                c.close()
-            for t in threads:
-                t.join(timeout=10)
-        finally:
-            os.environ.pop("BYTEPS_ENABLE_IPC", None)
+        ts = [threading.Thread(target=round_trip, args=(w,))
+              for w in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        results[label] = outs[0].copy()
+        np.testing.assert_array_equal(outs[0], outs[1])
+        for c in cs:
+            c.close()
+        for t in threads:
+            t.join(timeout=10)
     np.testing.assert_array_equal(results["ipc"], results["tcp"])
 
 
-def test_ipc_large_message_exceeds_ring():
+def test_ipc_large_message_exceeds_ring(monkeypatch):
     """Messages larger than the ring stream through in chunks (byte-stream
     semantics, not datagram): a 1MB payload over a 64KB ring."""
-    os.environ["BYTEPS_IPC_RING_BYTES"] = str(64 << 10)
-    try:
-        addrs, threads = start_servers(1, num_workers=1)
-        c = PSClient(addrs, worker_id=0)
-        assert c.ipc_conns > 0
-        x = np.random.RandomState(0).randn(1 << 18).astype(np.float32)  # 1MB
-        c.init_key(0, 21, np.zeros_like(x), CMD_F32)
-        c.zpush(0, 21, x, CMD_F32)
-        out = np.empty_like(x)
-        c.zpull(0, 21, out, CMD_F32)
-        np.testing.assert_array_equal(out, x)
-        c.close()
-        for t in threads:
-            t.join(timeout=10)
-    finally:
-        os.environ.pop("BYTEPS_IPC_RING_BYTES", None)
+    monkeypatch.setenv("BYTEPS_IPC_RING_BYTES", str(64 << 10))
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    assert c.ipc_conns > 0
+    x = np.random.RandomState(0).randn(1 << 18).astype(np.float32)  # 1MB
+    c.init_key(0, 21, np.zeros_like(x), CMD_F32)
+    c.zpush(0, 21, x, CMD_F32)
+    out = np.empty_like(x)
+    c.zpull(0, 21, out, CMD_F32)
+    np.testing.assert_array_equal(out, x)
+    c.close()
+    for t in threads:
+        t.join(timeout=10)
 
 
 def test_ipc_failure_detection_still_fires():

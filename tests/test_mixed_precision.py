@@ -1,0 +1,120 @@
+"""Mixed-precision utilities (misc/) tests: policy casts, dynamic loss
+scaling (skip/backoff/growth), fp32 master weights, and composition with
+distributed_optimizer on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byteps_tpu.core.state import get_state
+from byteps_tpu.jax import distributed_optimizer
+from byteps_tpu.jax.train import make_train_step
+from byteps_tpu.misc import (
+    MixedPrecisionPolicy, cast_to_compute, cast_to_param,
+    dynamic_loss_scaling, mixed_precision_optimizer,
+)
+from byteps_tpu.misc.mixed_precision import current_loss_scale
+
+
+def test_policy_casts():
+    p = {"w": jnp.ones((4, 4), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+    c = cast_to_compute(p, MixedPrecisionPolicy.bf16())
+    assert c["w"].dtype == jnp.bfloat16
+    assert c["i"].dtype == jnp.int32  # non-float leaves untouched
+    back = cast_to_param(c, MixedPrecisionPolicy.bf16())
+    assert back["w"].dtype == jnp.float32
+
+
+def test_loss_scaling_skips_nonfinite_and_backs_off():
+    tx = dynamic_loss_scaling(optax.sgd(0.1), init_scale=1024.0,
+                              growth_interval=3)
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    state = tx.init(params)
+    s0 = float(current_loss_scale(state))
+    assert s0 == 1024.0
+
+    # finite scaled grads: update = lr * grad / scale
+    g = {"w": jnp.full((3,), 2.0 * s0)}
+    u, state = tx.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(u["w"]), -0.2, rtol=1e-6)
+
+    # non-finite grads: step skipped, scale halves
+    g_bad = {"w": jnp.array([1.0, jnp.inf, 2.0])}
+    u, state = tx.update(g_bad, state, params)
+    np.testing.assert_array_equal(np.asarray(u["w"]), 0.0)
+    assert float(current_loss_scale(state)) == 512.0
+
+
+def test_loss_scaling_grows_after_streak():
+    tx = dynamic_loss_scaling(optax.sgd(0.1), init_scale=8.0,
+                              growth_interval=2)
+    params = {"w": jnp.ones((2,), jnp.float32)}
+    state = tx.init(params)
+    g = {"w": jnp.ones((2,), jnp.float32)}
+    _, state = tx.update(g, state, params)   # good step 1
+    _, state = tx.update(g, state, params)   # good step 2 -> grow
+    assert float(current_loss_scale(state)) == 16.0
+
+
+def test_master_weights_accumulate_small_updates():
+    """Updates too small for bf16 rounding must still accumulate in the
+    fp32 masters — the whole point of the imagenet18 arrangement."""
+    policy = MixedPrecisionPolicy.bf16()
+    tx = mixed_precision_optimizer(optax.sgd(1.0), policy)
+    params = cast_to_compute({"w": jnp.ones((4,), jnp.float32)}, policy)
+    assert params["w"].dtype == jnp.bfloat16
+    state = tx.init(params)
+    # one bf16 ulp at 1.0 is ~0.0078; push 1e-3 steps 8 times: each one
+    # alone would round to nothing in bf16, together they must move w
+    g = {"w": jnp.full((4,), 1e-3, jnp.bfloat16)}
+    for _ in range(8):
+        u, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, u)
+    assert params["w"].dtype == jnp.bfloat16
+    master = state.master["w"]
+    np.testing.assert_allclose(np.asarray(master), 1.0 - 8e-3, rtol=1e-4)
+    # the bf16 param tracks the rounded master
+    np.testing.assert_allclose(np.asarray(params["w"].astype(jnp.float32)),
+                               np.asarray(master.astype(jnp.bfloat16)
+                                          .astype(jnp.float32)))
+
+
+def test_composes_with_distributed_optimizer(bps):
+    """fp16 end-to-end: scaled loss, push_pull-averaged grads, master
+    weights — loss decreases on a tiny regression problem."""
+    mesh = get_state().mesh
+    policy = MixedPrecisionPolicy.fp16()
+    tx = distributed_optimizer(
+        dynamic_loss_scaling(
+            mixed_precision_optimizer(optax.sgd(0.05), policy),
+            init_scale=256.0, growth_interval=50))
+
+    rng = np.random.RandomState(0)
+    Xh = rng.randn(32, 8).astype(np.float32)
+    yh = (Xh @ rng.randn(8, 1)).astype(np.float32)
+
+    params = cast_to_compute(
+        {"w": jnp.zeros((8, 1), jnp.float32)}, policy)
+
+    def loss_fn(p, batch):
+        # per-example scale column: batch entries shard over dp, scalars
+        # can't — mean() recovers the scalar scale after sharding
+        scale = jnp.mean(batch["scale"])
+        x = batch["x"].astype(policy.compute_dtype)
+        pred = x @ p["w"]
+        loss = jnp.mean((pred.astype(jnp.float32)
+                         - batch["y"]) ** 2)
+        return loss * scale  # caller-side scaling
+
+    step = make_train_step(loss_fn, tx, mesh)
+    opt_state = tx.init(params)
+    losses = []
+    for _ in range(20):
+        scale = float(current_loss_scale(opt_state))
+        params, opt_state, loss = step(
+            params, opt_state,
+            {"x": Xh, "y": yh,
+             "scale": np.full((32,), scale, np.float32)})
+        losses.append(float(loss) / scale)
+    assert losses[-1] < losses[0] * 0.5, losses
