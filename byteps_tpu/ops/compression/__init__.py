@@ -46,6 +46,14 @@ __all__ = [
 _REGISTRY = {}
 
 
+def parse_bool_kwarg(kwargs: Dict[str, str], name: str,
+                     default: str = "false") -> bool:
+    """Shared string-truthiness rule for codec kwargs — one definition so
+    the worker registry, the host registry, and the wire stay in
+    lockstep."""
+    return str(kwargs.get(name, default)).lower() in ("1", "true", "yes")
+
+
 def register_codec(name: str):
     def deco(fn):
         _REGISTRY[name] = fn
@@ -55,14 +63,15 @@ def register_codec(name: str):
 
 @register_codec("onebit")
 def _make_onebit(kwargs: Dict[str, str], size: int) -> Codec:
-    scaled = str(kwargs.get("scaling", "true")).lower() in ("1", "true", "yes")
-    return OnebitCodec(size=size, scaled=scaled)
+    return OnebitCodec(size=size,
+                       scaled=parse_bool_kwarg(kwargs, "scaling", "true"))
 
 
 @register_codec("topk")
 def _make_topk(kwargs: Dict[str, str], size: int) -> Codec:
     k = resolve_k(float(kwargs.get("k", 0.01)), size)
-    return TopkCodec(size=size, k=k)
+    return TopkCodec(size=size, k=k,
+                     approx=parse_bool_kwarg(kwargs, "approx"))
 
 
 @register_codec("randomk")

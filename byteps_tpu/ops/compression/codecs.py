@@ -112,12 +112,26 @@ def resolve_k(k_param: float, size: int) -> int:
 class TopkCodec(Codec):
     """Top-k |x| selection into (indices, values) (topk.cc:24-43); the
     reference's heap loop becomes lax.top_k, which XLA maps to the TPU
-    sort unit."""
+    sort unit. A hand-written Pallas selection cannot beat that dedicated
+    unit, so — unlike onebit/randomk/dithering — topk intentionally has no
+    Pallas kernel (SURVEY §7 "hard parts" #3 budgets for exactly this).
+
+    ``approx=True`` instead lowers to the TPU's ApproxTopK hardware op
+    (lax.approx_max_k, ~95% recall by default): it returns *approximately*
+    the largest-|x| set, which is sound under error feedback (missed
+    coordinates stay in the EF residual and ship next round) and is
+    substantially faster at large n. Documented divergence: indices may
+    differ from exact top-k; the wire format is unchanged (the server
+    mirror consumes (indices, values) pairs either way)."""
 
     k: int = 1
+    approx: bool = False
 
     def compress(self, x: jnp.ndarray, step: int = 0) -> Dict[str, Any]:
-        _, idx = jax.lax.top_k(jnp.abs(x), self.k)
+        if self.approx:
+            _, idx = jax.lax.approx_max_k(jnp.abs(x), self.k)
+        else:
+            _, idx = jax.lax.top_k(jnp.abs(x), self.k)
         return {"indices": idx.astype(jnp.int32), "values": x[idx]}
 
     def decompress(self, payload: Dict[str, Any]) -> jnp.ndarray:

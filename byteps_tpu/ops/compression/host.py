@@ -34,6 +34,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ...utils.logging import log
 from .codecs import resolve_k
 from .rng import np_uniform_parallel
 
@@ -311,12 +312,20 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
     """Registry: kwargs dict -> (momentum ->) (EF ->) codec stack, same
     lookup order as the reference (compressor_registry.cc:39-56) and same
     parameter names as ops.compression.make_compressor."""
+    from . import parse_bool_kwarg
+
     name = kwargs.get("compressor")
     if name == "onebit":
-        scaled = str(kwargs.get("scaling", "true")).lower() in (
-            "1", "true", "yes")
-        codec: HostCodec = HostOnebit(n=n, scaled=scaled)
+        codec: HostCodec = HostOnebit(
+            n=n, scaled=parse_bool_kwarg(kwargs, "scaling", "true"))
     elif name == "topk":
+        if parse_bool_kwarg(kwargs, "approx"):
+            # ApproxTopK is a TPU hardware op; the host (numpy) tier runs
+            # the exact selection. Warn instead of silently dropping the
+            # kwarg so a user following the docs knows which tier the
+            # knob applies to.
+            log.warning("topk approx=1 applies to the in-jit TPU tier "
+                        "only; the host/PS codec uses exact selection")
         codec = HostTopk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n))
     elif name == "randomk":
         codec = HostRandomk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n),

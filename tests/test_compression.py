@@ -83,6 +83,31 @@ def test_topk_matches_golden(k):
         float(np.abs(golden).sum()), rel=1e-6)
 
 
+def test_topk_approx_mode():
+    """approx=True (TPU ApproxTopK hardware path) keeps the wire contract:
+    k (index, value) pairs, values faithful to x at those indices, and on
+    this well-separated input it recovers the exact top-k set."""
+    rng = np.random.RandomState(0)
+    x = (rng.randn(512) * np.logspace(0, 3, 512)).astype(np.float32)
+    codec = TopkCodec(size=512, k=16, approx=True)
+    payload = jax.jit(codec.compress)(x)
+    idx = np.asarray(payload["indices"])
+    vals = np.asarray(payload["values"])
+    assert idx.shape == (16,) and vals.shape == (16,)
+    np.testing.assert_allclose(vals, x[idx], rtol=1e-6)
+    # recall: with magnitudes spread over 3 decades the approximate set
+    # must equal the exact top-16 (guards against a regression returning
+    # k valid-looking but low-magnitude coordinates)
+    assert set(idx.tolist()) == set(np.argsort(-np.abs(x))[:16].tolist())
+    out = np.asarray(jax.jit(codec.decompress)(payload))
+    assert int((out != 0).sum()) <= 16
+    # registry plumbs the kwarg through
+    from byteps_tpu.ops.compression import make_compressor
+    st = make_compressor({"compressor": "topk", "k": "16", "approx": "1"},
+                         512)
+    assert st.codec.approx is True
+
+
 def test_randomk_matches_golden():
     n, k, seed, step = 256, 16, 3, 4
     rng = np.random.RandomState(0)
