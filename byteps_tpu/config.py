@@ -85,6 +85,10 @@ class Config:
     trace_start_step: int = 10            # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 20              # BYTEPS_TRACE_END_STEP
     trace_dir: str = "./traces"           # BYTEPS_TRACE_DIR
+    # non-empty -> jax.profiler.start_trace(dir) at init, stop at
+    # shutdown: device (XLA) trace for TensorBoard/Perfetto, with the
+    # host comm spans mirrored in as TraceAnnotations (SURVEY §5.1 note)
+    jax_profiler_dir: str = ""            # BYTEPS_JAX_PROFILER_DIR
     telemetry_on: bool = True             # BYTEPS_TELEMETRY_ON
     debug_sample_tensor: str = ""         # BYTEPS_DEBUG_SAMPLE_TENSOR
 
@@ -127,6 +131,7 @@ class Config:
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+            jax_profiler_dir=_env_str("BYTEPS_JAX_PROFILER_DIR", ""),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             num_processes=_env_int("BYTEPS_NUM_PROCESS", 1),

@@ -70,6 +70,7 @@ class GlobalState:
         self.suspended = False
         self.telemetry = _Telemetry()
         self.tracer = None           # set lazily by utils.tracing
+        self._jax_profiling = False  # jax.profiler trace active
         self.ps_client = None        # set by server.client when PS configured
         self.scheduler = None        # PipelineScheduler over ps_client
         self.handles = None          # HandleManager for the async API
@@ -135,6 +136,15 @@ class GlobalState:
             if self.config.trace_on and self.tracer is None:
                 from ..utils.tracing import Tracer
                 self.tracer = Tracer(self.config)
+            if self.config.jax_profiler_dir and not self._jax_profiling:
+                # device (XLA) trace for TensorBoard/Perfetto alongside
+                # the Chrome comm timeline (SURVEY §5.1 TPU note); host
+                # comm spans appear inside it as TraceAnnotations
+                try:
+                    jax.profiler.start_trace(self.config.jax_profiler_dir)
+                    self._jax_profiling = True
+                except Exception as e:  # noqa: BLE001 - profiling is aux
+                    log.warning("jax.profiler.start_trace failed: %s", e)
             if (self.config.num_servers > 0
                     and self.config.role == "worker"
                     and jax.process_count() > 1):
@@ -181,6 +191,12 @@ class GlobalState:
                 self.ps_client = None
             if self.tracer is not None:
                 self.tracer.flush()
+            if self._jax_profiling:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    log.warning("jax.profiler.stop_trace failed: %s", e)
+                self._jax_profiling = False
             self.initialized = False
             self.suspended = False
 

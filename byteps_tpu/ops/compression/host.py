@@ -308,6 +308,9 @@ class HostNesterovMomentum:
         return self.inner.kwargs_wire()
 
 
+_warned_approx: list = []  # once-per-process dedup for the approx warning
+
+
 def make_host_codec(kwargs: Dict[str, str], n: int):
     """Registry: kwargs dict -> (momentum ->) (EF ->) codec stack, same
     lookup order as the reference (compressor_registry.cc:39-56) and same
@@ -319,11 +322,12 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
         codec: HostCodec = HostOnebit(
             n=n, scaled=parse_bool_kwarg(kwargs, "scaling", "true"))
     elif name == "topk":
-        if parse_bool_kwarg(kwargs, "approx"):
+        if parse_bool_kwarg(kwargs, "approx") and not _warned_approx:
             # ApproxTopK is a TPU hardware op; the host (numpy) tier runs
-            # the exact selection. Warn instead of silently dropping the
-            # kwarg so a user following the docs knows which tier the
-            # knob applies to.
+            # the exact selection. Warn (once — this runs per partition)
+            # instead of silently dropping the kwarg so a user following
+            # the docs knows which tier the knob applies to.
+            _warned_approx.append(True)
             log.warning("topk approx=1 applies to the in-jit TPU tier "
                         "only; the host/PS codec uses exact selection")
         codec = HostTopk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n))

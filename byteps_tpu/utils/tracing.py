@@ -45,20 +45,42 @@ class Tracer:
 
     def begin(self, name: str, stage: str) -> None:
         """Mark the start of a (tensor, stage) span
-        (reference: scheduled_queue.cc:105-123)."""
+        (reference: scheduled_queue.cc:105-123). begin/end pair on ONE
+        thread (the stage's pool thread), which lets the span mirror into
+        a jax.profiler.TraceAnnotation — visible in Perfetto/TensorBoard
+        when a jax profiler trace is running (BYTEPS_JAX_PROFILER_DIR)."""
         if not self._active():
             return
+        ann = None
+        if self._config.jax_profiler_dir:  # mirroring costs nothing else
+            try:
+                import jax
+                ann = jax.profiler.TraceAnnotation(f"bps:{stage}:{name}")
+                ann.__enter__()
+            except Exception:  # noqa: BLE001 - profiler mirroring is aux
+                ann = None
         with self._lock:
-            self._open_spans[(name, stage)] = self._us()
+            self._open_spans[(name, stage)] = (self._us(), ann)
 
     def end(self, name: str, stage: str) -> None:
-        """Record span duration (reference: core_loops.cc:69-91)."""
+        """Record span duration (reference: core_loops.cc:69-91). The
+        annotation exit is NOT gated on the trace window: a span that
+        straddles trace_end_step must still close its TraceAnnotation on
+        this (long-lived pool) thread or every later annotation nests
+        inside the orphan forever."""
+        with self._lock:
+            entry = self._open_spans.pop((name, stage), None)
+        if entry is None:
+            return
+        start, ann = entry
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
         if not self._active():
             return
         with self._lock:
-            start = self._open_spans.pop((name, stage), None)
-            if start is None:
-                return
             self._events.append({
                 "name": stage, "cat": "comm", "ph": "X",
                 "ts": start, "dur": self._us() - start,

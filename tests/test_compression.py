@@ -85,20 +85,23 @@ def test_topk_matches_golden(k):
 
 def test_topk_approx_mode():
     """approx=True (TPU ApproxTopK hardware path) keeps the wire contract:
-    k (index, value) pairs, values faithful to x at those indices, and on
-    this well-separated input it recovers the exact top-k set."""
+    k (index, value) pairs, values faithful to x at those indices, and
+    high recall of the true top-k on well-separated magnitudes."""
     rng = np.random.RandomState(0)
-    x = (rng.randn(512) * np.logspace(0, 3, 512)).astype(np.float32)
+    signs = np.where(rng.rand(512) < 0.5, -1.0, 1.0)
+    # exactly log-spaced |x| (no randn factor that could collapse the
+    # separation): recall must be near-perfect, but NOT exact-set — the
+    # hardware op guarantees ~95% recall, not 100% (bucketed reduction)
+    x = (signs * np.logspace(-3, 3, 512)).astype(np.float32)
     codec = TopkCodec(size=512, k=16, approx=True)
     payload = jax.jit(codec.compress)(x)
     idx = np.asarray(payload["indices"])
     vals = np.asarray(payload["values"])
     assert idx.shape == (16,) and vals.shape == (16,)
     np.testing.assert_allclose(vals, x[idx], rtol=1e-6)
-    # recall: with magnitudes spread over 3 decades the approximate set
-    # must equal the exact top-16 (guards against a regression returning
-    # k valid-looking but low-magnitude coordinates)
-    assert set(idx.tolist()) == set(np.argsort(-np.abs(x))[:16].tolist())
+    true_top = set(np.argsort(-np.abs(x))[:16].tolist())
+    recall = len(true_top & set(idx.tolist())) / 16
+    assert recall >= 0.8, (recall, sorted(idx.tolist()))
     out = np.asarray(jax.jit(codec.decompress)(payload))
     assert int((out != 0).sum()) <= 16
     # registry plumbs the kwarg through
