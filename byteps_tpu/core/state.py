@@ -133,7 +133,11 @@ class GlobalState:
                 devices = jax.local_devices() if local_only else None
                 self.mesh = mesh_lib.make_mesh(
                     self.config.parsed_mesh() or None, devices)
-            if self.config.trace_on and self.tracer is None:
+            if ((self.config.trace_on or self.config.jax_profiler_dir)
+                    and self.tracer is None):
+                # profiler-only mode still needs the Tracer: it carries
+                # the comm spans into the device trace as annotations
+                # (Chrome-trace events stay gated on trace_on's window)
                 from ..utils.tracing import Tracer
                 self.tracer = Tracer(self.config)
             if self.config.jax_profiler_dir and not self._jax_profiling:

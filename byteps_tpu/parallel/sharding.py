@@ -108,6 +108,46 @@ def moe_param_specs() -> Any:
     }
 
 
+def fsdp_param_specs(params: Any, axis: str = DP_AXIS, *, axis_size: int,
+                     base_specs: Any = None,
+                     min_elements: int = 1 << 14) -> Any:
+    """ZeRO-3/FSDP layout: shard each large param leaf over the data axis.
+
+    The GSPMD expression of fully-sharded data parallelism: params (and,
+    via mirror_opt_specs, optimizer state) live sharded over ``axis``;
+    XLA inserts the per-layer all-gathers in forward/backward and
+    reduce-scatters the gradients — the compiler-native generalization of
+    the reference's hierarchical owns-1/N scheme (core_loops.cc:216-268),
+    extended from optimizer state (ZeRO-1, make_zero_train_step) to the
+    parameters themselves.
+
+    Per leaf: the first dimension divisible by ``axis_size`` that
+    ``base_specs`` (e.g. Megatron TP rules, for dp x tp 2D sharding)
+    leaves unsharded gets the axis; leaves smaller than ``min_elements``
+    or with no divisible free dim stay on their base spec (replicated
+    over ``axis``) — sharding tiny tensors costs more in collective
+    latency than it saves in HBM.
+    """
+    import numpy as _np
+
+    def leaf_spec(leaf, base):
+        shape = tuple(getattr(leaf, "shape", ()))
+        entries = list(base) if base is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        if int(_np.prod(shape or (0,))) < min_elements:
+            return P(*entries)
+        for i, d in enumerate(shape):
+            if entries[i] is None and d % axis_size == 0:
+                entries[i] = axis
+                return P(*entries)
+        return P(*entries)
+
+    if base_specs is None:
+        return jax.tree.map(lambda leaf: leaf_spec(leaf, None), params)
+    return jax.tree.map(leaf_spec, params, base_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def _keystr(k) -> str:
     return str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
 

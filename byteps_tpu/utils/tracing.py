@@ -25,7 +25,8 @@ class Tracer:
         self._events: List[dict] = []
         self._step = 0
         self._t0 = time.monotonic()
-        self._open_spans: Dict[tuple, float] = {}
+        # (tensor, stage) -> (start_us, entered TraceAnnotation or None)
+        self._open_spans: Dict[tuple, tuple] = {}
 
     def _us(self) -> float:
         return (time.monotonic() - self._t0) * 1e6
@@ -49,10 +50,14 @@ class Tracer:
         thread (the stage's pool thread), which lets the span mirror into
         a jax.profiler.TraceAnnotation — visible in Perfetto/TensorBoard
         when a jax profiler trace is running (BYTEPS_JAX_PROFILER_DIR)."""
-        if not self._active():
+        # annotations mirror whenever a profiler dir is configured —
+        # independent of the Chrome-trace window, which only gates the
+        # comm.json events (a profiler session spans init()->shutdown())
+        mirror = bool(self._config.jax_profiler_dir)
+        if not (mirror or self._active()):
             return
         ann = None
-        if self._config.jax_profiler_dir:  # mirroring costs nothing else
+        if mirror:
             try:
                 import jax
                 ann = jax.profiler.TraceAnnotation(f"bps:{stage}:{name}")

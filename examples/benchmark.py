@@ -2,7 +2,7 @@
 
 Mirrors example/pytorch/benchmark_byteps.py:110-140: repeated timed batches,
 per-iter throughput lines, mean +- 1.96 sigma summary, scaled totals.
-Models: mlp | resnet50 | bert | llama | moe (byteps_tpu.models zoo).
+Models: mlp | resnet50 | vgg16 | bert | llama | moe (byteps_tpu.models zoo).
 
 The timed step exercises the REAL communication path, exactly like the
 reference (benchmark_byteps.py push_pulls every gradient via
@@ -29,8 +29,16 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import os
+import sys
+
+# runnable as `python examples/<name>.py` from anywhere (same idiom as
+# benchmark_scaling.py)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
 import byteps_tpu as bps
-from byteps_tpu.models import bert, llama, mlp, moe, resnet
+from byteps_tpu.models import bert, llama, mlp, moe, resnet, vgg
 
 
 def build(model: str, batch_size: int):
@@ -58,6 +66,16 @@ def build(model: str, batch_size: int):
             return l
 
         return params, batch, loss
+    if model == "vgg16":
+        # the reference's bandwidth-stress vehicle (138M params dominated
+        # by fc layers; its largest reported wins, docs/performance.md:9)
+        cfg = vgg.VGGConfig.vgg16()
+        params = vgg.init_params(key, cfg)
+        batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
+                                  jnp.float32),
+                 "y": jnp.asarray(rng.randint(0, 1000, batch_size),
+                                  jnp.int32)}
+        return params, batch, lambda p, b: vgg.loss_fn(p, b, cfg)
     if model == "bert":
         cfg = bert.BertConfig.bert_large()
         params = bert.init_params(key, cfg)
@@ -86,7 +104,7 @@ def build(model: str, batch_size: int):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama",
-                    choices=["mlp", "resnet50", "bert", "llama", "moe"])
+                    choices=["mlp", "resnet50", "vgg16", "bert", "llama", "moe"])
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--num-warmup-batches", type=int, default=3)
     ap.add_argument("--num-batches-per-iter", type=int, default=5)

@@ -18,6 +18,14 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+import os
+import sys
+
+# runnable as `python examples/<name>.py` from anywhere (same idiom as
+# benchmark_scaling.py)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
 import byteps_tpu as bps
 from byteps_tpu.models import mlp
 from byteps_tpu.parallel.mesh import DP_AXIS

@@ -1,0 +1,108 @@
+"""FSDP / ZeRO-3 parameter sharding (GSPMD tier).
+
+Params + optimizer state live sharded over dp (fsdp_param_specs); XLA
+inserts the layer all-gathers and gradient reduce-scatters. Checks:
+the layout actually shards (per-device bytes shrink), training matches
+the replicated baseline bitwise-ish, and the 2D dp x tp composition
+trains with both axes used.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byteps_tpu.models import llama
+from byteps_tpu.parallel import sharding as sh
+from byteps_tpu.parallel.mesh import DP_AXIS, TP_AXIS, make_mesh
+
+
+def _train_step(tx, cfg):
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, {"tokens": tokens}, cfg))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+    return step
+
+
+def _run(mesh, cfg, param_specs, steps=3):
+    tx = optax.adam(1e-2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = tx.init(params)
+    p_sh = sh.to_shardings(mesh, param_specs)
+    o_sh = sh.to_shardings(mesh, sh.mirror_opt_specs(tx, params,
+                                                     param_specs))
+    b_sh = NamedSharding(mesh, P(DP_AXIS))
+    step = jax.jit(_train_step(tx, cfg),
+                   in_shardings=(p_sh, o_sh, b_sh),
+                   out_shardings=(p_sh, o_sh, NamedSharding(mesh, P())))
+    params = jax.tree.map(jax.device_put, params, p_sh)
+    opt_state = jax.tree.map(jax.device_put, opt_state, o_sh)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (8, 33)), jnp.int32)
+    tokens = jax.device_put(tokens, b_sh)
+    losses = []
+    with mesh:
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+    return params, losses
+
+
+def test_fsdp_specs_shard_large_leaves():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128, seq=32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    specs = sh.fsdp_param_specs(params, axis_size=8, min_elements=128)
+    flat = {jax.tree_util.keystr(k): (v.shape, s) for (k, v), (_, s) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0])}
+    # embed [128, d]: 128 % 8 == 0 -> first dim sharded over dp
+    shape, spec = flat["['embed']"]
+    assert spec[0] == DP_AXIS, (shape, spec)
+    # norms are tiny -> replicated
+    shape, spec = flat["['final_norm']"]
+    assert all(e is None for e in spec), (shape, spec)
+
+
+def test_fsdp_matches_replicated_training():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128, seq=32)
+    mesh = make_mesh({DP_AXIS: 8})
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    fsdp = sh.fsdp_param_specs(params, axis_size=8, min_elements=128)
+    repl = jax.tree.map(lambda _: P(), params)
+
+    with jax.default_matmul_precision("float32"):
+        p_fsdp, l_fsdp = _run(mesh, cfg, fsdp)
+        p_repl, l_repl = _run(mesh, cfg, repl)
+    np.testing.assert_allclose(l_fsdp, l_repl, rtol=2e-4)
+    # param trees agree after training
+    a = np.asarray(jax.tree.leaves(p_fsdp)[0])
+    b = np.asarray(jax.tree.leaves(p_repl)[0])
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
+    # and the fsdp layout genuinely shards: addressable shard of embed is
+    # 1/8 of the full leaf
+    embed = p_fsdp["embed"]
+    assert embed.addressable_shards[0].data.shape[0] == embed.shape[0] // 8
+
+
+def test_fsdp_composes_with_tp():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128, seq=32)
+    mesh = make_mesh({DP_AXIS: 4, TP_AXIS: 2})
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tp = sh.llama_param_specs(None)
+    specs = sh.fsdp_param_specs(params, axis_size=4, base_specs=tp,
+                                min_elements=128)
+    # lm_head [d, V]: tp on dim 1 (vocab-parallel) stays; dp lands on a
+    # free divisible dim
+    lm = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    by_path = {jax.tree_util.keystr(k): s for k, s in lm}
+    assert TP_AXIS in tuple(by_path["['lm_head']"])
+    assert DP_AXIS in tuple(by_path["['lm_head']"])
+    _, losses = _run(mesh, cfg, specs)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
