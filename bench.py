@@ -187,8 +187,24 @@ def measure_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
 
 
 def main() -> None:
+    # Watchdog: a dead device tunnel (axon backend unreachable) hangs
+    # inside the first device call with no Python-level timeout. Turn
+    # that into a diagnosable failure instead of an opaque driver
+    # timeout. 520s still fits ~3 fresh XLA compiles.
+    def _watchdog():
+        import faulthandler
+        import sys
+        sys.stderr.write("[bench] watchdog: no result after 520s — device "
+                         "backend likely unresponsive; dumping stacks\n")
+        faulthandler.dump_traceback(file=sys.stderr)
+        os._exit(3)
+
+    wd = threading.Timer(520.0, _watchdog)
+    wd.daemon = True
+    wd.start()
     tps, mfu = measure()
     dense_gbps, onebit_gbps = measure_pushpull()
+    wd.cancel()
     print(json.dumps({
         "metric": "llama125m_train_tokens_per_sec",
         "value": round(tps, 1),

@@ -11,13 +11,15 @@ reference's ``load_model`` + ``broadcast_parameters`` flow.
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["save", "restore", "latest_step", "Checkpointer"]
+__all__ = ["save", "save_async", "restore", "latest_step", "Checkpointer"]
 
 
 def _checkpointer():
@@ -49,6 +51,43 @@ def save(path: str, state: Dict[str, Any], step: int,
             import shutil
             shutil.rmtree(_step_dir(path, s), ignore_errors=True)
     return target
+
+
+_async_writer: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_async_writer_mu = threading.Lock()
+
+
+def _writer() -> concurrent.futures.ThreadPoolExecutor:
+    global _async_writer
+    with _async_writer_mu:
+        if _async_writer is None:
+            # one thread: checkpoint writes are ordered, and overlapping
+            # two multi-GB writes would thrash the disk anyway
+            _async_writer = concurrent.futures.ThreadPoolExecutor(
+                1, thread_name_prefix="bps-ckpt")
+        return _async_writer
+
+
+def save_async(path: str, state: Dict[str, Any], step: int,
+               keep: Optional[int] = None) -> concurrent.futures.Future:
+    """Like save(), but returns immediately: the state is snapshotted to
+    host arrays NOW (the only device sync) and written by a background
+    thread, so the train loop overlaps the disk write — the async-save
+    pattern orbax's AsyncCheckpointer implements, kept dependency-light.
+    The returned future resolves to the checkpoint dir; .result() (or
+    Checkpointer.wait()) surfaces write errors. Non-root workers get an
+    already-resolved future (save() is rank-0-only)."""
+    import byteps_tpu as bps
+
+    fut: concurrent.futures.Future = concurrent.futures.Future()
+    if bps.rank() != 0:
+        fut.set_result(_step_dir(path, step))
+        return fut
+    # np.array(..., copy=True): np.asarray would alias host-resident
+    # ndarrays, racing the background write against in-place mutation by
+    # the train loop (device arrays transfer, but numpy state would tear)
+    snapshot = jax.tree.map(lambda x: np.array(x, copy=True), state)
+    return _writer().submit(save, path, snapshot, step, keep)
 
 
 def all_steps(path: str) -> list:
@@ -145,18 +184,38 @@ class Checkpointer:
     """
 
     def __init__(self, path: str, every_steps: int = 1000,
-                 keep: Optional[int] = 3):
+                 keep: Optional[int] = 3, async_save: bool = False):
         self.path = path
         self.every_steps = every_steps
         self.keep = keep
+        self.async_save = async_save
+        self._pending: Optional[concurrent.futures.Future] = None
 
     def maybe_save(self, step: int, state: Dict[str, Any]) -> Optional[str]:
         if step % self.every_steps:
             return None
+        if self.async_save:
+            # at most one write in flight: wait for (and error-check) the
+            # previous one before snapshotting the next
+            self.wait()
+            self._pending = save_async(self.path, state, step,
+                                       keep=self.keep)
+            return _step_dir(self.path, step)
         return save(self.path, state, step, keep=self.keep)
+
+    def wait(self) -> None:
+        """Block until the outstanding async save (if any) has landed;
+        re-raises its error (once — a failed save does not poison later
+        ones). Call before exit."""
+        if self._pending is not None:
+            try:
+                self._pending.result()
+            finally:
+                self._pending = None
 
     def restore_latest(self, example: Optional[Dict[str, Any]] = None,
                        broadcast: bool = True) -> Dict[str, Any]:
+        self.wait()  # never restore a checkpoint that is mid-write
         return restore(self.path, example=example, broadcast=broadcast)
 
     def latest_step(self) -> Optional[int]:

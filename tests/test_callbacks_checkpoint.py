@@ -123,3 +123,25 @@ def test_checkpointer_periodic_and_keep(bps, tmp_path):
     assert ckpt.all_steps(path) == [15, 20]
     out = c.restore_latest(example=state)
     np.testing.assert_array_equal(out["w"], state["w"])
+
+
+def test_checkpointer_async_save(bps, tmp_path):
+    """async_save overlaps the disk write with the train loop: states are
+    snapshotted at call time (later mutation must not leak into the
+    file), ordered, pruned, and wait() surfaces completion."""
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "run_async")
+    c = ckpt.Checkpointer(path, every_steps=2, keep=2, async_save=True)
+    # mutate IN PLACE: the save must snapshot-copy at call time, not
+    # alias the live buffer the loop keeps writing into
+    state = {"w": np.zeros(8, np.float32)}
+    for step in range(1, 9):
+        state["w"] += 1.0
+        c.maybe_save(step, state)
+    c.wait()
+    assert ckpt.all_steps(path) == [6, 8]
+    out = ckpt.restore(path, step=8, broadcast=False)
+    np.testing.assert_array_equal(out["w"], np.full(8, 8.0, np.float32))
+    out6 = ckpt.restore(path, step=6, broadcast=False)
+    np.testing.assert_array_equal(out6["w"], np.full(8, 6.0, np.float32))

@@ -188,6 +188,10 @@ def test_ps_mode_two_processes():
 
 
 _LAUNCH_TRAIN = (
+    # config.update, not env: the axon plugin otherwise initializes (and,
+    # with a wedged tunnel, hangs) regardless of JAX_PLATFORMS
+    "import jax;"
+    "jax.config.update('jax_platforms', 'cpu');"
     "import numpy as np, byteps_tpu as bps;"
     "bps.init();"
     "x = np.arange(16, dtype=np.float32);"
