@@ -199,10 +199,17 @@ def main() -> None:
         faulthandler.dump_traceback(file=sys.stderr)
         os._exit(3)
 
+    # one budget per phase, re-armed between them: a loaded host where
+    # compiles + the push_pull rounds legitimately total >520s must not
+    # be hard-killed mid-progress
     wd = threading.Timer(520.0, _watchdog)
     wd.daemon = True
     wd.start()
     tps, mfu = measure()
+    wd.cancel()
+    wd = threading.Timer(520.0, _watchdog)
+    wd.daemon = True
+    wd.start()
     dense_gbps, onebit_gbps = measure_pushpull()
     wd.cancel()
     print(json.dumps({

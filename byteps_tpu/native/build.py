@@ -33,10 +33,26 @@ def _sanitizer_flags() -> list:
     return []
 
 
+def _cpu_tag() -> str:
+    """Identify the build host's CPU so a -march=native binary cached in
+    a package dir that moves hosts (NFS install, baked container image)
+    is rebuilt instead of SIGILL-ing on a smaller ISA."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "Model")):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    import platform
+    return platform.processor() or platform.machine()
+
+
 def lib_path() -> str:
     with open(_SRC, "rb") as f:
         h = hashlib.sha256(f.read())
     h.update(" ".join(_sanitizer_flags()).encode())
+    h.update(_cpu_tag().encode())
     digest = h.hexdigest()[:16]
     return os.path.join(_DIR, f"libbyteps_ps-{digest}.so")
 
@@ -52,10 +68,18 @@ def build(verbose: bool = False) -> str:
         if san:
             # sanitizer flags override -O3 (listed later wins for -O)
             flags += san
-        cmd = ["g++", *flags, _SRC, "-o", out + ".tmp"]
+        # The library is always built on the host it runs on (content-
+        # hashed lazy build), so target its full ISA: AVX2/AVX-512 widens
+        # sum_into and the codec loops well past baseline SSE2 — the
+        # reference gets the same effect from hand-written AVX paths
+        # (cpu_reducer.cc:59-120). Fall back if the toolchain objects.
+        cmd = ["g++", *flags, "-march=native", _SRC, "-o", out + ".tmp"]
         if verbose:
             print("[byteps_tpu] building native PS:", " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            cmd = ["g++", *flags, _SRC, "-o", out + ".tmp"]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"native build failed:\n{proc.stderr[-4000:]}")

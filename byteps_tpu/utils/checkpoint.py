@@ -87,7 +87,20 @@ def save_async(path: str, state: Dict[str, Any], step: int,
     # ndarrays, racing the background write against in-place mutation by
     # the train loop (device arrays transfer, but numpy state would tear)
     snapshot = jax.tree.map(lambda x: np.array(x, copy=True), state)
-    return _writer().submit(save, path, snapshot, step, keep)
+    out = _writer().submit(save, path, snapshot, step, keep)
+
+    def _log_unconsumed(f: concurrent.futures.Future) -> None:
+        # a future nobody .result()s (e.g. the process exits between
+        # intervals without wait()) must not swallow a write failure —
+        # the executor's atexit join would discard it silently
+        e = f.exception()
+        if e is not None:
+            from .logging import log
+            log.error("async checkpoint write (step %d) failed: %s",
+                      step, e)
+
+    out.add_done_callback(_log_unconsumed)
+    return out
 
 
 def all_steps(path: str) -> list:
