@@ -1,0 +1,42 @@
+"""Examples are part of the product surface (the reference ships its
+example/ scripts as the de-facto benchmark + system tests, SURVEY §4):
+smoke them as real subprocesses the way a user runs them, pinned to the
+CPU platform (a child inherits neither conftest's config updates nor a
+usable TPU on CI)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the axon plugin initializes (and can hang) regardless of JAX_PLATFORMS;
+# config.update is the reliable pin, run before the script. The script
+# path + its args arrive as real argv (no string templating).
+_PIN = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "jax.config.update('jax_num_cpu_devices', 8); "
+        "import runpy, sys; sys.argv = sys.argv[1:]; "
+        "runpy.run_path(sys.argv[0], run_name='__main__')")
+
+
+def _run_example(name: str, argv: list, timeout: int = 420):
+    path = os.path.join(REPO, "examples", name)
+    return subprocess.run(
+        [sys.executable, "-c", _PIN, path, *argv], cwd=REPO,
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH":
+             REPO + os.pathsep + os.environ.get("PYTHONPATH", "")})
+
+
+def test_llama_pretrain_tiny_runs():
+    r = _run_example("llama_pretrain.py",
+                     ["--size", "tiny", "--steps", "3", "--batch", "8"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_train_mnist_runs():
+    r = _run_example("train_mnist.py", ["--epochs", "1",
+                                        "--batch-size", "64"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "accuracy" in r.stdout.lower() or "loss" in r.stdout.lower(), \
+        r.stdout[-500:]
