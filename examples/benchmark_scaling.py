@@ -118,7 +118,10 @@ def run_config(n_workers: int, args) -> float:
                 p.kill()
 
 
-def main() -> None:
+def build_args(argv=None, **overrides) -> argparse.Namespace:
+    """One source of truth for the harness knobs: CLI parsing and
+    programmatic use (bench.py measure_scaling) share this parser, so a
+    new knob added here is automatically present in both."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--devices", type=int, default=1,
@@ -127,7 +130,14 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--dim", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+def main() -> None:
+    args = build_args()
 
     print(f"Measuring 1-worker baseline ({args.steps} steps)...", flush=True)
     t1 = run_config(1, args)
