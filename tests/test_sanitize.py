@@ -23,7 +23,7 @@ from byteps_tpu.server import run_server
 from byteps_tpu.server.client import PSClient
 from byteps_tpu.server.compressed import CompressedTensor
 
-PORT = 24917
+PORT = int(os.environ["BPS_STRESS_PORT"])
 cfg = Config(num_workers=2, num_servers=1)
 server = threading.Thread(target=run_server, args=(PORT, cfg), daemon=True)
 server.start()
@@ -63,38 +63,59 @@ print("STRESS_OK")
 """
 
 
+_TIERS = {
+    # mode -> (runtime lib, options env var, options, error marker)
+    "thread": ("libtsan.so", "TSAN_OPTIONS",
+               "halt_on_error=1 exitcode=66",
+               "WARNING: ThreadSanitizer"),
+    # leak detection would see the whole long-lived interpreter (numpy,
+    # CPython arenas) — scope ASAN to memory-safety errors
+    "address": ("libasan.so", "ASAN_OPTIONS",
+                "detect_leaks=0 halt_on_error=1 exitcode=66",
+                "ERROR: AddressSanitizer"),
+}
+
+
 @pytest.mark.slow
-def test_tsan_loopback_stress(tmp_path):
+@pytest.mark.parametrize("mode", sorted(_TIERS))
+def test_sanitized_loopback_stress(tmp_path, mode):
+    """The concurrent loopback stress under TSAN (races) and ASAN (heap
+    overflow / use-after-free) against the server stores, shm ring
+    transport, and codec mirror."""
+    from byteps_tpu.utils.net import free_port
+
+    lib_name, opts_var, opts, marker = _TIERS[mode]
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    libtsan = subprocess.run(
-        ["g++", "-print-file-name=libtsan.so"], capture_output=True,
+    runtime = subprocess.run(
+        ["g++", f"-print-file-name={lib_name}"], capture_output=True,
         text=True).stdout.strip()
-    if not os.path.isabs(libtsan) or not os.path.exists(libtsan):
-        pytest.skip("libtsan not available")
+    if not os.path.isabs(runtime) or not os.path.exists(runtime):
+        pytest.skip(f"{lib_name} not available")
 
     script = tmp_path / "stress.py"
     script.write_text(_STRESS)
     env = {
         **os.environ,
         "BPS_REPO": repo,
-        "BYTEPS_SANITIZE": "thread",
-        "LD_PRELOAD": libtsan,
-        "TSAN_OPTIONS": "halt_on_error=1 exitcode=66",
-        # jax under TSAN is hopeless; the stress uses numpy only
+        "BPS_STRESS_PORT": str(free_port()),
+        "BYTEPS_SANITIZE": mode,
+        "LD_PRELOAD": runtime,
+        opts_var: opts,
+        # jax under sanitizers is hopeless; the stress uses numpy only
         "JAX_PLATFORMS": "cpu",
     }
-    # build the sanitized lib first (outside LD_PRELOAD, g++ subprocesses
-    # under TSAN preload are fine but slower)
+    # build the sanitized lib first (outside LD_PRELOAD; g++ subprocesses
+    # under a preloaded runtime work but are slower)
     subprocess.run(
         [sys.executable, "-c",
          "import sys, os; sys.path.insert(0, os.environ['BPS_REPO']); "
          "from byteps_tpu.native.build import build; build(verbose=True)"],
-        env={**os.environ, "BPS_REPO": repo, "BYTEPS_SANITIZE": "thread"},
+        env={**os.environ, "BPS_REPO": repo, "BYTEPS_SANITIZE": mode},
         check=True, capture_output=True, timeout=300)
 
     proc = subprocess.run([sys.executable, str(script)], env=env,
                           capture_output=True, text=True, timeout=480)
     out = proc.stdout + proc.stderr
-    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert marker not in out, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert "STRESS_OK" in out, out[-4000:]
