@@ -43,6 +43,9 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--fsdp", action="store_true",
+                    help="shard params + optimizer state over dp "
+                         "(ZeRO-3, composes with --tp)")
     ap.add_argument("--ps", action="store_true",
                     help="route gradients through the DCN PS")
     ap.add_argument("--compression", default=None,
@@ -61,7 +64,18 @@ def main() -> None:
     tx = optax.adamw(3e-4, weight_decay=0.01)
     opt = tx.init(params)
 
+    if args.fsdp and args.ps:
+        raise SystemExit(
+            "--fsdp and --ps are mutually exclusive: the PS train step "
+            "works on replicated params (grads leave the device for the "
+            "server), so ZeRO-3 sharding would silently be undone after "
+            "the first step. Use --fsdp on the GSPMD tier, or --ps.")
     pspecs = sh.llama_param_specs(None)
+    if args.fsdp:
+        # ZeRO-3: dp lands on each large leaf's first free divisible dim,
+        # on top of the Megatron TP rules (docs/running.md "FSDP")
+        pspecs = sh.fsdp_param_specs(params, axis_size=dp,
+                                     base_specs=pspecs)
     pshard = sh.to_shardings(mesh, pspecs)
     oshard = sh.to_shardings(mesh, sh.mirror_opt_specs(tx, params, pspecs))
     bshard = NamedSharding(mesh, P(DP_AXIS))

@@ -34,6 +34,18 @@ def test_llama_pretrain_tiny_runs():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
 
 
+def test_llama_pretrain_fsdp_tp():
+    r = _run_example("llama_pretrain.py",
+                     ["--size", "tiny", "--steps", "2", "--batch", "4",
+                      "--fsdp", "--tp", "2"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    # the inert combination must refuse, not silently un-shard
+    r = _run_example("llama_pretrain.py",
+                     ["--steps", "1", "--fsdp", "--ps"])
+    assert r.returncode != 0
+    assert "mutually exclusive" in r.stdout + r.stderr
+
+
 def test_train_mnist_runs():
     r = _run_example("train_mnist.py", ["--epochs", "1",
                                         "--batch-size", "64"])
