@@ -55,6 +55,7 @@ def distributed_optimizer(
     compression: Optional[dict] = None,
     params_example: Optional[Any] = None,
     min_compress_bytes: Optional[int] = None,
+    lr_schedule=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so its gradients are push_pulled across
     ``axis`` before the update — the functional equivalent of the reference's
@@ -81,7 +82,8 @@ def distributed_optimizer(
         from ..ops.compression import compression_transform
         comm = compression_transform(params_example, compression, axis=axis,
                                      average=average,
-                                     min_compress_bytes=min_compress_bytes)
+                                     min_compress_bytes=min_compress_bytes,
+                                     lr_schedule=lr_schedule)
     else:
         comm = _psum_transform(axis, average)
 

@@ -126,13 +126,15 @@ def _is_stack_leaf(x):
 
 
 def compressed_psum_tree(grads: Any, states: Any, stacks: Any,
-                         axis: str, step, average: bool = True):
+                         axis: str, step, average: bool = True, lr=None):
     """Compress each leaf, all_gather payloads over ``axis``, sum the
     decompressed replicas. Returns (reduced_grads, new_states).
 
     ``stacks``: pytree of CompressorStack aligned with grads leaves
     (NO_COMPRESS leaf = plain psum). ``states``: matching pytree of state
-    dicts. Call inside shard_map with ``axis`` bound.
+    dicts. Call inside shard_map with ``axis`` bound. ``lr``: current
+    learning rate, for the EF residual rescale under LR schedules
+    (feedback.CompressorStack.compress).
     """
     n = jax.lax.axis_size(axis)
 
@@ -142,7 +144,7 @@ def compressed_psum_tree(grads: Any, states: Any, stacks: Any,
             return (summed / n if average else summed), st
         shape = g.shape
         flat = g.reshape(-1).astype(jnp.float32)
-        payload, new_st = stack.compress(flat, st, step)
+        payload, new_st = stack.compress(flat, st, step, lr=lr)
         gathered = jax.lax.all_gather(payload, axis_name=axis)  # leading n
         dec = jax.vmap(stack.decompress)(gathered)
         total = jnp.sum(dec, axis=0)
@@ -193,7 +195,8 @@ def default_stacks(params: Any, kwargs: Dict[str, str],
 
 def compression_transform(params_example: Any, kwargs: Dict[str, str],
                           axis: str = "dp", average: bool = True,
-                          min_compress_bytes: Optional[int] = None):
+                          min_compress_bytes: Optional[int] = None,
+                          lr_schedule=None):
     """optax GradientTransformation performing compressed cross-replica
     reduction with EF/momentum state. Compose before the base optimizer:
 
@@ -202,6 +205,11 @@ def compression_transform(params_example: Any, kwargs: Dict[str, str],
     (byteps_tpu.jax.distributed_optimizer does this wiring when given a
     ``compression`` kwargs dict.) Must run inside shard_map with ``axis``
     bound.
+
+    ``lr_schedule``: optional step -> lr callable (typically the same
+    optax schedule the base optimizer uses). When given, the EF residual
+    is rescaled by prev_lr/cur_lr across LR changes
+    (CompressorStack.compress; the reference's lr.s mechanism).
     """
     stacks = default_stacks(params_example, kwargs, min_compress_bytes)
 
@@ -215,9 +223,10 @@ def compression_transform(params_example: Any, kwargs: Dict[str, str],
 
     def update_fn(grads, state, params=None):
         del params
+        lr = lr_schedule(state["step"]) if lr_schedule is not None else None
         reduced, new_states = compressed_psum_tree(
             grads, state["compress"], stacks, axis, state["step"],
-            average=average)
+            average=average, lr=lr)
         return reduced, {"compress": new_states,
                          "step": state["step"] + 1}
 

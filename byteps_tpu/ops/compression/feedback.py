@@ -40,13 +40,31 @@ class CompressorStack:
         st: Dict[str, Any] = {}
         if self.use_ef:
             st["error"] = jnp.zeros((size,), jnp.float32)
+            # 0 = "no LR seen yet" (first rescale is a no-op); a fixed
+            # key keeps the state pytree structure static under jit.
+            # NOTE: added in round 2 — an optimizer-state checkpoint from
+            # before then lacks this leaf; restore such a checkpoint by
+            # adding a zeros(()) leaf to each EF state dict.
+            st["prev_lr"] = jnp.zeros((), jnp.float32)
         if self.momentum_mu is not None:
             st["momentum"] = jnp.zeros((size,), jnp.float32)
         return st
 
     def compress(self, grad: jnp.ndarray, state: Dict[str, Any],
-                 step: int = 0) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-        """(payload, new_state). ``grad`` flat f32."""
+                 step: int = 0, lr=None) -> Tuple[Dict[str, Any],
+                                                  Dict[str, Any]]:
+        """(payload, new_state). ``grad`` flat f32.
+
+        ``lr``: current learning rate. When given (and EF is on), the
+        carried residual is rescaled by prev_lr/cur_lr before reuse — a
+        residual is "gradient still owed", and under a changed LR the
+        owed *parameter delta* is what must be conserved (the reference's
+        VanillaErrorFeedbackCompressor reads pre_lr/cur_lr from the
+        mmap'd lr.s file the trainer writes each step,
+        vanilla_error_feedback.cc:44-67, mxnet/__init__.py:326-331; here
+        the LR flows as an explicit argument instead of a file
+        side-channel). Omit lr for the constant-LR case (scale 1).
+        """
         new_state = dict(state)
         x = grad
         if self.momentum_mu is not None:
@@ -55,7 +73,21 @@ class CompressorStack:
             new_state["momentum"] = m
             x = x + mu * m
         if self.use_ef:
-            x = x + state["error"]
+            error = state["error"]
+            if lr is not None:
+                cur = jnp.asarray(lr, jnp.float32)
+                prev = state["prev_lr"]
+                # skip the rescale entirely at the boundaries: prev==0
+                # means "no LR seen yet"; cur==0 (a schedule touching
+                # zero, e.g. warm restarts) must not destroy the
+                # residual — keep it, and keep prev so the next nonzero
+                # LR rescales from the last real one
+                ok = (prev != 0) & (cur != 0)
+                scale = jnp.where(ok, prev / jnp.where(cur == 0, 1.0, cur),
+                                  1.0)
+                error = error * scale
+                new_state["prev_lr"] = jnp.where(cur == 0, prev, cur)
+            x = x + error
             payload = self.codec.compress(x, step)
             new_state["error"] = x - self.codec.decompress(payload)
         else:

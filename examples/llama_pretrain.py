@@ -52,6 +52,12 @@ def main() -> None:
                     help="codec name for --ps, e.g. onebit")
     ap.add_argument("--ckpt", default=None)
     args = ap.parse_args()
+    if args.fsdp and args.ps:
+        raise SystemExit(
+            "--fsdp and --ps are mutually exclusive: the PS train step "
+            "works on replicated params (grads leave the device for the "
+            "server), so ZeRO-3 sharding would silently be undone after "
+            "the first step. Use --fsdp on the GSPMD tier, or --ps.")
 
     bps.init()
     devices = jax.devices()
@@ -64,12 +70,6 @@ def main() -> None:
     tx = optax.adamw(3e-4, weight_decay=0.01)
     opt = tx.init(params)
 
-    if args.fsdp and args.ps:
-        raise SystemExit(
-            "--fsdp and --ps are mutually exclusive: the PS train step "
-            "works on replicated params (grads leave the device for the "
-            "server), so ZeRO-3 sharding would silently be undone after "
-            "the first step. Use --fsdp on the GSPMD tier, or --ps.")
     pspecs = sh.llama_param_specs(None)
     if args.fsdp:
         # ZeRO-3: dp lands on each large leaf's first free divisible dim,

@@ -266,3 +266,68 @@ def test_uniform_fast_matches_golden():
         np.testing.assert_array_equal(
             _uniform_fast(seed, n, mix).view(np.uint32),
             np_uniform_parallel(seed, n, mix).view(np.uint32))
+
+
+def test_ef_lr_rescale():
+    """EF residual rescaling under an LR change: the residual is 'gradient
+    still owed', so when the LR halves, the carried residual must double
+    in gradient units to conserve the owed parameter delta (reference:
+    VanillaErrorFeedbackCompressor's pre_lr/cur_lr mmap scaling,
+    impl/vanilla_error_feedback.cc:44-67)."""
+    from byteps_tpu.ops.compression import make_compressor
+
+    st_stack = make_compressor({"compressor": "topk", "k": "2",
+                                "ef": "vanilla"}, 8)
+    g = jnp.asarray(np.array([4, 3, 0.5, 0.25, 0.2, 0.1, 0.05, 0.01],
+                             np.float32))
+    state = st_stack.init_state(8)
+
+    # step 0 at lr=0.1: top-2 ships {4,3}; residual carries the rest
+    p0, state = st_stack.compress(g, state, step=0, lr=0.1)
+    resid0 = np.asarray(state["error"])
+    assert float(state["prev_lr"]) == np.float32(0.1)
+
+    # step 1 with the SAME lr: corrected = g + resid0 (scale 1)
+    p1, st_same = st_stack.compress(g, state, step=1, lr=0.1)
+    # step 1 with lr halved: corrected = g + 2*resid0
+    p2, st_halved = st_stack.compress(g, state, step=1, lr=0.05)
+    dec = st_stack.codec.decompress
+    np.testing.assert_allclose(
+        np.asarray(dec(p1)) + np.asarray(st_same["error"]),
+        np.asarray(g) + resid0, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(dec(p2)) + np.asarray(st_halved["error"]),
+        np.asarray(g) + 2 * resid0, rtol=1e-6)
+    assert float(st_halved["prev_lr"]) == np.float32(0.05)
+
+    # no lr passed: structure static, scale 1 (constant-LR contract)
+    p3, st_nolr = st_stack.compress(g, state, step=1)
+    assert set(st_nolr) == set(state)
+
+
+def test_ef_lr_rescale_zero_lr_boundary():
+    """A schedule touching lr=0 (warm restarts) must not destroy the
+    residual: scale stays 1 and prev_lr keeps the last nonzero LR."""
+    from byteps_tpu.ops.compression import make_compressor
+
+    st_stack = make_compressor({"compressor": "topk", "k": "2",
+                                "ef": "vanilla"}, 8)
+    g = jnp.asarray(np.array([4, 3, 0.5, 0.25, 0.2, 0.1, 0.05, 0.01],
+                             np.float32))
+    state = st_stack.init_state(8)
+    _, state = st_stack.compress(g, state, step=0, lr=0.1)
+    resid = np.asarray(state["error"])
+    # lr -> 0: residual reused unscaled, prev_lr retains 0.1
+    p, state = st_stack.compress(g, state, step=1, lr=0.0)
+    np.testing.assert_allclose(
+        np.asarray(st_stack.codec.decompress(p))
+        + np.asarray(state["error"]),
+        np.asarray(g) + resid, rtol=1e-6)
+    assert float(state["prev_lr"]) == np.float32(0.1)
+    # back to a nonzero LR: rescales from the last REAL lr (0.1 -> 0.05)
+    resid1 = np.asarray(state["error"])
+    p, state = st_stack.compress(g, state, step=2, lr=0.05)
+    np.testing.assert_allclose(
+        np.asarray(st_stack.codec.decompress(p))
+        + np.asarray(state["error"]),
+        np.asarray(g) + 2 * resid1, rtol=1e-6)
