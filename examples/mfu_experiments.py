@@ -1,0 +1,105 @@
+"""MFU tuning harness for the llama-125M bench — run on a real TPU.
+
+The bench ceiling analysis (docs/performance.md) attributes the gap from
+MFU 0.34 to the ~0.6-0.75 shape-mix ceiling to attention softmax HBM
+traffic, rmsnorm/rope VPU work, remat recompute and the optimizer pass.
+This harness A/Bs candidate fixes against the current loss_fn baseline:
+
+1. chunked-vocab cross entropy — computes logsumexp/pick per vocab chunk
+   under a nothing-saveable checkpoint policy, so the [B,S,V] logits are
+   never resident at once (trades one extra lm_head matmul in bwd for
+   ~1GB of HBM round-trips at V=32k)
+2. S=2048 at B=8 — same tokens/step, bigger attention tiles
+
+Prints tokens/s per variant; apply winners to bench.py / models/llama.py.
+(Deliberately uses llama internals — this is a tuning tool for this
+repo's model, not a user example.)
+
+    python examples/mfu_experiments.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import time, functools
+import jax, jax.numpy as jnp, numpy as np, optax
+from byteps_tpu.models import llama
+
+cfg = llama.LlamaConfig.small(vocab_size=32000)
+B, S, steps = 16, 1024, 10
+params0 = llama.init_params(jax.random.PRNGKey(0), cfg)
+tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
+tok = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (B, S + 1)), jnp.int32)
+
+
+def bench_loss(loss_fn, label, B=B, S=S, tokens=None):
+    tokens = tok if tokens is None else tokens
+    p = jax.tree.map(jnp.copy, params0)
+    o = tx.init(p)
+
+    def step(p, o, t):
+        loss, g = jax.value_and_grad(lambda q: loss_fn(q, t))(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    stepj = jax.jit(step, donate_argnums=(0, 1))
+    for _ in range(3):
+        p, o, loss = stepj(p, o, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, o, loss = stepj(p, o, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+    print(f"{label}: {B*S*steps/dt:,.0f} tok/s  (loss {float(loss):.3f})", flush=True)
+
+
+# -- 1. chunked-vocab xent ------------------------------------------------ #
+def chunked_xent_loss(q, t, n_chunks=8):
+    """Cross entropy over vocab chunks: never materializes [B,S,V] in one
+    piece; bwd recomputes per chunk via jax.checkpoint on the chunk fn."""
+    inputs, targets = t[:, :-1], t[:, 1:]
+    # trunk identical to llama.forward minus lm_head
+    Bc, Sc = inputs.shape
+    x = q["embed"].astype(cfg.dtype)[inputs]
+    cos, sin = llama.rope_cache(cfg, Sc)
+    blk = lambda h, lp: llama._block(h, lp, cos, sin, cfg, None)
+    if cfg.remat:
+        blk = jax.checkpoint(
+            blk, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    h, _ = jax.lax.scan(lambda h, lp: (blk(h, lp), None), x, q["blocks"])
+    h = llama._rmsnorm(h, q["final_norm"], cfg.norm_eps)
+    W = q["lm_head"]
+    V = W.shape[1]
+    Vc = V // n_chunks
+
+    @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_lse_pick(h, Wc, base):
+        logits = (h @ Wc.astype(h.dtype)).astype(jnp.float32)  # [B,S,Vc]
+        lse_c = jax.scipy.special.logsumexp(logits, -1)
+        inrange = (targets >= base) & (targets < base + Vc)
+        loc = jnp.clip(targets - base, 0, Vc - 1)
+        picked_c = jnp.where(
+            inrange, jnp.take_along_axis(logits, loc[..., None], -1)[..., 0], -jnp.inf)
+        return lse_c, picked_c
+
+    Wr = W.reshape(W.shape[0], n_chunks, Vc)
+    lses, picks = [], []
+    for c in range(n_chunks):
+        lse_c, picked_c = chunk_lse_pick(h, Wr[:, c], c * Vc)
+        lses.append(lse_c)
+        picks.append(picked_c)
+    lse = jax.scipy.special.logsumexp(jnp.stack(lses, 0), 0)
+    picked = jnp.max(jnp.stack(picks, 0), 0)
+    return jnp.mean(lse - picked)
+
+
+bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg), "baseline")
+for nc in (4, 8):
+    bench_loss(functools.partial(chunked_xent_loss, n_chunks=nc),
+               f"chunked xent x{nc}")
+
+# -- 2. S=2048, B=8 ------------------------------------------------------- #
+tok2 = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (8, 2049)), jnp.int32)
+bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
+           "baseline B=8 S=2048", B=8, S=2048, tokens=tok2)
