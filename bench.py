@@ -16,7 +16,8 @@ Extra keys in the same line:
   across real worker OS processes through the loopback PS (the
   reference's headline metric shape, README.md:34-40; under-reported on
   a 1-core host — a regression tracker, not an absolute).
-- ``pushpull_dense_gbps`` / ``pushpull_onebit_gbps`` — the push_pull
+- ``pushpull_dense_gbps`` / ``pushpull_onebit_gbps`` /
+  ``pushpull_randomk_gbps`` — the push_pull
   micro north-star (BASELINE.md "maximize GB/s/chip"): a 256MB gradient
   set through the full pipelined PS path (priority scheduler -> native
   TCP client -> C++ server on loopback), reported as gradient
@@ -179,18 +180,25 @@ def measure_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
         dense_gbps = best_of(round_trip)
 
         state = bps.core.state.get_state()
-        reg = CompressedRegistry(state.ps_client, 1,
-                                 {"compressor": "onebit"})
 
-        def comp_round():
-            hs = [reg.push_pull_async(state, f"bench_c{i}", g,
-                                      average=False)
-                  for i, g in enumerate(grads)]
-            for h in hs:
-                bps.synchronize(h, timeout=300)
+        def comp_fn(kwargs, prefix):
+            reg = CompressedRegistry(state.ps_client, 1, kwargs)
 
-        onebit_gbps = best_of(comp_round)
-        return dense_gbps, onebit_gbps
+            def comp_round():
+                hs = [reg.push_pull_async(state, f"{prefix}{i}", g,
+                                          average=False)
+                      for i, g in enumerate(grads)]
+                for h in hs:
+                    bps.synchronize(h, timeout=300)
+
+            return comp_round
+
+        onebit_gbps = best_of(comp_fn({"compressor": "onebit"}, "bench_c"))
+        # randomk exercises the server's wire-form (homomorphic) fast
+        # path: O(k) summation per push instead of O(n)
+        randomk_gbps = best_of(
+            comp_fn({"compressor": "randomk", "k": "0.01"}, "bench_r"))
+        return dense_gbps, onebit_gbps, randomk_gbps
     finally:
         bps.shutdown()
         server.join(timeout=20)
@@ -250,7 +258,7 @@ def main() -> None:
     with _phase_watchdog("train (device compiles + steps)"):
         tps, mfu = measure()
     with _phase_watchdog("pushpull (loopback PS)"):
-        dense_gbps, onebit_gbps = measure_pushpull()
+        dense_gbps, onebit_gbps, randomk_gbps = measure_pushpull()
     # last and flakiest phase (subprocess fan-out on a shared host): a
     # failure here must not discard the already-measured numbers. The
     # watchdog budget exceeds run_config's own 600s communicate timeout
@@ -272,6 +280,7 @@ def main() -> None:
         "mfu": round(mfu, 4),
         "pushpull_dense_gbps": round(dense_gbps, 3),
         "pushpull_onebit_gbps": round(onebit_gbps, 3),
+        "pushpull_randomk_gbps": round(randomk_gbps, 3),
         "scaling_efficiency_2w": scaling,
     }))
 

@@ -912,6 +912,10 @@ struct KeyStore {
   CompressorCfg comp;
   std::vector<int32_t> round_idx;     // randomk: this round's indices
   std::vector<float> scratch;         // decompress buffer
+  // randomk homomorphic fast path: the round's aggregate in WIRE form
+  // ([k idx][k vals], vals summed in place). Non-empty only while a
+  // fast-path round is in flight.
+  std::vector<uint8_t> wire_accum;
   // Published aggregates (sync mode): swapped atomically under `mu` at
   // ALL_RECV, NEVER mutated afterwards — pulls send straight from the
   // shared buffer with no per-request copy (the reference caches per-key
@@ -1158,6 +1162,7 @@ class Server {
         // when they retry after elastic resume.
         ks.init_count = 0;
         ks.recv_count = 0;
+        ks.wire_accum.clear();  // drop a half-summed randomk wire round
         if (ks.pull_abort.size() != ks.worker_push_count.size())
           ks.pull_abort.assign(ks.worker_push_count.size(), 0);
         for (size_t w = 0; w < ks.worker_push_count.size(); ++w) {
@@ -1339,6 +1344,7 @@ class Server {
         ks.pub_wire.reset();
         ks.round_idx.clear();
         ks.scratch.clear();
+        ks.wire_accum.clear();
       }
       if (ks.init_done) {
         // the cold-start barrier already completed for this store; a
@@ -1393,6 +1399,11 @@ class Server {
           ks.comp = cfg;
           ks.scratch.resize(cfg.n);
           ks.round_idx.clear();
+          // a half-summed randomk wire round under the OLD config must
+          // not be reinterpreted with the new k (out-of-bounds reads and
+          // scatter writes); drop it and restart the round count
+          ks.wire_accum.clear();
+          ks.recv_count = 0;
           // the dense ALL_RECV publishes by MOVING accum out; a key that
           // ran dense rounds before COMP_INIT arrives here with an empty
           // accum, and the compressed first-recv memcpys into it — make
@@ -1411,6 +1422,56 @@ class Server {
     m.conn->send_msg(r, nullptr);
   }
 
+  // [k idx][k vals] wire -> dense f32[n] scatter with duplicate-index
+  // last-wins (numpy parity) — the ONE definition of the wire-to-dense
+  // convention, shared by the fast path's degrade and publish steps
+  // (CompressorCfg::Decompress keeps its own bounds-checked variant for
+  // untrusted input).
+  static void ScatterWire(const uint8_t* wire, uint32_t k, float* dst,
+                          uint32_t n) {
+    const int32_t* idx = (const int32_t*)wire;
+    const float* val = (const float*)(wire + 4 * (size_t)k);
+    std::memset(dst, 0, (size_t)n * sizeof(float));
+    for (uint32_t i = 0; i < k; ++i) dst[idx[i]] = val[i];
+  }
+
+  // randomk homomorphic aggregation: every worker of a round derives the
+  // SAME index vector from (seed, round), so the sum of the decompressed
+  // tensors equals the scatter of the elementwise-summed wire values —
+  // including duplicate-index last-wins semantics, since the duplicate
+  // positions align across workers. Summing k floats per push replaces
+  // the generic path's O(n) scatter+add (the THC observation: linear
+  // codecs aggregate without decompression). Returns false (untouched
+  // state) when the payload's indices don't match the round's — e.g.
+  // worker-side round counters skewed by an elastic resume — after
+  // expanding the wire accumulator into the dense accumulator so the
+  // caller's generic path finishes the round correctly.
+  bool RandomkFastPush(EngineMsg& m, KeyStore& ks) {
+    const uint32_t k = ks.comp.k;
+    const uint8_t* payload = m.payload.data();
+    const int32_t* idx = (const int32_t*)payload;
+    const float* val = (const float*)(payload + 4 * (size_t)k);
+    if (ks.recv_count == 0) {
+      ks.wire_accum.assign(payload, payload + m.payload.size());
+      ks.round_idx.assign(idx, idx + k);
+      return true;
+    }
+    if (!ks.wire_accum.empty() &&
+        std::memcmp(ks.wire_accum.data(), idx, 4 * (size_t)k) == 0) {
+      float* acc = (float*)(ks.wire_accum.data() + 4 * (size_t)k);
+      for (uint32_t i = 0; i < k; ++i) acc[i] += val[i];
+      return true;
+    }
+    if (!ks.wire_accum.empty()) {
+      // degrade mid-round: expand wire form to dense, then generic path
+      if (ks.accum.size() != ks.len) ks.accum.assign(ks.len, 0);
+      ScatterWire(ks.wire_accum.data(), k, (float*)ks.accum.data(),
+                  ks.comp.n);
+      ks.wire_accum.clear();
+    }
+    return false;
+  }
+
   void DoPushCompressed(EngineMsg& m, KeyStore& ks) {
     std::vector<ParkedPull> flush;
     {
@@ -1419,6 +1480,51 @@ class Server {
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
         return;
+      }
+      if (ks.comp.type == CompressorCfg::RANDOMK &&
+          m.payload.size() == ks.comp.WireLen()) {
+        // bounds-check indices, then try the O(k) wire-form aggregation
+        bool valid = true;
+        const int32_t* idx = (const int32_t*)m.payload.data();
+        for (uint32_t i = 0; i < ks.comp.k; ++i)
+          if (idx[i] < 0 || (uint32_t)idx[i] >= ks.comp.n) {
+            valid = false;
+            break;
+          }
+        if (!valid) {
+          std::fprintf(stderr, "[bps-server] compressed push rejected "
+                       "key=%llu (bad indices)\n",
+                       (unsigned long long)m.key);
+          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          m.conn->send_msg(r, nullptr);
+          return;
+        }
+        if (RandomkFastPush(m, ks)) {
+          ks.total_pushes++;
+          if (m.sender < ks.worker_push_count.size())
+            ks.worker_push_count[m.sender]++;
+          if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+          ks.recv_count++;
+          if ((int)ks.recv_count >= num_workers_) {
+            // ALL_RECV: the wire accumulator IS the compressed
+            // aggregate; scatter it once for the dense published view
+            auto w = std::make_shared<std::vector<uint8_t>>(
+                std::move(ks.wire_accum));
+            ks.wire_accum.clear();
+            auto d = std::make_shared<std::vector<uint8_t>>(ks.len, 0);
+            ScatterWire(w->data(), ks.comp.k, (float*)d->data(),
+                        ks.comp.n);
+            DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
+            ks.pub = std::move(d);
+            ks.pub_wire = std::move(w);
+            ks.recv_count = 0;
+            ks.completed_rounds++;
+            flush.swap(ks.parked_pulls);
+          }
+          goto ack;  // shared ACK + parked-pull flush tail
+        }
+        // fell back: wire_accum expanded into dense accum; the generic
+        // path below decompresses THIS payload and adds it
       }
       if (m.payload.size() != ks.comp.WireLen() ||
           !ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
@@ -1480,6 +1586,7 @@ class Server {
         flush.swap(ks.parked_pulls);
       }
     }
+  ack:
     MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
     m.conn->send_msg(r, nullptr);
     for (auto& p : flush) AnswerPull(ks, p);

@@ -446,3 +446,67 @@ def test_dense_rounds_then_compression_same_key():
     np.testing.assert_allclose(out2, want, rtol=1e-6)
     c.close()
     t.join(timeout=10)
+
+
+def test_randomk_skewed_steps_degrades_correctly():
+    """The server's randomk wire-form fast path requires the round's
+    payloads to share indices; workers whose per-tensor round counters
+    are skewed (elastic resume) ship DIFFERENT index vectors, and the
+    server must fall back to dense aggregation — the aggregate is then
+    the sum of each worker's own scatter, exactly like the generic
+    path."""
+    from byteps_tpu.core.types import RequestType, get_command_type
+
+    n, k = 512, 32
+    port, t = _server(2)
+    addr = [f"127.0.0.1:{port}"]
+    c0 = PSClient(addr, worker_id=0)
+    c1 = PSClient(addr, worker_id=1)
+    ctx0 = _ctx("skew", n * 4, 2)
+    ctx1 = _ctx("skew", n * 4, 2)
+    key = ctx0.partitions[0].key
+    codec = host.HostRandomk(n=n, k=k, seed=7)
+    kw = codec.kwargs_wire()
+
+    def init(c, ctx):
+        c.init_tensor(ctx, np.zeros(n, np.float32))
+        c.comp_init(0, key, kw)
+
+    ths = [threading.Thread(target=init, args=p)
+           for p in ((c0, ctx0), (c1, ctx1))]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(60)
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(n).astype(np.float32) for _ in range(2)]
+    steps = [3, 9]  # skewed round counters -> different index vectors
+    wires = [codec.compress(xs[i], step=steps[i]) for i in range(2)]
+    assert not np.array_equal(codec.indices(3), codec.indices(9))
+    cmd = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                           DataType.FLOAT32)
+    outs = [np.empty(n, np.float32) for _ in range(2)]
+
+    def roundtrip(w):
+        buf = np.frombuffer(wires[w], np.uint8)
+        c = (c0, c1)[w]
+        c.zpush(0, key, buf, cmd)
+        # pull the DENSE aggregate (not the recompressed wire): the
+        # degraded round published the sum of both scatters
+        dense_cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                     DataType.FLOAT32)
+        c.zpull(0, key, outs[w], dense_cmd)
+
+    ths = [threading.Thread(target=roundtrip, args=(w,)) for w in range(2)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(60)
+
+    want = codec.decompress(wires[0]) + codec.decompress(wires[1])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-6)
+    np.testing.assert_array_equal(outs[0], outs[1])
+    c0.close()
+    c1.close()
+    t.join(timeout=15)
