@@ -22,8 +22,13 @@ policy machinery for the cases that remain:
 - ``mixed_precision_optimizer``: fp32 master weights living in the
   optimizer state when the model params are half precision.
 
-All pieces compose with ``byteps_tpu.jax.distributed_optimizer`` (chain
-order: loss scaling -> push_pull -> master-weight update).
+All pieces compose with ``byteps_tpu.jax.distributed_optimizer``. Chain
+order: ``loss scaling -> push_pull -> master-weight update`` keeps the
+wire in fp32 (the unscale emits fp32, so nothing underflows); to ship a
+compressed fp16 wire like the reference's imagenet18 recipe, order it
+``push_pull -> loss scaling -> master-weight update`` so the wire
+carries the still-scaled fp16 values and the unscale happens at the
+fp32 update.
 """
 
 from __future__ import annotations
@@ -113,9 +118,16 @@ def dynamic_loss_scaling(
             inner=tx.init(params))
 
     def update(grads, state, params=None):
+        # Unscaled grads stay fp32: casting back to an incoming fp16
+        # dtype would flush small unscaled values to zero — the exact
+        # underflow loss scaling exists to prevent — and anything
+        # downstream (push_pull averaging, master-weight update) is
+        # range-safe in fp32. Callers wanting a compressed fp16 WIRE
+        # should push_pull the still-scaled grads BEFORE this transform
+        # in the chain (the reference communicates scaled fp16 and
+        # unscales at the fp32 update).
         grads = jax.tree.map(
-            lambda g: (g.astype(jnp.float32) / state.scale).astype(g.dtype),
-            grads)
+            lambda g: g.astype(jnp.float32) / state.scale, grads)
         finite = jnp.all(jnp.asarray(
             [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
 

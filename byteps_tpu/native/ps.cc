@@ -52,6 +52,8 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
+#include <cerrno>
 #include <chrono>
 #include <climits>
 #include <cmath>
@@ -88,6 +90,7 @@ enum Op : uint8_t {
   PULL_REPLY = 7,
   COMP_INIT = 8,  // per-key compressor kwargs (operations.cc:396-408)
   IPC_HELLO = 9,  // colocated shm-transport upgrade (BYTEPS_ENABLE_IPC)
+  IPC_CONFIRM = 10,  // client commit of the upgrade (3rd handshake leg)
 };
 
 enum ReqType : uint32_t {
@@ -145,6 +148,35 @@ static bool recv_all(int fd, void* buf, size_t n) {
     n -= (size_t)r;
   }
   return true;
+}
+
+static bool recv_all_deadline(int fd, void* buf, size_t len,
+                              int timeout_ms) {
+  // Bounded, alignment-preserving receive: MSG_PEEK until the FULL
+  // message is buffered, then one consuming read. On expiry NOTHING has
+  // been consumed — even a partially-arrived message stays queued — so
+  // the TCP byte stream remains message-aligned for the caller's
+  // fallback path (a late-completing message is drained whole by the
+  // normal read loop).
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    ssize_t n = ::recv(fd, buf, len, MSG_PEEK | MSG_DONTWAIT);
+    if (n == 0) return false;  // peer closed
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      return false;
+    if (n >= (ssize_t)len) break;
+    auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) return false;
+    int remain = (int)std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - now).count();
+    struct pollfd pfd;
+    pfd.fd = fd;
+    pfd.events = POLLIN;
+    pfd.revents = 0;
+    ::poll(&pfd, 1, remain > 0 ? remain : 1);  // EINTR: loop re-checks
+  }
+  return recv_all(fd, buf, len);
 }
 
 // header+payload in one gathered send; sendmsg (not writev) so
@@ -421,8 +453,17 @@ class IpcChan {
 };
 
 static bool ipc_enabled() {
+  // Default ON — a deliberate divergence from the reference's opt-in
+  // BYTEPS_ENABLE_IPC (documented in docs/env.md): the loopback shm
+  // upgrade is negotiated in-band and strictly faster when colocated.
+  // Explicit disable accepts the same falsy spellings as the Python
+  // side's parse_bool_kwarg plus no/off, case-insensitively.
   const char* e = ::getenv("BYTEPS_ENABLE_IPC");
-  return !(e && (e[0] == '0' || e[0] == 'f' || e[0] == 'F'));
+  if (!e || !*e) return true;
+  std::string v(e);
+  for (char& c : v) c = (char)std::tolower((unsigned char)c);
+  return !(v == "0" || v == "f" || v == "false" || v == "n" || v == "no" ||
+           v == "off");
 }
 
 static size_t ipc_ring_bytes() {
@@ -865,8 +906,12 @@ struct Conn {
     if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
   }
   std::mutex write_mu;
-  // shm transport after an IPC_HELLO upgrade; null = plain TCP
+  // shm transport after a COMMITTED IPC upgrade; null = plain TCP
   std::unique_ptr<IpcChan> ipc;
+  // mapped at IPC_HELLO, promoted to `ipc` only by the client's
+  // IPC_CONFIRM (conn-loop thread only); abandoned — munmapped by the
+  // IpcChan dtor — when any other message arrives first or the conn dies
+  std::unique_ptr<IpcChan> ipc_pending;
   bool send_msg(const MsgHeader& h, const void* payload) {
     std::lock_guard<std::mutex> lk(write_mu);
     if (ipc) return ipc->send_msg(h, payload);
@@ -1100,6 +1145,23 @@ class Server {
         HandleIpcHello(conn, h.rid, m.payload);
         continue;
       }
+      if (h.op == IPC_CONFIRM) {
+        // 3rd handshake leg: only NOW move the conn onto the rings. A
+        // client that timed out waiting for the ACK never sends this,
+        // so a late ACK cannot split the transport (client on TCP,
+        // server on shm). write_mu: engine threads read `ipc` in
+        // send_msg.
+        std::lock_guard<std::mutex> lk(conn->write_mu);
+        if (conn->ipc_pending) conn->ipc = std::move(conn->ipc_pending);
+        continue;
+      }
+      if (conn->ipc_pending) {
+        // any other message while the upgrade is pending means the
+        // client declined (never confirmed) and moved on over TCP
+        conn->ipc_pending.reset();
+        std::fprintf(stderr,
+                     "[bps-server] ipc upgrade abandoned (no confirm)\n");
+      }
       if (h.op == BARRIER) {
         HandleBarrier(std::move(m));
         continue;
@@ -1190,10 +1252,11 @@ class Server {
   void HandleIpcHello(const std::shared_ptr<Conn>& conn, uint32_t rid,
                       const std::vector<uint8_t>& payload) {
     // Client offered a shm segment (its first message on this conn; no
-    // requests are in flight). Map + validate, ACK over TCP, THEN switch
-    // the conn to the rings — the ACK must not ride the ring the client
-    // only trusts after seeing it. Any failure error-ACKs and the conn
-    // simply stays TCP.
+    // requests are in flight). Map + validate, ACK over TCP, then hold
+    // the mapping PENDING until the client's IPC_CONFIRM — the ACK must
+    // not ride the ring the client only trusts after seeing it, and the
+    // conn must not switch before the client has committed. Any failure
+    // error-ACKs and the conn simply stays TCP.
     std::string name(reinterpret_cast<const char*>(payload.data()),
                      payload.size());
     bool ok = false;
@@ -1213,7 +1276,9 @@ class Server {
                 sizeof(IpcShm) + 2 * (size_t)s->ring_size) {
           MsgHeader r{kMagic, ACK, 0, 0, rid, 0, 0, 0};
           conn->send_msg(r, nullptr);  // still TCP: ipc not yet set
-          conn->ipc.reset(
+          // pending until the client's IPC_CONFIRM commits it — the
+          // client may time out on our ACK and stay TCP
+          conn->ipc_pending.reset(
               new IpcChan(base, (size_t)st.st_size, conn->fd, true));
           ok = true;
         } else {
@@ -2055,12 +2120,26 @@ class ServerConn {
     MsgHeader h{kMagic, IPC_HELLO, 0, sender, 0, 0, 0,
                 (uint32_t)std::strlen(name)};
     MsgHeader r{};
-    bool ok = send_msg_iov(fd_, h, name) && recv_all(fd_, &r, sizeof(r)) &&
+    // Bound the handshake: a server that stalls or predates IPC_HELLO
+    // (version skew) must not wedge Connect() forever. The peeking
+    // receive never consumes a partial ACK, so on expiry the byte
+    // stream is intact for plain TCP (a late ACK is drained by
+    // RecvLoop's unknown-rid path). The upgrade commits on BOTH sides
+    // only via the IPC_CONFIRM third leg below — a timed-out client
+    // never sends it, so the server abandons its half instead of
+    // splitting the transport (client on TCP, server on shm).
+    bool ok = send_msg_iov(fd_, h, name) &&
+              recv_all_deadline(fd_, &r, sizeof(r), 10000) &&
               r.op == ACK && (r.flags & 1) == 0;
     ::shm_unlink(name);  // server has it mapped (or declined): name gone
     if (!ok) {
       ::munmap(base, total);
       std::fprintf(stderr, "[bps-client] ipc upgrade declined, using TCP\n");
+      return;
+    }
+    MsgHeader c{kMagic, IPC_CONFIRM, 0, sender, 0, 0, 0, 0};
+    if (!send_msg_iov(fd_, c, nullptr)) {
+      ::munmap(base, total);
       return;
     }
     chan_.reset(new IpcChan(base, total, fd_, false));

@@ -43,8 +43,9 @@ class CompressorStack:
             # 0 = "no LR seen yet" (first rescale is a no-op); a fixed
             # key keeps the state pytree structure static under jit.
             # NOTE: added in round 2 — an optimizer-state checkpoint from
-            # before then lacks this leaf; restore such a checkpoint by
-            # adding a zeros(()) leaf to each EF state dict.
+            # before then lacks this leaf; utils.checkpoint.restore()
+            # migrates such checkpoints automatically (retries against
+            # the legacy structure and reinserts the leaf as zeros).
             st["prev_lr"] = jnp.zeros((), jnp.float32)
         if self.momentum_mu is not None:
             st["momentum"] = jnp.zeros((size,), jnp.float32)

@@ -121,6 +121,58 @@ def latest_step(path: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _strip_ef_prev_lr(tree):
+    """(tree-without-prev_lr, stripped key-paths): drop the
+    error-feedback ``prev_lr`` leaf (added to CompressorStack.init_state
+    in round 2) from every EF state dict — the on-disk structure of
+    checkpoints written before then. Walks dicts/lists/tuples/
+    namedtuples, the containers orbax round-trips. The returned paths
+    let the inverse reinsert ONLY where a leaf was stripped (an
+    unrelated dict that merely contains an ``error`` key must not grow
+    one)."""
+    paths = []
+
+    def walk(t, path):
+        if isinstance(t, dict):
+            out = {}
+            for k, v in t.items():
+                if k == "prev_lr" and "error" in t:
+                    paths.append(path)
+                    continue
+                out[k] = walk(v, path + (k,))
+            return out
+        if isinstance(t, tuple):
+            vals = [walk(v, path + (i,)) for i, v in enumerate(t)]
+            return type(t)(*vals) if hasattr(t, "_fields") else tuple(vals)
+        if isinstance(t, list):
+            return [walk(v, path + (i,)) for i, v in enumerate(t)]
+        return t
+
+    return walk(tree, ()), paths
+
+
+def _insert_ef_prev_lr(tree, paths):
+    """Inverse of _strip_ef_prev_lr: add a zeros(()) ``prev_lr`` at
+    exactly the stripped paths (0 = "no LR seen yet", a first-rescale
+    no-op — see CompressorStack.init_state)."""
+    pathset = set(paths)
+
+    def walk(t, path):
+        if isinstance(t, dict):
+            out = {k: walk(v, path + (k,)) for k, v in t.items()}
+            if path in pathset:
+                out["prev_lr"] = np.zeros((), np.float32)
+            return out
+        if isinstance(t, tuple):
+            vals = [walk(v, path + (i,)) for i, v in enumerate(t)]
+            return type(t)(*vals) if hasattr(t, "_fields") else tuple(vals)
+        if isinstance(t, list):
+            return [walk(v, path + (i,)) for i, v in enumerate(t)]
+        return t
+
+    return walk(tree, ())
+
+
 def restore(path: str, step: Optional[int] = None,
             example: Optional[Dict[str, Any]] = None,
             broadcast: bool = True) -> Dict[str, Any]:
@@ -168,9 +220,24 @@ def restore(path: str, step: Optional[int] = None,
             # (raw leaf-order reshaping would silently corrupt e.g.
             # optax.MultiSteps state, whose field names do not sort
             # alphabetically)
-            state = _checkpointer().restore(
-                _step_dir(path, step),
-                item=jax.tree.map(np.asarray, example))
+            item = jax.tree.map(np.asarray, example)
+            try:
+                state = _checkpointer().restore(_step_dir(path, step),
+                                                item=item)
+            except Exception:
+                # round-1-era checkpoints predate the EF state's prev_lr
+                # leaf: retry against the legacy structure and reinsert
+                # the leaf as zeros (a first-rescale no-op)
+                legacy, stripped = _strip_ef_prev_lr(item)
+                if not stripped:
+                    raise
+                state = _checkpointer().restore(_step_dir(path, step),
+                                                item=legacy)
+                state = _insert_ef_prev_lr(state, stripped)
+                from .logging import log
+                log.info("checkpoint %s step %s: migrated legacy "
+                         "error-feedback state (+%d prev_lr leaf(s))",
+                         path, step, len(stripped))
         else:
             state = _checkpointer().restore(_step_dir(path, step))
     else:

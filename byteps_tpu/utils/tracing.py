@@ -56,6 +56,17 @@ class Tracer:
         mirror = bool(self._config.jax_profiler_dir)
         if not (mirror or self._active()):
             return
+        with self._lock:
+            prev = self._open_spans.pop((name, stage), None)
+        if prev is not None and prev[1] is not None:
+            # double-begin without an end: close the orphan annotation
+            # BEFORE entering the new one (annotations stack per thread;
+            # exiting it later would unwind out of order and every
+            # subsequent annotation would nest inside the orphan)
+            try:
+                prev[1].__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
         ann = None
         if mirror:
             try:

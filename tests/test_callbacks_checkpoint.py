@@ -145,3 +145,32 @@ def test_checkpointer_async_save(bps, tmp_path):
     np.testing.assert_array_equal(out["w"], np.full(8, 8.0, np.float32))
     out6 = ckpt.restore(path, step=6, broadcast=False)
     np.testing.assert_array_equal(out6["w"], np.full(8, 6.0, np.float32))
+
+
+def test_checkpoint_legacy_ef_state_migrates(bps, tmp_path):
+    """A round-1-era checkpoint whose error-feedback state predates the
+    prev_lr leaf restores against a current example: restore() retries
+    with the legacy structure and reinserts prev_lr as zeros()."""
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    legacy_ef = {"error": np.arange(4, dtype=np.float32),
+                 "momentum": np.ones(4, np.float32)}
+    legacy = {"params": {"w": np.arange(4, dtype=np.float32)},
+              "comp_state": {"t0": legacy_ef}}
+    path = str(tmp_path / "legacy")
+    ckpt.save(path, legacy, step=1)
+
+    current_ef = dict(legacy_ef, prev_lr=np.zeros((), np.float32))
+    example = {"params": {"w": np.zeros(4, np.float32)},
+               "comp_state": {"t0": current_ef}}
+    restored = ckpt.restore(path, example=example, broadcast=False)
+    ef = restored["comp_state"]["t0"]
+    np.testing.assert_array_equal(ef["error"], legacy_ef["error"])
+    np.testing.assert_array_equal(ef["momentum"], legacy_ef["momentum"])
+    assert np.asarray(ef["prev_lr"]).shape == ()
+    assert float(ef["prev_lr"]) == 0.0
+    # round-trip of a CURRENT checkpoint is untouched by the shim
+    ckpt.save(path, restored, step=2)
+    again = ckpt.restore(path, example=example, broadcast=False)
+    np.testing.assert_array_equal(again["comp_state"]["t0"]["error"],
+                                  legacy_ef["error"])
