@@ -252,9 +252,25 @@ def phase_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
 
             return comp_round
 
-        onebit_gbps = best_of(comp_fn({"compressor": "onebit"}, "bench_c"))
-        # randomk exercises the server's wire-form (homomorphic) fast
-        # path: O(k) summation per push instead of O(n)
+        # onebit via the DEVICE codec tier — the default for
+        # make_ps_train_step since the D2H-moves-compressed-bytes change
+        # (jax/device_compression.py); on this CPU-only loopback the
+        # compress is the bottleneck either way, on a TPU worker the
+        # compress rides the chip and the wire/D2H saving is the point
+        import jax.numpy as jnp
+
+        from byteps_tpu.jax.device_compression import DeviceCompressor
+        dc = DeviceCompressor(state.ps_client, 1, {"compressor": "onebit"})
+        grads_dev = [jnp.asarray(g) for g in grads]
+        dev_names = [f"bench_c{i}" for i in range(n_tensors)]
+
+        def dev_round():
+            dc.push_pull_leaves(state, dev_names, grads_dev, average=False)
+
+        onebit_gbps = best_of(dev_round)
+        # randomk via the HOST codec tier: exercises the numpy wire path
+        # and the server's wire-form (homomorphic) fast path — O(k)
+        # summation per push instead of O(n)
         randomk_gbps = best_of(
             comp_fn({"compressor": "randomk", "k": "0.01"}, "bench_r"))
         return {"pushpull_dense_gbps": round(dense_gbps, 3),
@@ -381,6 +397,13 @@ def main() -> None:
         probe, err = _run_phase("probe", 120.0)
         if err or not probe.get("ok"):
             errors["probe"] = err or f"bad probe {probe}"
+            return False
+        if (probe.get("platform") == "cpu"
+                and not os.environ.get("BENCH_ALLOW_CPU")):
+            # a silent jax CPU fallback must not publish CPU tokens/s as
+            # the headline device number; null + an error note instead
+            # (BENCH_ALLOW_CPU=1 overrides for local testing)
+            errors["probe"] = "default backend is cpu, not an accelerator"
             return False
         train, err = _run_phase("train", 440.0)
         if err:

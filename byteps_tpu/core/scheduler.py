@@ -147,10 +147,11 @@ class PartitionTask:
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
-                 "cmd_pull")
+                 "cmd_pull", "pull_len")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
-                 group, cmd, stack=None, step=0, wire=None, cmd_pull=None):
+                 group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
+                 pull_len=None):
         self.ctx: TensorContext = ctx
         self.partition: Partition = partition
         self.priority = priority
@@ -163,6 +164,7 @@ class PartitionTask:
         self.step = step           # compression round (seeds randomk/dither)
         self.wire = wire           # prebuilt/compressed push payload
         self.cmd_pull = cmd if cmd_pull is None else cmd_pull
+        self.pull_len = pull_len   # reply bytes when not dense (telemetry)
 
     @property
     def key(self) -> int:
@@ -390,7 +392,11 @@ class PipelineScheduler:
         finally:
             if self._tracer:
                 self._tracer.end(name, span)
-        if task.stack is None and self._config is not None:
+        if (task.stack is None and task.pull_len is None
+                and self._config is not None):
+            # pull_len set = device-compressed wire reply: NOT dense
+            # dtype data, sampling it would misparse (or raise on
+            # non-4-byte-aligned dithering replies and fail the round)
             try:
                 from ..utils.logging import debug_sample
                 debug_sample(self._config, name, span,
@@ -425,8 +431,11 @@ class PipelineScheduler:
             if task.stack is not None:
                 self._telemetry.record(task.stack.wire_bytes() * 2)
             elif task.wire is not None:
-                # prebuilt sparse payload up, dense reply down
-                self._telemetry.record(len(task.wire) + task.nbytes)
+                # prebuilt payload up; reply is dense unless pull_len says
+                # otherwise (device-compressed pulls are wire-sized)
+                down = task.pull_len if task.pull_len is not None \
+                    else task.nbytes
+                self._telemetry.record(len(task.wire) + down)
             else:
                 self._telemetry.record(task.nbytes * 2)
         with self._inflight_mu:
@@ -492,6 +501,35 @@ class PipelineScheduler:
             except RuntimeError as e:
                 # scheduler stopped mid-submit: fail this partition so the
                 # handle resolves with an error instead of hanging
+                group.partition_done(e)
+
+    def submit_wire(self, ctx: TensorContext, wires: List[np.ndarray],
+                    reply_lens: List[int], cmds: List[int], handle: Handle,
+                    version: int = 0,
+                    priority: Optional[int] = None) -> None:
+        """Prebuilt-wire push_pull for device-compressed tensors
+        (jax/device_compression.py): partition i pushes ``wires[i]`` with
+        ``cmds[i]`` and pulls ``reply_lens[i]`` raw bytes; the handle
+        resolves to the list of reply buffers. No host codec stages —
+        compress and decompress run inside the worker's XLA programs, so
+        the pipeline here is pure PUSH -> PULL with the usual priority,
+        credit and same-key serialization semantics."""
+        replies = [np.empty(rl, np.uint8) for rl in reply_lens]
+
+        def on_complete(err: Optional[Exception]) -> None:
+            handle._finish(replies if err is None else None, err)
+
+        group = TaskGroup(ctx, len(ctx.partitions), on_complete)
+        if priority is None:
+            priority = -ctx.declared_key
+        for i, p in enumerate(ctx.partitions):
+            task = PartitionTask(
+                ctx, p, priority, version, None, replies[i], group,
+                cmds[i], wire=wires[i], cmd_pull=cmds[i],
+                pull_len=reply_lens[i])
+            try:
+                self._queue.add_task(task)
+            except RuntimeError as e:
                 group.partition_done(e)
 
     def submit_rowsparse(self, ctx: TensorContext, host2d: np.ndarray,

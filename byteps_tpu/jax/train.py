@@ -177,6 +177,54 @@ def _comp_pool():
     return _COMP_POOL
 
 
+def _device_compressed_round(state, client, comp_state, compression,
+                             min_compress_bytes, rowsparse_params, names,
+                             leaves, treedef):
+    """One gradient round on the device-compressed tier: leaves matching
+    ``rowsparse_params`` ride the host row-sparse path (the row payload
+    needs the dense host rows anyway); everything else compresses inside
+    XLA and crosses device->host wire-sized
+    (device_compression.DeviceCompressor)."""
+    import numpy as np
+
+    from .device_compression import DeviceCompressor
+
+    if comp_state["client"] is not client or comp_state["device"] is None:
+        mcb = min_compress_bytes
+        if mcb is None:
+            mcb = getattr(state.config, "min_compress_bytes", 0)
+        comp_state["device"] = DeviceCompressor(
+            client, state.config.num_workers, compression, mcb)
+        comp_state["client"] = client
+        comp_state["registry"] = None  # host tier rebuilt on demand
+    dc = comp_state["device"]
+
+    sparse = {}
+    dev_idx = []
+    for i, (name, leaf) in enumerate(zip(names, leaves)):
+        if (rowsparse_params and leaf.ndim == 2
+                and any(s in name for s in rowsparse_params)):
+            sparse[i] = None
+        else:
+            dev_idx.append(i)
+    from .. import _rowsparse_submit
+    for i in sparse:
+        h = np.asarray(leaves[i]).astype(np.float32, copy=False)
+        handle = state.handles.allocate(names[i])
+        _rowsparse_submit(state, names[i], h, True, handle)
+        sparse[i] = (handle, leaves[i].dtype)
+    results = [None] * len(leaves)
+    if dev_idx:
+        out = dc.push_pull_leaves(state, [names[i] for i in dev_idx],
+                                  [leaves[i] for i in dev_idx])
+        for i, o in zip(dev_idx, out):
+            results[i] = o
+    for i, (handle, dt) in sparse.items():
+        results[i] = np.asarray(
+            state.handles.wait_and_clear(handle.id)).astype(dt, copy=False)
+    return treedef.unflatten(results)
+
+
 def make_ps_train_step(
     loss_fn: Callable,
     tx: optax.GradientTransformation,
@@ -185,6 +233,7 @@ def make_ps_train_step(
     compression: Optional[dict] = None,
     min_compress_bytes: Optional[int] = None,
     rowsparse_params: Optional[Tuple[str, ...]] = None,
+    device_compress: Optional[bool] = None,
 ):
     """Two-phase train step for the DCN PS path — the reference's actual
     architecture (docs/architecture.md "General Workflow"): the compiled
@@ -200,6 +249,15 @@ def make_ps_train_step(
     mirror (reference: BASELINE config 4 path; server.cc:92-118). EF and
     momentum state live worker-side per tensor. ``min_compress_bytes``
     gates small tensors onto the dense path (BYTEPS_MIN_COMPRESS_BYTES).
+
+    ``device_compress`` (default on whenever ``compression`` is set and
+    the scheduler is running): run the momentum->EF->codec stack inside
+    the compiled step (jax/device_compression.py), so the device->host
+    hop carries the wire-sized payload — SURVEY §7's "the D2H moves
+    *compressed* bytes" — instead of dense f32 that is then compressed
+    in numpy; the pull reply is decompressed back on device. EF state
+    lives on device and, like the host path's, resets on
+    suspend/resume. Set False to force the host-numpy codec tier.
 
     ``rowsparse_params``: substrings of gradient names (e.g.
     ``("embed",)``) whose 2D gradients travel row-sparse — only nonzero
@@ -218,7 +276,7 @@ def make_ps_train_step(
     # registry is keyed to the client that created it: suspend/resume
     # replaces state.ps_client, and a cached registry would then push on a
     # destroyed native handle with a stale worker count
-    comp_state = {"registry": None, "client": None}
+    comp_state = {"registry": None, "client": None, "device": None}
 
     def local_grads(params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -248,6 +306,17 @@ def make_ps_train_step(
                     str(getattr(k, "key", getattr(k, "idx", k)))
                     for k in path))
                 leaves.append(leaf)
+            use_device = (compression is not None
+                          and device_compress is not False
+                          and state.scheduler is not None)
+            if use_device:
+                grads = _device_compressed_round(
+                    state, client, comp_state, compression,
+                    min_compress_bytes, rowsparse_params, names, leaves,
+                    treedef)
+                params, opt_state = apply_fn(params, opt_state, grads)
+                return params, opt_state, loss
+            # host tier below: dense D2H, codecs in numpy.
             # start ALL D2H copies now; each np.asarray below then only
             # waits for ITS leaf, so the transfer of leaf k+1 rides the
             # bus while leaf k is already in PUSH — the reference's

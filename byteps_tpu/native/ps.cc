@@ -168,6 +168,16 @@ static bool recv_all_deadline(int fd, void* buf, size_t len,
     if (n >= (ssize_t)len) break;
     auto now = std::chrono::steady_clock::now();
     if (now >= deadline) return false;
+    if (n > 0) {
+      // partial message buffered: POLLIN is level-triggered and would
+      // return instantly on the bytes already there — sleep instead of
+      // busy-spinning a core until the rest (or the deadline) arrives
+      struct timespec ts;
+      ts.tv_sec = 0;
+      ts.tv_nsec = 1000000;  // 1ms
+      ::nanosleep(&ts, nullptr);
+      continue;
+    }
     int remain = (int)std::chrono::duration_cast<std::chrono::milliseconds>(
         deadline - now).count();
     struct pollfd pfd;

@@ -263,10 +263,12 @@ def test_compressed_ps_training(monkeypatch):
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(32, 8), jnp.float32)
         y = jnp.asarray(rng.randint(0, 4, 32), jnp.int32)
+        # device_compress=False pins the HOST-numpy codec tier (the
+        # device tier's e2e lives in test_device_compress.py)
         step = make_ps_train_step(
             lambda p, b: mlp.loss_fn(p, b, cfg), tx, state.mesh,
             compression={"compressor": "onebit", "ef": "vanilla"},
-            min_compress_bytes=0)
+            min_compress_bytes=0, device_compress=False)
         losses = []
         for _ in range(25):
             params, opt, loss = step(params, opt, {"x": x, "y": y})
