@@ -147,7 +147,7 @@ class PartitionTask:
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
-                 "cmd_pull", "pull_len")
+                 "cmd_pull", "pull_len", "push_len")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
                  group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
@@ -165,6 +165,7 @@ class PartitionTask:
         self.wire = wire           # prebuilt/compressed push payload
         self.cmd_pull = cmd if cmd_pull is None else cmd_pull
         self.pull_len = pull_len   # reply bytes when not dense (telemetry)
+        self.push_len = None       # actual pushed bytes (set by _do_push)
 
     @property
     def key(self) -> int:
@@ -351,6 +352,7 @@ class PipelineScheduler:
         span = self._span(task, "PUSH")
         try:
             buf = task.wire if task.wire is not None else task.in_view
+            task.push_len = len(buf)  # actual bytes (varint wires vary)
             if (self._config is not None and task.stack is None
                     and task.in_view is not None):
                 from ..utils.logging import debug_sample
@@ -380,9 +382,9 @@ class PipelineScheduler:
         try:
             if task.stack is not None:
                 reply = np.empty(task.stack.wire_bytes(), np.uint8)
-                self._client.zpull(task.partition.server, task.key, reply,
-                                   task.cmd_pull)
-                task.wire = reply
+                got = self._client.zpull(task.partition.server, task.key,
+                                         reply, task.cmd_pull)
+                task.wire = reply[:got]  # variable-length wires (varint)
             else:
                 self._client.zpull(task.partition.server, task.key,
                                    task.out_view, task.cmd_pull)
@@ -429,7 +431,14 @@ class PipelineScheduler:
         self._queue.report_finish(task)
         if self._telemetry:
             if task.stack is not None:
-                self._telemetry.record(task.stack.wire_bytes() * 2)
+                # ACTUAL lengths, not wire_bytes() (only an upper bound
+                # for variable-length varint wires): push_len captured at
+                # send; the reply overwrote task.wire, sliced to length
+                sent = task.push_len if task.push_len is not None \
+                    else task.stack.wire_bytes()
+                recvd = len(task.wire) if task.wire is not None \
+                    else task.stack.wire_bytes()
+                self._telemetry.record(sent + recvd)
             elif task.wire is not None:
                 # prebuilt payload up; reply is dense unless pull_len says
                 # otherwise (device-compressed pulls are wire-sized)

@@ -120,8 +120,20 @@ class _LeafPlan:
         self.name = name
         self.ctx = ctx
         self.n = (ctx.partitions[-1].offset + ctx.partitions[-1].length) // 4
+        # ef/momentum run on device (the server mirrors only the base
+        # codec); index_coding is a host-tier wire option — the device
+        # payload stays dense int8 (XLA needs static shapes), so the
+        # server must not be told to expect the varint wire
+        if kwargs.get("index_coding", "dense") != "dense":
+            from ..utils.logging import log
+            log.warning(
+                "compression index_coding=%r is a host-tier wire option; "
+                "the device tier ships dense int8 levels (XLA static "
+                "shapes). Pass device_compress=False to make_ps_train_step "
+                "to use the coded sparse wire.", kwargs["index_coding"])
         base_kwargs = {k: v for k, v in kwargs.items()
-                       if k not in ("ef", "momentum", "momentum_mu")}
+                       if k not in ("ef", "momentum", "momentum_mu",
+                                    "index_coding")}
         self.stacks: List[Optional[CompressorStack]] = []
         self.codecs: List[Optional[Codec]] = []   # portable base codecs
         self.host_base = []                       # kwargs_wire providers

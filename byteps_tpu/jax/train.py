@@ -177,6 +177,25 @@ def _comp_pool():
     return _COMP_POOL
 
 
+def _route_rowsparse(name: str, leaf, state, rowsparse_params) -> bool:
+    """One routing predicate for BOTH compression tiers: a leaf matching
+    ``rowsparse_params`` rides the row-sparse wire only when it is 2D
+    and a scheduler is running; mismatches warn once and fall back to
+    the tier's dense/compressed path."""
+    if not (rowsparse_params and any(s in name for s in rowsparse_params)):
+        return False
+    if getattr(leaf, "ndim", None) == 2 and state.scheduler is not None:
+        return True
+    if name not in _rowsparse_warned:
+        from ..utils.logging import log
+        _rowsparse_warned.add(name)
+        log.warning(
+            "rowsparse_params matched %r but the gradient is not 2D "
+            "(shape %s) or no scheduler is running — using the dense "
+            "path", name, getattr(leaf, "shape", None))
+    return False
+
+
 def _device_compressed_round(state, client, comp_state, compression,
                              min_compress_bytes, rowsparse_params, names,
                              leaves, treedef):
@@ -202,8 +221,7 @@ def _device_compressed_round(state, client, comp_state, compression,
     sparse = {}
     dev_idx = []
     for i, (name, leaf) in enumerate(zip(names, leaves)):
-        if (rowsparse_params and leaf.ndim == 2
-                and any(s in name for s in rowsparse_params)):
+        if _route_rowsparse(name, leaf, state, rowsparse_params):
             sparse[i] = None
         else:
             dev_idx.append(i)
@@ -372,21 +390,10 @@ def make_ps_train_step(
             for name, leaf in zip(names, leaves):
                 h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
                 shapes.append(h.shape)
-                want_sparse = rowsparse_params and any(
-                    s in name for s in rowsparse_params)
-                if (want_sparse and state.scheduler is not None
-                        and h.ndim == 2):
+                if _route_rowsparse(name, h, state, rowsparse_params):
                     # non-f32 grads upcast for the wire, cast back below
                     waiters.append(submit_sparse(name, h, h.dtype))
                 else:
-                    if want_sparse and name not in _rowsparse_warned:
-                        from ..utils.logging import log
-                        _rowsparse_warned.add(name)
-                        log.warning(
-                            "rowsparse_params matched %r but the "
-                            "gradient is not 2D (shape %s) or no "
-                            "scheduler is running — using the dense "
-                            "path", name, h.shape)
                     waiters.append(submit(name, h.reshape(-1)))
             results = [w().reshape(shape)
                        for w, shape in zip(waiters, shapes)]

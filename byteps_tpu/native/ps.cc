@@ -679,20 +679,32 @@ struct CompressorCfg {
   bool scaled = true;   // onebit
   bool natural = false; // dithering partition
   bool l2 = false;      // dithering normalize
+  bool varint = false;  // dithering sparse index coding (delta+LEB128)
 
+  // Upper bound on a wire payload. Fixed formats use it exactly; the
+  // varint dithering wire is variable-length up to this bound (worst
+  // case all-nonzero: n 1-byte gaps + n levels, plus slack for the rare
+  // multi-byte gaps, whose count is bounded by sum(gaps) <= n).
   uint32_t WireLen() const {
     switch (type) {
       case ONEBIT: return ((n + 31) / 32) * 4 + 4;
       case TOPK: case RANDOMK: return k * 8;
-      case DITHERING: return n + 4;
+      case DITHERING:
+        return varint ? 2 * n + n / 64 + 16 : n + 4;
       default: return 0;
     }
+  }
+
+  bool ValidLen(size_t len) const {
+    if (type == DITHERING && varint)
+      return len >= 8 && len <= WireLen();
+    return len == WireLen();
   }
 
   bool operator==(const CompressorCfg& o) const {
     return type == o.type && n == o.n && k == o.k && s == o.s &&
            seed == o.seed && scaled == o.scaled && natural == o.natural &&
-           l2 == o.l2;
+           l2 == o.l2 && varint == o.varint;
   }
 
   // kwargs string: "compressor=onebit;n=100;scaling=1;..."
@@ -718,6 +730,7 @@ struct CompressorCfg {
           c.scaled = (val == "1" || val == "true");
         else if (key == "partition_type") c.natural = (val == "natural");
         else if (key == "normalize_type") c.l2 = (val == "l2");
+        else if (key == "index_coding") c.varint = (val == "varint");
       }
       pos = semi + 1;
     }
@@ -739,7 +752,7 @@ struct CompressorCfg {
   // indices instead of re-deriving the xorshift stream)
   bool Decompress(const uint8_t* in, uint32_t len, float* out,
                   std::vector<int32_t>* idx_out) const {
-    if (len != WireLen()) return false;
+    if (!ValidLen(len)) return false;
     switch (type) {
       case ONEBIT: {
         float scale;
@@ -763,6 +776,48 @@ struct CompressorCfg {
         return true;
       }
       case DITHERING: {
+        if (varint) {
+          // [u32 nnz][LEB128 gaps][int8 levels][f32 norm]; gaps are
+          // deltas with an implicit start index of -1 (first gap =
+          // idx0 + 1, always >= 1). Bounds-checked: untrusted input.
+          uint32_t nnz;
+          std::memcpy(&nnz, in, 4);
+          if (nnz > n) return false;
+          std::memset(out, 0, (size_t)n * sizeof(float));
+          size_t pos = 4;
+          std::vector<uint32_t> idxs(nnz);
+          int64_t idx = -1;
+          for (uint32_t j = 0; j < nnz; ++j) {
+            uint64_t g = 0;
+            int shift = 0;
+            for (;;) {
+              if (pos >= len) return false;
+              uint8_t b = in[pos++];
+              g |= (uint64_t)(b & 0x7F) << shift;
+              if (!(b & 0x80)) break;
+              shift += 7;
+              if (shift > 35) return false;
+            }
+            if (g == 0) return false;
+            idx += (int64_t)g;
+            if (idx >= (int64_t)n) return false;
+            idxs[j] = (uint32_t)idx;
+          }
+          if (pos + nnz + 4 != len) return false;
+          const int8_t* lv = (const int8_t*)(in + pos);
+          float norm;
+          std::memcpy(&norm, in + pos + nnz, 4);
+          for (uint32_t j = 0; j < nnz; ++j) {
+            float l = (float)lv[j];
+            float a = std::fabs(l);
+            float mag = !natural ? a / (float)s
+                                 : (l == 0.0f ? 0.0f
+                                              : std::exp2f(-(a - 1.0f)));
+            float sgn = (l > 0) - (l < 0);
+            out[idxs[j]] = sgn * mag * norm;
+          }
+          return true;
+        }
         float norm;
         std::memcpy(&norm, in + n, 4);
         const int8_t* lv = (const int8_t*)in;
@@ -784,11 +839,13 @@ struct CompressorCfg {
     }
   }
 
-  // dense f32[n] -> wire payload. step = completed aggregation rounds
-  // before this one (matches the worker's per-key push counter);
-  // round_idx = the shared indices of this round's randomk payloads.
-  void Compress(const float* in, uint8_t* out, uint64_t step,
-                const std::vector<int32_t>& round_idx) const {
+  // dense f32[n] -> wire payload; returns the ACTUAL payload length
+  // (== WireLen() for the fixed formats; <= WireLen() for the varint
+  // dithering wire). step = completed aggregation rounds before this one
+  // (matches the worker's per-key push counter); round_idx = the shared
+  // indices of this round's randomk payloads.
+  uint32_t Compress(const float* in, uint8_t* out, uint64_t step,
+                    const std::vector<int32_t>& round_idx) const {
     switch (type) {
       case ONEBIT: {
         float scale = 1.0f;
@@ -810,7 +867,7 @@ struct CompressorCfg {
           bits[w] = word;
         }
         std::memcpy(out + words * 4, &scale, 4);
-        break;
+        return words * 4 + 4;
       }
       case TOPK: {
         // (|v| desc, idx asc) selection, emitted in ascending-index order
@@ -830,7 +887,7 @@ struct CompressorCfg {
           idx[i] = order[i];
           val[i] = in[order[i]];
         }
-        break;
+        return k * 8;
       }
       case RANDOMK: {
         int32_t* idx = (int32_t*)out;
@@ -840,7 +897,7 @@ struct CompressorCfg {
           idx[i] = j;
           val[i] = in[j];
         }
-        break;
+        return k * 8;
       }
       case DITHERING: {
         float m = 0.0f;
@@ -862,7 +919,15 @@ struct CompressorCfg {
         uint64_t s0, s1;
         seed_state64(seed, &s0, &s1);
         uint32_t base = (uint32_t)(s0 & 0xFFFFFFFFULL) ^ (uint32_t)step;
-        int8_t* lv = (int8_t*)out;
+        // dense: int8 levels in place. varint: [u32 nnz][LEB128 gaps
+        // (first gap = idx0+1, then deltas)][int8 nonzero levels]
+        // [f32 norm] — the reference's coded sparse dithering wire
+        // (impl/dithering.cc:25-80, utils.h BitWriter), byte-aligned.
+        int8_t* lv_dense = varint ? nullptr : (int8_t*)out;
+        size_t gap_pos = 4;
+        std::vector<int8_t> lvs;
+        uint32_t last = 0, nnz = 0;
+        bool first = true;
         for (uint32_t i = 0; i < n; ++i) {
           float scl = std::fabs(in[i]) / norm;
           float u = uniform_at(i, base);
@@ -886,12 +951,33 @@ struct CompressorCfg {
             level = std::min(std::max(level, 0.0f), 126.0f);
           }
           float sgn = (in[i] > 0) - (in[i] < 0);
-          lv[i] = (int8_t)(sgn * level);
+          int8_t v = (int8_t)(sgn * level);
+          if (!varint) {
+            lv_dense[i] = v;
+            continue;
+          }
+          if (v == 0) continue;
+          uint64_t gap = first ? (uint64_t)i + 1 : (uint64_t)(i - last);
+          first = false;
+          last = i;
+          while (gap >= 0x80) {
+            out[gap_pos++] = (uint8_t)(gap & 0x7F) | 0x80;
+            gap >>= 7;
+          }
+          out[gap_pos++] = (uint8_t)gap;
+          lvs.push_back(v);
+          ++nnz;
         }
-        std::memcpy(out + n, &norm, 4);
-        break;
+        if (!varint) {
+          std::memcpy(out + n, &norm, 4);
+          return n + 4;
+        }
+        std::memcpy(out, &nnz, 4);
+        if (nnz) std::memcpy(out + gap_pos, lvs.data(), nnz);
+        std::memcpy(out + gap_pos + nnz, &norm, 4);
+        return (uint32_t)(gap_pos + nnz + 4);
       }
-      default: break;
+      default: return 0;
     }
   }
 };
@@ -1487,8 +1573,10 @@ class Server {
           // publish a compressed view of the current aggregate so a pull
           // that precedes the first compressed round is answerable
           auto w = std::make_shared<std::vector<uint8_t>>(cfg.WireLen());
-          ks.comp.Compress((const float*)ks.pub->data(), w->data(),
-                           ks.completed_rounds, ks.round_idx);
+          uint32_t wl = ks.comp.Compress((const float*)ks.pub->data(),
+                                         w->data(), ks.completed_rounds,
+                                         ks.round_idx);
+          w->resize(wl);  // varint wires are variable-length
           ks.pub_wire = std::move(w);
         }
       }
@@ -1601,13 +1689,14 @@ class Server {
         // fell back: wire_accum expanded into dense accum; the generic
         // path below decompresses THIS payload and adds it
       }
-      if (m.payload.size() != ks.comp.WireLen() ||
-          !ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
+      if (!ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
                               ks.scratch.data(),
                               ks.recv_count == 0 ? &ks.round_idx : nullptr)) {
+        // Decompress validates the length itself (exact for the fixed
+        // formats, bounded for the variable varint dithering wire)
         std::fprintf(stderr,
                      "[bps-server] compressed push rejected key=%llu "
-                     "len=%zu want=%u\n",
+                     "len=%zu bound=%u\n",
                      (unsigned long long)m.key, m.payload.size(),
                      ks.comp.WireLen());
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
@@ -1645,8 +1734,9 @@ class Server {
             std::move(ks.accum));
         DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
         auto w = std::make_shared<std::vector<uint8_t>>(ks.comp.WireLen());
-        ks.comp.Compress((const float*)d->data(), w->data(),
-                         ks.completed_rounds, ks.round_idx);
+        uint32_t wl = ks.comp.Compress((const float*)d->data(), w->data(),
+                                       ks.completed_rounds, ks.round_idx);
+        w->resize(wl);  // varint wires are variable-length
         if (ks.pub && ks.pub.use_count() == 1 &&
             ks.pub->size() == ks.len) {
           ks.accum = std::move(

@@ -185,6 +185,55 @@ def _uniform_fast(seed: int, n: int, mix: int) -> np.ndarray:
     return u
 
 
+def _varint_encode(vals: np.ndarray) -> np.ndarray:
+    """LEB128 bytes for nonnegative int64 values — vectorized (per-BYTE
+    python loop, <=5 iterations, each pass full-width numpy)."""
+    vals = np.asarray(vals, np.int64)
+    if vals.size == 0:
+        return np.zeros(0, np.uint8)
+    nb = np.ones(vals.shape, np.int64)
+    v = vals >> 7
+    while v.any():
+        nb += v > 0
+        v >>= 7
+    ends = np.cumsum(nb)
+    out = np.zeros(int(ends[-1]), np.uint8)
+    starts = ends - nb
+    for j in range(int(nb.max())):
+        sel = nb > j
+        byte = (vals[sel] >> (7 * j)) & 0x7F
+        cont = np.where(j < nb[sel] - 1, 0x80, 0)
+        out[starts[sel] + j] = (byte | cont).astype(np.uint8)
+    return out
+
+
+def _varint_decode(buf_u8: np.ndarray, count: int):
+    """(values int64[count], bytes_consumed) — vectorized LEB128 decode
+    of the first ``count`` varints in ``buf_u8``."""
+    if count == 0:
+        return np.zeros(0, np.int64), 0
+    term = (buf_u8 & 0x80) == 0
+    ends = np.flatnonzero(term)
+    if len(ends) < count:
+        raise ValueError("truncated varint stream")
+    last = int(ends[count - 1])
+    b = buf_u8[: last + 1].astype(np.int64)
+    e = ends[:count]
+    starts = np.concatenate(([0], e[:-1] + 1))
+    gid = np.zeros(last + 1, np.int64)
+    gid[starts[1:]] = 1
+    gid = np.cumsum(gid)
+    # cap matches the C++ decoder (shift > 35 rejected): values stay
+    # < 2^42, so a cumsum of <= 2^31 of them cannot overflow int64 and
+    # wrap an index negative past the bounds checks
+    shift = (np.arange(last + 1) - starts[gid]) * 7
+    if int(shift.max(initial=0)) > 35:
+        raise ValueError("varint too long")
+    vals = np.zeros(count, np.int64)
+    np.add.at(vals, gid, (b & 0x7F) << shift)
+    return vals, last + 1
+
+
 @dataclasses.dataclass
 class HostDithering(HostCodec):
     n: int
@@ -192,6 +241,15 @@ class HostDithering(HostCodec):
     partition: str = "linear"
     normalize: str = "max"
     seed: int = 0
+    # "varint": delta+LEB128-coded nonzero indices + int8 levels on the
+    # wire — the reference's coded sparse dithering format
+    # (impl/dithering.cc:25-80, compressor/utils.h BitWriter), byte-
+    # aligned here. Wire bytes ~ 2 x nnz instead of n: at low s most
+    # levels quantize to zero and the wire shrinks accordingly. The wire
+    # is then VARIABLE-LENGTH (wire_bytes() is the allocation bound);
+    # only the host/C++ tier supports it (the on-device payload stays
+    # dense int8 — XLA needs static shapes).
+    index_coding: str = "dense"
 
     def compress(self, x: np.ndarray, step: int = 0) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
@@ -232,12 +290,40 @@ class HostDithering(HostCodec):
                              np.float32(0.0), exp + 1.0)
             level = np.clip(level, 0, 126)
         levels = (np.sign(x) * level).astype(np.int8)
+        if self.index_coding == "varint":
+            nz = np.flatnonzero(levels)
+            gaps = np.empty(len(nz), np.int64)
+            if len(nz):
+                gaps[0] = nz[0] + 1  # implicit start index -1
+                gaps[1:] = np.diff(nz)
+            gb = _varint_encode(gaps)
+            return (np.uint32(len(nz)).tobytes() + gb.tobytes()
+                    + levels[nz].tobytes() + np.float32(norm).tobytes())
         return levels.tobytes() + np.float32(norm).tobytes()
 
-    def decompress(self, buf) -> np.ndarray:
+    def _dense_levels(self, buf) -> tuple:
+        """(int8 levels[n], norm) from either wire form."""
         raw = np.frombuffer(buf, np.uint8)
-        lv = raw[: self.n].view(np.int8).astype(np.float32)
-        norm = raw[self.n: self.n + 4].view(np.float32)[0]
+        if self.index_coding != "varint":
+            return raw[: self.n].view(np.int8), \
+                raw[self.n: self.n + 4].view(np.float32)[0]
+        nnz = int(raw[:4].copy().view(np.uint32)[0])
+        if nnz > self.n:
+            raise ValueError(f"varint dithering wire: nnz {nnz} > n")
+        gaps, used = _varint_decode(raw[4: len(raw) - 4 - nnz], nnz)
+        if used != len(raw) - 8 - nnz:
+            raise ValueError("varint dithering wire: trailing bytes")
+        idx = np.cumsum(gaps) - 1
+        if len(idx) and (gaps.min() < 1 or gaps.max() > self.n
+                         or idx[-1] >= self.n):
+            raise ValueError("varint dithering wire: bad indices")
+        lv = np.zeros(self.n, np.int8)
+        lv[idx] = raw[4 + used: 4 + used + nnz].view(np.int8)
+        return lv, raw[-4:].copy().view(np.float32)[0]
+
+    def decompress(self, buf) -> np.ndarray:
+        lv, norm = self._dense_levels(buf)
+        lv = lv.astype(np.float32)
         if self.partition == "linear":
             mag = np.abs(lv) / np.float32(self.s)
         else:
@@ -246,12 +332,18 @@ class HostDithering(HostCodec):
         return (np.sign(lv) * mag * norm).astype(np.float32)
 
     def wire_bytes(self) -> int:
+        # varint: allocation BOUND (worst case all-nonzero + multi-byte
+        # gap slack); actual wires are shorter — matches ps.cc WireLen()
+        if self.index_coding == "varint":
+            return 2 * self.n + self.n // 64 + 16
         return self.n + 4
 
     def kwargs_wire(self) -> str:
+        extra = ";index_coding=varint" if self.index_coding == "varint" \
+            else ""
         return (f"compressor=dithering;n={self.n};s={self.s};"
                 f"partition_type={self.partition};"
-                f"normalize_type={self.normalize};seed={self.seed}")
+                f"normalize_type={self.normalize};seed={self.seed}{extra}")
 
 
 class HostErrorFeedback:
@@ -335,11 +427,14 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
         codec = HostRandomk(n=n, k=resolve_k(float(kwargs.get("k", 0.01)), n),
                             seed=int(kwargs.get("seed", 0)))
     elif name == "dithering":
+        coding = kwargs.get("index_coding", "dense")
+        if coding not in ("dense", "varint"):
+            raise ValueError(f"unknown index_coding {coding!r}")
         codec = HostDithering(
             n=n, s=int(kwargs.get("s", 127)),
             partition=kwargs.get("partition_type", "linear"),
             normalize=kwargs.get("normalize_type", "max"),
-            seed=int(kwargs.get("seed", 0)))
+            seed=int(kwargs.get("seed", 0)), index_coding=coding)
     else:
         raise ValueError(f"unknown compressor {name!r}")
     stack = codec

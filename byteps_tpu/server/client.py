@@ -182,11 +182,15 @@ class PSClient:
             raise RuntimeError(f"push failed key={key}")
 
     def zpull(self, server: int, key: int, out: np.ndarray,
-              cmd: int) -> None:
+              cmd: int) -> int:
+        """Pull into ``out``; returns the ACTUAL reply length (equal to
+        out.nbytes for dense/fixed formats, possibly shorter for
+        variable-length wires like varint-coded dithering)."""
         rc = self._lib.bps_client_pull(
             self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
         if rc < 0:
             raise RuntimeError(f"pull failed key={key}")
+        return rc
 
     def comp_init(self, server: int, key: int, kwargs_wire: str) -> None:
         """Install a server-side compressor for ``key`` (the reference's

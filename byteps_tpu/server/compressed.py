@@ -120,6 +120,9 @@ class CompressedTensor:
         out = np.empty_like(flat)
         view = flat.view(np.uint8)
         out_view = out.view(np.uint8)
+        # ACTUAL bytes moved this round (list.append is GIL-atomic):
+        # wire_bytes() is only an upper bound for varint wires
+        moved: list = []
 
         def one(p, stack):
             lo, hi = p.offset, p.offset + p.length
@@ -128,6 +131,7 @@ class CompressedTensor:
                 self.client.zpush(p.server, p.key, buf, CMD_F32)
                 dst = np.empty(p.length, np.uint8)
                 self.client.zpull(p.server, p.key, dst, CMD_F32)
+                moved.append(2 * p.length)
                 res = dst.view(np.float32)
                 if average and self.num_workers > 1:
                     res = res / self.num_workers
@@ -136,8 +140,9 @@ class CompressedTensor:
             wire = compress_partition(stack, view[lo:hi], step)
             self.client.zpush(p.server, p.key, wire, CMD_COMP_F32)
             reply = np.empty(stack.wire_bytes(), np.uint8)
-            self.client.zpull(p.server, p.key, reply, CMD_COMP_F32)
-            decompress_partition(stack, reply, out_view[lo:hi])
+            got = self.client.zpull(p.server, p.key, reply, CMD_COMP_F32)
+            moved.append(len(wire) + got)
+            decompress_partition(stack, reply[:got], out_view[lo:hi])
             if average and self.num_workers > 1:
                 res = out_view[lo:hi].view(np.float32)
                 res /= self.num_workers
@@ -148,6 +153,7 @@ class CompressedTensor:
         ]
         for f in futures:
             f.result()
+        self.last_round_bytes = sum(moved)
         return out
 
     def wire_bytes(self) -> int:
@@ -185,7 +191,8 @@ class CompressedRegistry:
                   average: bool = True) -> np.ndarray:
         ct = self.get(state, name, flat)
         out = ct.push_pull(flat, average)
-        state.telemetry.record(ct.wire_bytes() * 2)
+        state.telemetry.record(
+            getattr(ct, "last_round_bytes", None) or ct.wire_bytes() * 2)
         return out
 
     def push_pull_async(self, state, name: str, flat: np.ndarray,

@@ -1,0 +1,406 @@
+"""byteps_tpu.tensorflow — Horovod-compatible TensorFlow 2 adapter.
+
+The reference's TF adapter (byteps/tensorflow/__init__.py) splices a
+``BytepsPushPull`` AsyncOpKernel (ops.cc:167-231) into TF graphs and
+wraps optimizers/tapes so every gradient is push_pulled before the
+update. This rebuild keeps that public surface — ``push_pull``,
+``broadcast``/``broadcast_variables``, ``DistributedGradientTape``,
+``DistributedOptimizer``, handle-based async ops — with TF2-first
+mechanics: eager tensors hop to numpy and ride the SAME priority-
+scheduled PS pipeline as the JAX and torch adapters (core/scheduler.py
+-> native TCP/shm client -> C++ server), so a third framework shares
+one comm stack. Inside ``tf.function`` graphs the ops run through
+``tf.py_function`` (the numpy transport is host-side either way).
+
+Documented divergences from the reference:
+- no custom TF C++ op kernel: the transport is already native C++
+  behind ctypes; a py_function boundary replaces the AsyncOpKernel
+  (graph-compile fusion of comm ops buys nothing on a host-side wire).
+- ``tf.IndexedSlices`` gradients ride the ROW-SPARSE PS path (only
+  nonzero rows on the push wire — push_pull_rowsparse) and come back
+  dense, instead of the reference's all-gathered IndexedSlices.
+- TF1 Session/graph-mode (``broadcast_global_variables`` hook) is out
+  of scope, like the reference marks it deprecated for TF2.
+
+Single-worker (no PS configured) everything degrades to identity,
+matching the reference's size()==1 behavior.
+
+Reference parity map:
+- push_pull / handle ops            <- tensorflow/ops.py, ops.cc:167-231
+- DistributedGradientTape           <- tensorflow/__init__.py:343-417
+- DistributedOptimizer (keras)      <- tensorflow/__init__.py:282-341,
+                                       tensorflow/keras/__init__.py:40-64
+- broadcast_variables               <- tensorflow/__init__.py:110-122
+- keras callbacks                   <- tensorflow/keras/callbacks.py
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..core.scheduler import Handle, HandleManager
+from ..core.state import get_state
+from .compression import Compression
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "push_pull", "push_pull_async", "poll", "synchronize",
+    "broadcast", "broadcast_variables",
+    "DistributedGradientTape", "DistributedOptimizer",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "Compression",
+]
+
+
+def init(*args, **kwargs) -> None:
+    get_state().init(*args, **kwargs)
+
+
+def shutdown() -> None:
+    get_state().shutdown()
+
+
+def suspend() -> None:
+    get_state().suspend()
+
+
+def resume(num_workers: int, num_servers: int,
+           global_rank: Optional[int] = None) -> None:
+    get_state().resume(num_workers, num_servers, global_rank)
+
+
+def rank() -> int:
+    return get_state().rank()
+
+
+def size() -> int:
+    return get_state().size()
+
+
+def local_rank() -> int:
+    return get_state().local_rank()
+
+
+def local_size() -> int:
+    return get_state().local_size()
+
+
+# --------------------------------------------------------------------- #
+# handle-based async ops on the shared PS pipeline
+# --------------------------------------------------------------------- #
+
+# Adapter-owned handles (never the core's HandleManager): TF handles
+# cannot collide with JAX-side ids, and the single-worker fast path
+# needs no PS connection — same arrangement as the torch adapter.
+_handles = HandleManager()
+
+
+def _submit(host: np.ndarray, name: str, average: bool,
+            priority: Optional[int]) -> Handle:
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError(
+            "byteps_tpu.tensorflow: init() must be called first")
+    flat = np.ascontiguousarray(host).reshape(-1)
+    handle = _handles.allocate(name)
+    handle._shape = host.shape
+    if state.scheduler is None:
+        # single worker: sum over 1 contributor == identity
+        handle._finish(flat.copy(), None)
+        return handle
+    from ..server.client import get_or_init_ctx
+    ctx = get_or_init_ctx(state, name, flat)
+    state.scheduler.submit(ctx, flat, handle, average,
+                           state.config.num_workers,
+                           version=state.next_version(name),
+                           priority=priority)
+    return handle
+
+
+def _submit_rowsparse(host2d: np.ndarray, name: str,
+                      average: bool) -> Handle:
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError(
+            "byteps_tpu.tensorflow: init() must be called first")
+    host2d = np.ascontiguousarray(host2d, np.float32)
+    handle = _handles.allocate(name)
+    handle._shape = host2d.shape
+    if state.scheduler is None:
+        handle._finish(host2d.copy(), None)
+        return handle
+    from .. import _rowsparse_submit
+    _rowsparse_submit(state, name, host2d, average, handle)
+    return handle
+
+
+def _auto_name(prefix: str, tensor) -> str:
+    """Shape-derived default name. Names key the PS registry across
+    steps, so repeated push_pulls of the same logical tensor MUST reuse
+    one name: two distinct anonymous tensors of the same shape share a
+    key (rounds serialize; multi-worker callers should pass ``name``
+    explicitly, as the adapter's own tape/optimizer/broadcast paths
+    do)."""
+    shape = tuple(getattr(tensor, "shape", ()))
+    return f"{prefix}.{'x'.join(str(int(d)) for d in shape)}"
+
+
+def _to_numpy(value) -> np.ndarray:
+    if isinstance(value, np.ndarray):
+        return value
+    return value.numpy() if hasattr(value, "numpy") else np.asarray(value)
+
+
+def push_pull_async(tensor, name: str, average: bool = True,
+                    priority: Optional[int] = None) -> int:
+    """Submit an async push_pull of an eager tensor/ndarray; returns an
+    int handle for poll()/synchronize() (reference: ops.py:48-85)."""
+    return _submit(_to_numpy(tensor), name, average, priority).id
+
+
+def poll(handle: int) -> bool:
+    return _handles.poll(handle)
+
+
+def synchronize(handle: int, timeout: Optional[float] = None) -> tf.Tensor:
+    h = _handles.get(handle)
+    flat = _handles.wait_and_clear(handle, timeout=timeout)
+    return tf.constant(np.asarray(flat).reshape(h._shape))
+
+
+def _push_pull_dense(host: np.ndarray, name: str, average: bool,
+                     priority, compression) -> np.ndarray:
+    wire, cctx = compression.compress(host)
+    h = _submit(wire, name, average, priority)
+    out = _handles.wait_and_clear(h.id).reshape(wire.shape)
+    return compression.decompress(out, cctx)
+
+
+def push_pull(tensor, scope: str = "", average: bool = True,
+              name: Optional[str] = None, priority: Optional[int] = None,
+              compression=Compression.none, sparse_as_dense: bool = False):
+    """Cross-worker sum (mean when ``average``) of a tf tensor through
+    the PS (reference: tensorflow/__init__.py:40-90).
+
+    ``tf.IndexedSlices`` input rides the row-sparse wire (nonzero rows
+    only) unless ``sparse_as_dense``; the result is a DENSE tensor
+    either way. Works eagerly and inside ``tf.function`` (py_function
+    boundary)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        dense_shape = [int(d) for d in tensor.dense_shape]
+        nm = name or _auto_name(f"tfsparse/{scope or 'g'}", tensor.values)
+        idx = _to_numpy(tensor.indices)
+        vals = _to_numpy(tensor.values).astype(np.float32)
+        host = np.zeros(dense_shape, np.float32)
+        np.add.at(host, idx, vals)  # duplicate ids accumulate
+        if sparse_as_dense or len(dense_shape) != 2:
+            out = _push_pull_dense(host, nm, average, priority, compression)
+            return tf.constant(out)
+        h = _submit_rowsparse(host, nm, average)
+        return tf.constant(np.asarray(_handles.wait_and_clear(h.id)))
+
+    nm = name or _auto_name(f"tf/{scope or 'g'}", tensor)
+
+    if tf.is_tensor(tensor) and not tf.executing_eagerly():
+        # graph mode (inside tf.function): hop through py_function — the
+        # transport is host-side numpy either way
+        def _op(t):
+            out = _push_pull_dense(t.numpy(), nm, average, priority,
+                                   compression)
+            return tf.constant(out)
+
+        result = tf.py_function(_op, [tensor], Tout=tensor.dtype)
+        result.set_shape(tensor.shape)
+        return result
+
+    out = _push_pull_dense(_to_numpy(tensor), nm, average, priority,
+                           compression)
+    return tf.constant(out)
+
+
+# --------------------------------------------------------------------- #
+# broadcast
+# --------------------------------------------------------------------- #
+
+def broadcast(value, root_rank: int, scope: str = "",
+              name: Optional[str] = None) -> tf.Tensor:
+    """Root's value to every worker: non-roots contribute zeros and the
+    PS sum IS the broadcast (the torch adapter's arrangement; reference
+    broadcasts via its BytepsBroadcast op)."""
+    host = _to_numpy(value)
+    nm = name or _auto_name(f"tfbcast/{scope or 'b'}", value)
+    contrib = host if rank() == root_rank else np.zeros_like(host)
+    h = _submit(contrib, nm, False, None)
+    return tf.constant(_handles.wait_and_clear(h.id).reshape(host.shape))
+
+
+def broadcast_variables(variables: Iterable, root_rank: int = 0,
+                        scope: str = "") -> None:
+    """Assign every variable to the root's value (reference:
+    tensorflow/__init__.py:110-122) — run after building the model and
+    before training so all workers start bit-identical."""
+    if size() <= 1:
+        return
+    for i, var in enumerate(variables):
+        nm = f"tfbcast/{scope or 'v'}/{i}"
+        var.assign(broadcast(var.value(), root_rank, name=nm))
+
+
+# --------------------------------------------------------------------- #
+# DistributedGradientTape / DistributedOptimizer
+# --------------------------------------------------------------------- #
+
+class _TapeWrapper:
+    """Wraps a tf.GradientTape: gradient() push_pulls every gradient
+    before returning it (reference: _DistributedGradientTape,
+    tensorflow/__init__.py:343-417 — same contract, delegation instead
+    of dynamic subclassing)."""
+
+    def __init__(self, tape, compression, sparse_as_dense: bool):
+        self._tape = tape
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        if size() <= 1:
+            return grads
+        flat = tf.nest.flatten(grads)
+        out = []
+        for i, g in enumerate(flat):
+            if g is None:
+                out.append(None)
+                continue
+            out.append(push_pull(
+                g, scope="tape", name=f"tfgrad/{i}",
+                compression=self._compression,
+                sparse_as_dense=self._sparse_as_dense))
+        return tf.nest.pack_sequence_as(grads, out)
+
+
+def DistributedGradientTape(gradtape, compression=Compression.none,
+                            sparse_as_dense: bool = False,
+                            device_dense: str = "", device_sparse: str = "",
+                            op=None):
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns
+    cross-worker-averaged gradients. ``device_*``/``op`` accepted for
+    reference signature compatibility (devices are meaningless on the
+    host-side wire; the reduction is always average)."""
+    del device_dense, device_sparse, op
+    return _TapeWrapper(gradtape, compression, sparse_as_dense)
+
+
+class _OptimizerWrapper:
+    """Wraps a keras optimizer: gradients are push_pulled before the
+    inner apply (reference: keras/__init__.py:40-64 wrap_optimizer).
+    Supports both the keras-3 ``apply(grads, vars)`` and the classic
+    ``apply_gradients(zip(grads, vars))`` entry points."""
+
+    def __init__(self, optimizer, compression, sparse_as_dense: bool):
+        # object.__setattr__: __setattr__ below forwards to the inner
+        # optimizer, which doesn't have these slots yet
+        object.__setattr__(self, "_bps_inner", optimizer)
+        object.__setattr__(self, "_bps_compression", compression)
+        object.__setattr__(self, "_bps_sparse_as_dense", sparse_as_dense)
+
+    def _reduce(self, grads: List) -> List:
+        if size() <= 1:
+            return list(grads)
+        out = []
+        for i, g in enumerate(grads):
+            if g is None:
+                out.append(None)
+                continue
+            out.append(push_pull(
+                g, scope="opt", name=f"tfopt/{i}",
+                compression=self._bps_compression,
+                sparse_as_dense=self._bps_sparse_as_dense))
+        return out
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        pairs = list(grads_and_vars)
+        grads = self._reduce([g for g, _ in pairs])
+        return self._bps_inner.apply_gradients(
+            [(g, v) for g, (_, v) in zip(grads, pairs)], *args, **kwargs)
+
+    def apply(self, grads, trainable_variables=None, *args, **kwargs):
+        grads = self._reduce(list(grads))
+        if trainable_variables is None:
+            return self._bps_inner.apply(grads, *args, **kwargs)
+        return self._bps_inner.apply(grads, trainable_variables,
+                                     *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_bps_inner"), item)
+
+    def __setattr__(self, item, value):
+        setattr(object.__getattribute__(self, "_bps_inner"), item, value)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False,
+                         device_dense: str = "", device_sparse: str = "",
+                         backward_passes_per_step: int = 1, op=None):
+    """Wrap a keras optimizer so every gradient is cross-worker-averaged
+    before the update (reference: tensorflow/__init__.py:282-341).
+    ``backward_passes_per_step>1`` is not supported, matching the
+    reference's keras branch."""
+    del name, device_dense, device_sparse, op
+    if backward_passes_per_step != 1:
+        raise ValueError("backward_passes_per_step > 1 is not supported "
+                         "with keras optimizers (reference parity)")
+    return _OptimizerWrapper(optimizer, compression, sparse_as_dense)
+
+
+# --------------------------------------------------------------------- #
+# keras callbacks (reference: tensorflow/keras/callbacks.py)
+# --------------------------------------------------------------------- #
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast model + optimizer variables from root at train begin so
+    every worker starts from identical state."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done or size() <= 1:
+            return
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and hasattr(opt, "variables"):
+            v = opt.variables
+            variables += list(v() if callable(v) else v)
+        broadcast_variables(variables, self.root_rank, scope="fit")
+        self._done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics across workers before they reach downstream
+    callbacks (checkpointing/early stopping must agree on the value)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or size() <= 1:
+            return
+        for k in sorted(logs):
+            val = np.asarray([logs[k]], np.float32)
+            out = _handles.wait_and_clear(
+                _submit(val, f"tfmetric/{k}", True, None).id)
+            logs[k] = float(np.asarray(out)[0])

@@ -1,0 +1,60 @@
+"""Adapter-level wire compression for the TensorFlow adapter.
+
+Mirror of the reference's byteps/tensorflow/compression.py: a Compressor
+casts the tensor before push_pull and restores it on the way back; fp16
+halves wire bytes on the DCN PS hop. (The codec stack in
+byteps_tpu.ops.compression is the heavy-weight path; this is the
+adapter-level convenience knob, numpy-typed because the adapter's
+transport is the numpy PS client.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(array: np.ndarray):
+        """Return (compressed_array, ctx) — ctx is whatever decompress
+        needs."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(array: np.ndarray, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(array):
+        return array, None
+
+    @staticmethod
+    def decompress(array, ctx):
+        return array
+
+
+class FP16Compressor(Compressor):
+    """fp32/fp64 -> fp16 for the wire, restored on the way back
+    (reference: tensorflow/compression.py)."""
+
+    @staticmethod
+    def compress(array):
+        if array.dtype in (np.float32, np.float64):
+            return array.astype(np.float16), array.dtype
+        return array, None
+
+    @staticmethod
+    def decompress(array, ctx):
+        if ctx is not None:
+            return array.astype(ctx)
+        return array
+
+
+class Compression:
+    """Selection surface matching the reference
+    (``compression=bps.Compression.fp16``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

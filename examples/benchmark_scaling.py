@@ -33,6 +33,11 @@ from byteps_tpu.utils.net import free_port  # noqa: E402
 
 _WORKER = r"""
 import os, time
+if os.environ.get("BM_CPU"):  # distinct-core pinning (multi-core hosts)
+    try:
+        os.sched_setaffinity(0, {int(os.environ["BM_CPU"])})
+    except OSError:
+        pass
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", int(os.environ["BM_DEVICES"]))
@@ -93,10 +98,20 @@ def run_config(n_workers: int, args) -> float:
                            stdout=subprocess.DEVNULL,
                            stderr=subprocess.STDOUT)
     time.sleep(0.5)
+    # enough cores to give every worker its own (server gets the spare
+    # capacity): pin each worker to a distinct core, so the efficiency
+    # ratio measures the PS, not core contention between the workers
+    try:
+        cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        cores = list(range(os.cpu_count() or 1))
+    pin = len(cores) >= n_workers + 1
     workers = []
     try:
         for i in range(n_workers):
             env = {**common, "DMLC_WORKER_ID": str(i)}
+            if pin:
+                env["BM_CPU"] = str(cores[i])
             env.pop("JAX_PLATFORMS", None)
             workers.append(subprocess.Popen(
                 [sys.executable, "-c", _WORKER], env=env, cwd=REPO,

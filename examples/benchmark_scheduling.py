@@ -1,0 +1,140 @@
+"""Priority-scheduling A/B: does the scheduler's credit + priority (and
+the server's push-count priority queues) buy measurable end-to-end
+throughput on the loopback PS?
+
+The reference claims 0-15% from scheduling (docs/best-practice.md:7),
+on an architecture where per-layer push_pulls complete independently and
+the NEXT forward can start as soon as the front-of-model tensors are
+back. This rebuild's synchronous PS step is two compiled phases
+(grad_fn -> push all -> apply_fn), so the apply waits for the LAST
+tensor either way — the honest expectation here is ~zero end-to-end
+win, with scheduling mattering for (a) bounding in-flight bytes under
+memory pressure and (b) tensor completion ORDER for latency-sensitive
+consumers (e.g. cross_barrier-style pipelining in the torch adapter).
+This harness measures exactly that, fc-heavy (VGG-style: a few large
+tensors dominating many small ones), over the config matrix
+
+    BYTEPS_SCHEDULING_CREDIT in {0 (off), 8MB}
+      x BYTEPS_SERVER_ENABLE_SCHEDULE in {0, 1}
+
+    python examples/benchmark_scheduling.py --steps 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from byteps_tpu.utils.net import free_port  # noqa: E402
+
+_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import optax
+import byteps_tpu as bps
+from byteps_tpu.core.state import get_state
+from byteps_tpu.jax.train import make_ps_train_step
+from byteps_tpu.models import mlp
+
+bps.init()
+state = get_state()
+# fc-heavy stack (VGG's profile: two huge fc tensors + a tail of small
+# ones): ~19M params = ~75MB of gradients per step
+cfg = mlp.MLPConfig(in_dim=4096, hidden=(2048, 2048, 2048), n_classes=1000)
+params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+tx = optax.sgd(0.01)
+opt = tx.init(params)
+rng = np.random.RandomState(0)
+B = 8
+batch = {"x": jnp.asarray(rng.rand(B, cfg.in_dim), jnp.float32),
+         "y": jnp.asarray(rng.randint(0, 1000, B), jnp.int32)}
+step = make_ps_train_step(lambda p, b: mlp.loss_fn(p, b, cfg), tx,
+                          state.mesh)
+steps = int(os.environ["BM_STEPS"])
+for _ in range(2):
+    params, opt, loss = step(params, opt, batch)
+float(loss)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt, loss = step(params, opt, batch)
+float(loss)
+dt = time.perf_counter() - t0
+print("BM_RESULT", steps / dt, flush=True)
+bps.shutdown()
+"""
+
+
+def run_config(credit: int, srv_schedule: int, steps: int) -> float:
+    """One A/B cell: loopback server + 1 worker; returns steps/sec."""
+    port = free_port()
+    common = {
+        **os.environ,
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+        "BYTEPS_SCHEDULING_CREDIT": str(credit),
+        "BYTEPS_SERVER_ENABLE_SCHEDULE": str(srv_schedule),
+        "BM_STEPS": str(steps),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    common.pop("XLA_FLAGS", None)
+    srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
+                           env={**common, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.STDOUT)
+    time.sleep(0.5)
+    env = {**common, "DMLC_WORKER_ID": "0"}
+    env.pop("JAX_PLATFORMS", None)
+    w = subprocess.Popen([sys.executable, "-c", _WORKER], env=env, cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+    try:
+        out, _ = w.communicate(timeout=600)
+        if w.returncode != 0:
+            raise SystemExit(f"worker failed (rc={w.returncode}):\n"
+                             f"{out[-3000:]}")
+        for line in out.splitlines():
+            if line.startswith("BM_RESULT"):
+                result = float(line.split()[1])
+        srv.wait(timeout=30)
+        return result
+    finally:
+        for p in (srv, w):
+            if p.poll() is None:
+                p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="best-of repeats per cell (1-core CI jitter)")
+    args = ap.parse_args()
+
+    cells = [(0, 0), (8 << 20, 0), (0, 1), (8 << 20, 1)]
+    print(f"{'credit':>10} {'srv_sched':>9} {'steps/s':>9}")
+    results = {}
+    for credit, srv in cells:
+        best = 0.0
+        for _ in range(args.repeats):
+            best = max(best, run_config(credit, srv, args.steps))
+        results[(credit, srv)] = best
+        print(f"{credit:>10} {srv:>9} {best:>9.3f}", flush=True)
+    base = results[(0, 0)]
+    for (credit, srv), v in results.items():
+        if (credit, srv) != (0, 0) and base > 0:
+            print(f"credit={credit} srv={srv}: "
+                  f"{100 * (v / base - 1):+.1f}% vs baseline")
+
+
+if __name__ == "__main__":
+    main()
