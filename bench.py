@@ -289,11 +289,14 @@ def phase_scaling(workers: int = 2, steps: int = 10) -> dict:
     hosts each worker is pinned to its own core).
 
     Interpretation keys, so the ratio is meaningful on ANY host: on a
-    host with fewer cores than workers the compute-bound cap is
+    host with fewer cores than workers the WORKER-compute-bound cap is
     cores/workers (1 core, 2 workers -> 0.5) regardless of how good the
-    PS is; ``scaling_vs_core_cap`` divides that contention out — it is
-    the share of the achievable throughput the PS actually delivered
-    (1.0 = the PS added no overhead beyond core contention)."""
+    PS is; ``scaling_vs_core_cap`` divides that cap out — the share of
+    the worker-compute ceiling actually delivered. The residual folds
+    together PS protocol cost AND server CPU contention (the server
+    process is not counted in the cap; on hosts with cores >= workers+1
+    the workers are pinned to their own cores and the residual is
+    protocol cost alone)."""
     _force_cpu()
     import importlib.util
 

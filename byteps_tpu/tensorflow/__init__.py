@@ -190,6 +190,12 @@ def push_pull(tensor, scope: str = "", average: bool = True,
     only) unless ``sparse_as_dense``; the result is a DENSE tensor
     either way. Works eagerly and inside ``tf.function`` (py_function
     boundary)."""
+    if isinstance(tensor, tf.IndexedSlices) and not tf.executing_eagerly():
+        # graph mode: indices/values are symbolic — densify and take the
+        # dense py_function path below. The row-sparse wire optimization
+        # is eager-only (the reference's device_sparse path is its own
+        # op kernel; here sparse_as_dense semantics apply in graphs).
+        tensor = tf.convert_to_tensor(tensor)
     if isinstance(tensor, tf.IndexedSlices):
         dense_shape = [int(d) for d in tensor.dense_shape]
         nm = name or _auto_name(f"tfsparse/{scope or 'g'}", tensor.values)
@@ -245,9 +251,17 @@ def broadcast_variables(variables: Iterable, root_rank: int = 0,
     before training so all workers start bit-identical."""
     if size() <= 1:
         return
+    # submit ALL rounds first, then wait+assign: N sequential round
+    # trips would serialize startup on sum-of-RTTs (the torch adapter's
+    # broadcast_parameters arrangement)
+    pending = []
     for i, var in enumerate(variables):
-        nm = f"tfbcast/{scope or 'v'}/{i}"
-        var.assign(broadcast(var.value(), root_rank, name=nm))
+        host = _to_numpy(var.value())
+        contrib = host if rank() == root_rank else np.zeros_like(host)
+        h = _submit(contrib, f"tfbcast/{scope or 'v'}/{i}", False, None)
+        pending.append((var, h, host.shape))
+    for var, h, shape in pending:
+        var.assign(_handles.wait_and_clear(h.id).reshape(shape))
 
 
 # --------------------------------------------------------------------- #
@@ -304,51 +318,19 @@ def DistributedGradientTape(gradtape, compression=Compression.none,
     return _TapeWrapper(gradtape, compression, sparse_as_dense)
 
 
-class _OptimizerWrapper:
-    """Wraps a keras optimizer: gradients are push_pulled before the
-    inner apply (reference: keras/__init__.py:40-64 wrap_optimizer).
-    Supports both the keras-3 ``apply(grads, vars)`` and the classic
-    ``apply_gradients(zip(grads, vars))`` entry points."""
-
-    def __init__(self, optimizer, compression, sparse_as_dense: bool):
-        # object.__setattr__: __setattr__ below forwards to the inner
-        # optimizer, which doesn't have these slots yet
-        object.__setattr__(self, "_bps_inner", optimizer)
-        object.__setattr__(self, "_bps_compression", compression)
-        object.__setattr__(self, "_bps_sparse_as_dense", sparse_as_dense)
-
-    def _reduce(self, grads: List) -> List:
-        if size() <= 1:
-            return list(grads)
-        out = []
-        for i, g in enumerate(grads):
-            if g is None:
-                out.append(None)
-                continue
-            out.append(push_pull(
-                g, scope="opt", name=f"tfopt/{i}",
-                compression=self._bps_compression,
-                sparse_as_dense=self._bps_sparse_as_dense))
-        return out
-
-    def apply_gradients(self, grads_and_vars, *args, **kwargs):
-        pairs = list(grads_and_vars)
-        grads = self._reduce([g for g, _ in pairs])
-        return self._bps_inner.apply_gradients(
-            [(g, v) for g, (_, v) in zip(grads, pairs)], *args, **kwargs)
-
-    def apply(self, grads, trainable_variables=None, *args, **kwargs):
-        grads = self._reduce(list(grads))
-        if trainable_variables is None:
-            return self._bps_inner.apply(grads, *args, **kwargs)
-        return self._bps_inner.apply(grads, trainable_variables,
-                                     *args, **kwargs)
-
-    def __getattr__(self, item):
-        return getattr(object.__getattribute__(self, "_bps_inner"), item)
-
-    def __setattr__(self, item, value):
-        setattr(object.__getattribute__(self, "_bps_inner"), item, value)
+def _reduce_grads(grads: List, compression, sparse_as_dense: bool) -> List:
+    """push_pull every non-None gradient under stable position names."""
+    if size() <= 1:
+        return list(grads)
+    out = []
+    for i, g in enumerate(grads):
+        if g is None:
+            out.append(None)
+            continue
+        out.append(push_pull(g, scope="opt", name=f"tfopt/{i}",
+                             compression=compression,
+                             sparse_as_dense=sparse_as_dense))
+    return out
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
@@ -356,15 +338,49 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          sparse_as_dense: bool = False,
                          device_dense: str = "", device_sparse: str = "",
                          backward_passes_per_step: int = 1, op=None):
-    """Wrap a keras optimizer so every gradient is cross-worker-averaged
-    before the update (reference: tensorflow/__init__.py:282-341).
-    ``backward_passes_per_step>1`` is not supported, matching the
-    reference's keras branch."""
+    """A REAL keras optimizer (dynamic subclass of the given optimizer's
+    class, recreated via from_config — the reference's wrap_optimizer
+    arrangement, keras/__init__.py:40-64) whose gradient application
+    cross-worker-averages first. Being an actual Optimizer subclass, it
+    passes ``model.compile(optimizer=...)`` type validation.
+
+    Keras 3 routes ``apply_gradients`` through ``apply``, so only
+    ``apply`` is overridden there (overriding both would reduce twice);
+    optimizers predating ``apply`` get ``apply_gradients`` overridden
+    instead. ``backward_passes_per_step>1`` is not supported, matching
+    the reference's keras branch."""
     del name, device_dense, device_sparse, op
     if backward_passes_per_step != 1:
         raise ValueError("backward_passes_per_step > 1 is not supported "
                          "with keras optimizers (reference parity)")
-    return _OptimizerWrapper(optimizer, compression, sparse_as_dense)
+    base = type(optimizer)
+
+    if hasattr(base, "apply"):
+        def _apply(self, grads, trainable_variables=None, **kwargs):
+            grads = _reduce_grads(list(grads), self._bps_compression,
+                                  self._bps_sparse_as_dense)
+            if trainable_variables is None:
+                return base.apply(self, grads, **kwargs)
+            return base.apply(self, grads, trainable_variables, **kwargs)
+
+        overrides = {"apply": _apply}
+    else:
+        def _apply_gradients(self, grads_and_vars, *args, **kwargs):
+            pairs = list(grads_and_vars)
+            grads = _reduce_grads([g for g, _ in pairs],
+                                  self._bps_compression,
+                                  self._bps_sparse_as_dense)
+            return base.apply_gradients(
+                self, [(g, v) for g, (_, v) in zip(grads, pairs)],
+                *args, **kwargs)
+
+        overrides = {"apply_gradients": _apply_gradients}
+
+    cls = type("Distributed" + base.__name__, (base,), overrides)
+    new = cls.from_config(optimizer.get_config())
+    new._bps_compression = compression
+    new._bps_sparse_as_dense = sparse_as_dense
+    return new
 
 
 # --------------------------------------------------------------------- #

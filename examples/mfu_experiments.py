@@ -103,3 +103,31 @@ for nc in (4, 8):
 tok2 = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (8, 2049)), jnp.int32)
 bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
            "baseline B=8 S=2048", B=8, S=2048, tokens=tok2)
+
+# -- 3. remat off (125M activations fit HBM at B=16/S=1024) --------------- #
+import dataclasses
+cfg_noremat = dataclasses.replace(cfg, remat=False)
+bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg_noremat),
+           "remat OFF")
+
+# -- 4. long context S=4096: dense vs blockwise vs Pallas flash ----------- #
+from byteps_tpu.ops.flash_attention import make_flash_attn
+tok4 = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (4, 4097)),
+                   jnp.int32)
+bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
+           "dense B=4 S=4096", B=4, S=4096, tokens=tok4)
+bench_loss(lambda q, t: llama.loss_fn(
+               q, {"tokens": t}, cfg,
+               attn_impl=make_flash_attn(pallas=False)),
+           "blockwise B=4 S=4096", B=4, S=4096, tokens=tok4)
+bench_loss(lambda q, t: llama.loss_fn(
+               q, {"tokens": t}, cfg, attn_impl=make_flash_attn()),
+           "pallas-flash B=4 S=4096", B=4, S=4096, tokens=tok4)
+# S=8192: the regime where the S^2 term dominates outright
+tok8 = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (2, 8193)),
+                   jnp.int32)
+bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
+           "dense B=2 S=8192", B=2, S=8192, tokens=tok8)
+bench_loss(lambda q, t: llama.loss_fn(
+               q, {"tokens": t}, cfg, attn_impl=make_flash_attn()),
+           "pallas-flash B=2 S=8192", B=2, S=8192, tokens=tok8)

@@ -248,3 +248,40 @@ def test_two_worker_tf_push_pull(monkeypatch):
         for p in [srv, *workers]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_distributed_optimizer_is_real_keras_optimizer(bptf_ps):
+    """model.compile must accept it (keras type-validates): the wrapper
+    is a dynamic subclass of the wrapped optimizer's class."""
+    opt = bptf_ps.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    assert isinstance(opt, tf.keras.optimizers.Optimizer)
+    model = _toy_model()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True)
+    model.compile(optimizer=opt, loss="mse")
+    hist = model.fit(x, y, epochs=3, verbose=0, batch_size=32)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_indexed_slices_inside_tf_function(bptf_ps):
+    """Embedding gradients (IndexedSlices) inside a tf.function train
+    step: symbolic slices densify onto the py_function path instead of
+    crashing on graph-tensor iteration."""
+    tf.keras.utils.set_random_seed(0)
+    emb = tf.keras.layers.Embedding(16, 4)
+    opt = tf.keras.optimizers.SGD(0.1)
+    ids = tf.constant([[1, 5, 1, 7]])
+
+    @tf.function
+    def step():
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(tf.square(emb(ids)))
+        dtape = bptf_ps.DistributedGradientTape(tape)
+        grads = dtape.gradient(loss, emb.trainable_variables)
+        opt.apply_gradients(zip(grads, emb.trainable_variables))
+        return loss
+
+    l0 = float(step())
+    l1 = float(step())
+    assert l1 < l0
