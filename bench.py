@@ -43,12 +43,12 @@ group with a hard deadline:
   children force the CPU platform as the first jax call — so their
   numbers land no matter what the tunnel does.
 - ``train`` is gated on a cheap device ``probe`` each attempt and tried
-  up to three times in fresh processes (tunnel wedges are per-process):
-  once up front when the tunnel is healthy, once after the CPU phases
-  (which buy it minutes to recover), and once more after a short sleep.
-  If every attempt dies, its keys are emitted as ``null`` instead of
-  discarding the round. Worst-case wall clock is bounded
-  (~3x(120+440)s + 420 + 700 + 45 ≈ 35 min; healthy ~8 min).
+  twice in fresh processes (tunnel wedges are per-process): once up
+  front when the tunnel is healthy, once after the CPU phases (which
+  buy it minutes to recover). If both attempts die, its keys are
+  emitted as ``null`` instead of discarding the round. Worst-case wall
+  clock is bounded (~2x(120+440) + 420 + 700 ≈ 38 min pathological,
+  ~17 min on a wedged tunnel, ~8 min healthy).
 
 Tuning applied vs the anchor: bf16 activations/logits, logsumexp-form
 cross entropy (llama.next_token_xent), B=16 batch (MXU utilization),
@@ -445,9 +445,8 @@ def main() -> None:
             errors[name] = err
 
     if not trained:
-        trained = try_train()
-    if not trained:
-        time.sleep(45.0)
+        # one retry (fresh processes; tunnel wedges are per-process, and
+        # the CPU phases above bought it minutes to recover)
         trained = try_train()
 
     if result["value"] is not None:

@@ -52,3 +52,9 @@ def test_train_mnist_runs():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "accuracy" in r.stdout.lower() or "loss" in r.stdout.lower(), \
         r.stdout[-500:]
+
+
+def test_tf_train_runs():
+    r = _run_example("tf_train.py", [])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "final loss" in r.stdout
