@@ -1,0 +1,78 @@
+"""MirroredStrategy cross-device-ops reroute.
+
+The reference forks MirroredStrategy so its cross-device reduction runs
+over byteps instead of NCCL all-reduce
+(byteps/tensorflow/distribute/cross_device_ops.py:585-627). Here the
+same effect needs no strategy fork: ``BytePSCrossDeviceOps`` subclasses
+TF's ``ReductionToOneDevice`` — the LOCAL (intra-worker, cross-logical-
+device) reduction stays TF's own — and splices the CROSS-WORKER hop
+through the shared PS ``push_pull`` on the locally-reduced tensor,
+rebuilding the mirrored per-device copies afterwards. Pass it straight
+to the stock strategy:
+
+    strategy = tf.distribute.MirroredStrategy(
+        cross_device_ops=byteps_tpu.tensorflow.distribute
+            .BytePSCrossDeviceOps())
+
+Semantics: ReduceOp.SUM -> cross-worker sum of local sums (the global
+sum); ReduceOp.MEAN -> cross-worker average of local means, which is
+the global mean when every worker runs the same local replica count
+(MirroredStrategy's own assumption). Without a PS configured the op
+degrades to plain ReductionToOneDevice (single-worker identity).
+
+Uses two ``tensorflow.python.distribute`` internals
+(``cross_device_ops.ReductionToOneDevice``, ``values.Mirrored``) —
+import-guarded; the adapter's public surface works without them.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+from tensorflow.python.distribute import cross_device_ops as _cdo
+from tensorflow.python.distribute import values as _values
+
+from . import push_pull
+from ..core.state import get_state
+
+__all__ = ["BytePSCrossDeviceOps"]
+
+
+class BytePSCrossDeviceOps(_cdo.ReductionToOneDevice):
+    """ReductionToOneDevice locally, PS push_pull across workers."""
+
+    def _cross_worker(self, reduce_op, mirrored, name: str):
+        state = get_state()
+        if not state.initialized or state.ps_client is None:
+            return mirrored  # single worker / no PS: local result is it
+        vals = getattr(mirrored, "values", None)
+        if vals is None:
+            vals = (mirrored,)
+        average = reduce_op == tf.distribute.ReduceOp.MEAN
+        agg = push_pull(vals[0], scope="mirrored", name=name,
+                        average=average)
+        out = []
+        for v in vals:
+            with tf.device(v.device):
+                out.append(tf.identity(agg))
+        if len(out) == 1 and not isinstance(mirrored,
+                                            _values.DistributedValues):
+            return out[0]
+        return _values.Mirrored(out)
+
+    def reduce_implementation(self, reduce_op, per_replica_value,
+                              destinations, options):
+        local = super().reduce_implementation(
+            reduce_op, per_replica_value, destinations, options)
+        shape = "x".join(str(d) for d in getattr(
+            per_replica_value, "shape", ()) or ())
+        return self._cross_worker(reduce_op, local,
+                                  f"mirrored/r.{shape or 'scalar'}")
+
+    def batch_reduce_implementation(self, reduce_op,
+                                    value_destination_pairs, options):
+        local = super().batch_reduce_implementation(
+            reduce_op, value_destination_pairs, options)
+        # positional names: a train step batch-reduces its gradients in
+        # a stable order, which keys the PS registry across steps
+        return [self._cross_worker(reduce_op, m, f"mirrored/b.{i}")
+                for i, m in enumerate(local)]

@@ -10,6 +10,17 @@ import pytest
 
 tf = pytest.importorskip("tensorflow")
 
+# 2 logical CPU devices for the MirroredStrategy test — must be set at
+# import (collection) time, before ANY test in this process runs a TF op
+# and freezes the device topology
+try:
+    tf.config.set_logical_device_configuration(
+        tf.config.list_physical_devices("CPU")[0],
+        [tf.config.LogicalDeviceConfiguration(),
+         tf.config.LogicalDeviceConfiguration()])
+except RuntimeError:
+    pass
+
 from byteps_tpu.config import Config  # noqa: E402
 from byteps_tpu.server import run_server  # noqa: E402
 
@@ -285,3 +296,56 @@ def test_indexed_slices_inside_tf_function(bptf_ps):
     l0 = float(step())
     l1 = float(step())
     assert l1 < l0
+
+
+def test_mirrored_strategy_cross_device_ops(bptf_ps):
+    """MirroredStrategy over 2 logical CPU devices with the PS-backed
+    cross-device ops: local (cross-replica) reduction is TF's own, the
+    cross-worker hop rides push_pull through the real loopback server
+    (identity at 1 worker), and training converges under strategy.run.
+    Reference: tensorflow/distribute/cross_device_ops.py:585-627."""
+    from byteps_tpu.tensorflow.distribute import BytePSCrossDeviceOps
+
+    devices = [d.name for d in tf.config.list_logical_devices("CPU")][:2]
+    assert len(devices) == 2
+    strat = tf.distribute.MirroredStrategy(
+        devices=devices, cross_device_ops=BytePSCrossDeviceOps())
+    assert strat.num_replicas_in_sync == 2
+
+    # direct reduce: SUM across replicas, through the PS hop
+    def value_fn(ctx):
+        return tf.constant(float(ctx.replica_id_in_sync_group + 1))
+
+    per_replica = strat.experimental_distribute_values_from_function(
+        value_fn)
+    total = strat.reduce(tf.distribute.ReduceOp.SUM, per_replica,
+                         axis=None)
+    assert float(total) == pytest.approx(3.0)
+
+    # end to end: gradients batch-reduce through the ops inside a step
+    with strat.scope():
+        tf.keras.utils.set_random_seed(0)
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        opt = tf.keras.optimizers.SGD(0.1)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    ds = strat.experimental_distribute_dataset(
+        tf.data.Dataset.from_tensor_slices((x, y)).batch(16))
+
+    def step(inp, tgt):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(model(inp) - tgt))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    losses = []
+    for _ in range(15):
+        for batch in ds:
+            per_replica_loss = strat.run(step, args=batch)
+            losses.append(float(strat.reduce(
+                tf.distribute.ReduceOp.MEAN, per_replica_loss,
+                axis=None)))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
