@@ -50,7 +50,7 @@ __all__ = [
     "rank", "size", "local_rank", "local_size",
     "push_pull", "push_pull_async", "poll", "synchronize",
     "broadcast", "broadcast_variables",
-    "DistributedGradientTape", "DistributedOptimizer",
+    "DistributedGradientTape", "DistributedOptimizer", "load_model",
     "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
     "Compression",
 ]
@@ -381,6 +381,23 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     new._bps_compression = compression
     new._bps_sparse_as_dense = sparse_as_dense
     return new
+
+
+def load_model(filepath, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved keras model and re-wrap its optimizer as a
+    ``DistributedOptimizer`` (reference: keras/__init__.py:102-133
+    ``load_model`` re-wrapping on deserialize). The wrap recreates the
+    optimizer via from_config, so resumed SLOT state starts fresh —
+    broadcast variables after loading, as the callbacks do."""
+    model = tf.keras.models.load_model(filepath,
+                                       custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        wrapped = DistributedOptimizer(opt, compression=compression)
+        loss = getattr(model, "loss", None)
+        model.compile(optimizer=wrapped, loss=loss)
+    return model
 
 
 # --------------------------------------------------------------------- #

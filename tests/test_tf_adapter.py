@@ -349,3 +349,22 @@ def test_mirrored_strategy_cross_device_ops(bptf_ps):
                 tf.distribute.ReduceOp.MEAN, per_replica_loss,
                 axis=None)))
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_load_model_rewraps_optimizer(bptf_ps, tmp_path):
+    """bps.load_model: a saved keras model comes back with its optimizer
+    wrapped as a DistributedOptimizer and keeps training."""
+    model = _toy_model()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True)
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+    model.fit(x, y, epochs=1, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+
+    loaded = bptf_ps.load_model(path)
+    assert type(loaded.optimizer).__name__.startswith("Distributed")
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.Optimizer)
+    hist = loaded.fit(x, y, epochs=2, verbose=0)
+    assert hist.history["loss"][-1] <= hist.history["loss"][0]
