@@ -141,6 +141,8 @@ def model_flops_per_token(cfg, S: int) -> float:
 
 def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     jax = _setup_device_backend()
+    import dataclasses
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -151,34 +153,58 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     # when running on different hardware (v5p: 459e12, v4: 275e12).
     peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
+    tokens = None
+
+    def measure_cfg(cfg) -> float:
+        nonlocal tokens
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        # bf16 first moment: halves adam's m-state HBM traffic; v stays
+        # f32 (variance needs the range); ~+1% step time on v5e
+        tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
+        opt = tx.init(params)
+        if tokens is None:
+            tokens = jnp.asarray(
+                np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                                 (B, S + 1)), jnp.int32)
+
+        def step(p, o, t):
+            loss, g = jax.value_and_grad(
+                lambda p_: llama.loss_fn(p_, {"tokens": t}, cfg))(p)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
+
+        stepj = jax.jit(step, donate_argnums=(0, 1))
+        for _ in range(3):
+            params, opt, loss = stepj(params, opt, tokens)
+        float(loss)  # host readback: the only reliable sync here
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt, loss = stepj(params, opt, tokens)
+        float(loss)
+        return B * S * steps / (time.perf_counter() - t0)
+
     cfg = llama.LlamaConfig.small(vocab_size=32000)
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    # bf16 first moment: halves adam's m-state HBM traffic; v is kept f32
-    # (variance needs the range), measured ~+1% step time on v5e
-    tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
-    opt = tx.init(params)
-    tokens = jnp.asarray(
-        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),
-        jnp.int32)
-
-    def step(p, o, t):
-        loss, g = jax.value_and_grad(
-            lambda p_: llama.loss_fn(p_, {"tokens": t}, cfg))(p)
-        u, o = tx.update(g, o, p)
-        return optax.apply_updates(p, u), o, loss
-
-    stepj = jax.jit(step, donate_argnums=(0, 1))
-    for _ in range(3):
-        params, opt, loss = stepj(params, opt, tokens)
-    float(loss)  # host readback: the only reliable sync on this platform
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, loss = stepj(params, opt, tokens)
-    float(loss)
-    dt = time.perf_counter() - t0
-    tps = B * S * steps / dt
+    variants = {"remat": cfg,
+                # 125M at B=16/S=1024: saved activations (~a few GB) fit
+                # v5e HBM, buying back the remat recompute FLOPs
+                "noremat": dataclasses.replace(cfg, remat=False)}
+    results = {}
+    for name, c in variants.items():
+        try:
+            results[name] = measure_cfg(c)
+        except Exception as e:  # noqa: BLE001 - e.g. OOM on other chips
+            sys.stderr.write(f"[bench] train variant {name!r} failed: "
+                             f"{e}\n")
+    if not results:
+        raise RuntimeError("all train variants failed")
+    best = max(results, key=results.get)
+    tps = results[best]
     mfu = tps * model_flops_per_token(cfg, S) / peak_flops
-    return {"value": round(tps, 1), "mfu": round(mfu, 4)}
+    out = {"value": round(tps, 1), "mfu": round(mfu, 4),
+           "train_variant": best}
+    for name, v in results.items():
+        out[f"tokens_per_sec_{name}"] = round(v, 1)
+    return out
 
 
 def phase_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
