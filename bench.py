@@ -307,6 +307,68 @@ def phase_pushpull(total_bytes: int = 256 << 20, n_tensors: int = 16,
         server.join(timeout=20)
 
 
+def phase_pushpull_tpu(total_bytes: int = 256 << 20, n_tensors: int = 16,
+                       steps: int = 3) -> dict:
+    """The PS-worker-on-a-TPU-host measurement the CPU-forced phase
+    cannot make: gradients START on the accelerator, the device tier
+    compresses ON CHIP, and the D2H hop into the loopback server moves
+    wire-sized bytes (SURVEY §7's stage list). Effective GB/s counted in
+    dense-equivalent bytes, like the CPU phase. Only attempted after a
+    successful device probe; a wedge here costs its own subprocess, not
+    the round."""
+    import threading
+
+    jax = _setup_device_backend()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byteps_tpu.config import Config
+    from byteps_tpu.core.state import GlobalState
+    from byteps_tpu.jax.device_compression import DeviceCompressor
+    from byteps_tpu.server import run_server
+    from byteps_tpu.utils.net import free_port
+
+    port = free_port()
+    os.environ.update({
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    server = threading.Thread(
+        target=run_server, args=(port, Config(num_workers=1, num_servers=1)),
+        daemon=True)
+    server.start()
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        per = total_bytes // n_tensors // 4
+        rng = np.random.RandomState(0)
+        grads = [jnp.asarray(rng.randn(per).astype(np.float32))
+                 for i in range(n_tensors)]
+        jax.block_until_ready(grads)
+        nbytes = total_bytes
+        state = bps.core.state.get_state()
+        dc = DeviceCompressor(state.ps_client, 1, {"compressor": "onebit"})
+        names = [f"tbench_{i}" for i in range(n_tensors)]
+
+        def dev_round():
+            out = dc.push_pull_leaves(state, names, grads, average=False)
+            np.asarray(out[0][:1])  # host sync
+
+        dev_round()  # warmup: jit compiles + server install
+        best = float("inf")
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            dev_round()
+            best = min(best, time.perf_counter() - t0)
+        return {"pushpull_onebit_tpu_gbps": round(nbytes * 2 / best / 1e9,
+                                                  3)}
+    finally:
+        bps.shutdown()
+        server.join(timeout=20)
+
+
 def phase_scaling(workers: int = 2, steps: int = 10) -> dict:
     """Scaling efficiency tn/(n*t1) across REAL worker OS processes
     through the loopback PS (the reference's headline metric shape,
@@ -350,6 +412,7 @@ _PHASES = {
     "probe": phase_probe,
     "train": phase_train,
     "pushpull": phase_pushpull,
+    "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
 }
 
@@ -474,6 +537,15 @@ def main() -> None:
         # one retry (fresh processes; tunnel wedges are per-process, and
         # the CPU phases above bought it minutes to recover)
         trained = try_train()
+    if trained:
+        # optional device-tier wire measurement (grads start on chip,
+        # compress on chip, D2H moves wire bytes) — gated on a live
+        # tunnel; its failure must not cost anything else
+        r, err = _run_phase("pushpull_tpu", 360.0)
+        if r:
+            result.update(r)
+        else:
+            errors["pushpull_tpu"] = err
 
     if result["value"] is not None:
         result["vs_baseline"] = round(result["value"]
