@@ -44,8 +44,8 @@ def _dense_causal(q, k, v, causal: bool):
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      axis: str = SP_AXIS,
-                      causal: bool = True) -> jnp.ndarray:
+                      axis: str = SP_AXIS, causal: bool = True,
+                      local_attn=None) -> jnp.ndarray:
     """Exact attention with the sequence sharded over ``axis`` via head
     re-sharding. q [B, S_local, H, D], k/v [B, S_local, Hkv, D] with
     Hkv | H; H must be divisible by the axis size. Must run inside
@@ -77,16 +77,32 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     q = seq_to_heads(q)
     k = seq_to_heads(k)
     v = seq_to_heads(v)
-    o = _dense_causal(q, k, v, causal)
+    if local_attn is None:
+        o = _dense_causal(q, k, v, causal)
+    else:
+        # the per-device problem is ordinary attention over the FULL
+        # sequence for a head subset — exactly where flash/blockwise
+        # pays at long S (ops/flash_attention.py); any attn_impl-shaped
+        # callable works
+        o = local_attn(q, k, v)
     # [B, S, H/P, D] -> [B, S/P, H, D]
     return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 
 
-def make_ulysses_attn(axis: str = SP_AXIS, causal: bool = True):
-    """Bind ulysses_attention as a models.llama ``attn_impl``."""
+def make_ulysses_attn(axis: str = SP_AXIS, causal: bool = True,
+                      flash: bool = False):
+    """Bind ulysses_attention as a models.llama ``attn_impl``.
+    ``flash=True`` runs the post-all-to-all local attention through
+    ops.flash_attention (O(S*block) residency over the full gathered
+    sequence — the long-context composition)."""
+    local = None
+    if flash:
+        from ..ops.flash_attention import make_flash_attn
+        local = make_flash_attn(causal=causal)
 
     def impl(q, k, v):
-        return ulysses_attention(q, k, v, axis=axis, causal=causal)
+        return ulysses_attention(q, k, v, axis=axis, causal=causal,
+                                 local_attn=local)
 
     return impl

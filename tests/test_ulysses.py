@@ -117,3 +117,32 @@ def test_llama_ulysses_trains(bps):
         params, opt, loss = stepj(params, opt, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_ulysses_flash_local_attention(bps):
+    """Ulysses with the flash/blockwise local attention (the long-
+    context composition): exact match vs the dense local path."""
+    import functools
+
+    mesh = get_state().mesh
+    B, S, H, D = 2, 64, 8, 16
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    from byteps_tpu.ops.flash_attention import make_flash_attn
+
+    def run(local):
+        f = jax.shard_map(
+            functools.partial(ulysses_attention, axis="dp", causal=True,
+                              local_attn=local),
+            mesh=mesh, in_specs=(P(None, "dp"),) * 3,
+            out_specs=P(None, "dp"), check_vma=False)
+        return jax.jit(f)(q, k, v)
+
+    with jax.default_matmul_precision("float32"):
+        dense = run(None)
+        flash = run(make_flash_attn(block_q=16, block_k=16))
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
