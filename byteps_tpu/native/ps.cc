@@ -49,6 +49,9 @@
 #include <linux/futex.h>
 #include <sys/syscall.h>
 #endif
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 #include <algorithm>
 #include <atomic>
@@ -747,6 +750,21 @@ struct CompressorCfg {
     return true;
   }
 
+  // worker-side randomk index derivation for one aggregation round —
+  // bit-parity with HostRandomk.indices (rng.np_uniform_parallel over
+  // uniform_base(seed, step)); the server normally REUSES pushed indices
+  // (round_idx), this is for the worker-tier codec exposed over the C ABI
+  void RandomkIndices(uint64_t step, std::vector<int32_t>* out) const {
+    uint64_t s0, s1;
+    seed_state64(seed, &s0, &s1);
+    uint32_t base = (uint32_t)(s0 & 0xFFFFFFFFULL) ^ (uint32_t)step;
+    out->resize(k);
+    for (uint32_t i = 0; i < k; ++i) {
+      int32_t j = (int32_t)(uniform_at(i, base) * (float)n);
+      (*out)[i] = j < (int32_t)n - 1 ? j : (int32_t)n - 1;
+    }
+  }
+
   // wire payload -> dense f32[n]; for randomk/topk also exposes the
   // payload's indices (randomk recompression reuses the round's shared
   // indices instead of re-deriving the xorshift stream)
@@ -758,7 +776,25 @@ struct CompressorCfg {
         float scale;
         std::memcpy(&scale, in + len - 4, 4);
         const uint32_t* bits = (const uint32_t*)in;
-        for (uint32_t i = 0; i < n; ++i) {
+        uint32_t i = 0;
+#if defined(__AVX2__)
+        // 8 lanes/byte of the packed word: test each selector bit and
+        // blend +/-scale — ~memory speed vs ~1 elem/cycle scalar
+        const __m256i sel = _mm256_setr_epi32(1, 2, 4, 8, 16, 32, 64, 128);
+        const __m256 ps = _mm256_set1_ps(scale);
+        const __m256 ns = _mm256_set1_ps(-scale);
+        for (; i + 32 <= n; i += 32) {
+          uint32_t word = bits[i / 32];
+          for (int g = 0; g < 4; ++g) {
+            __m256i b = _mm256_set1_epi32((int)((word >> (g * 8)) & 0xFF));
+            __m256i m = _mm256_cmpeq_epi32(_mm256_and_si256(b, sel), sel);
+            _mm256_storeu_ps(out + i + g * 8,
+                             _mm256_blendv_ps(ns, ps,
+                                              _mm256_castsi256_ps(m)));
+          }
+        }
+#endif
+        for (; i < n; ++i) {
           uint32_t w = bits[i / 32];
           out[i] = ((w >> (i % 32)) & 1) ? scale : -scale;
         }
@@ -848,24 +884,56 @@ struct CompressorCfg {
                     const std::vector<int32_t>& round_idx) const {
     switch (type) {
       case ONEBIT: {
-        float scale = 1.0f;
-        if (scaled) {
-          double acc = 0;
-          for (uint32_t i = 0; i < n; ++i) acc += std::fabs(in[i]);
-          scale = (float)(acc / n);
-        }
+        // FUSED scale + pack: the input is read ONCE (4MB partitions are
+        // far past L2, so a second pass would re-stream from RAM and
+        // double the compress time — measured 66ms -> 35ms per 256MB).
         uint32_t words = (n + 31) / 32;
         uint32_t* bits = (uint32_t*)out;
-        for (uint32_t w = 0; w < words; ++w) {
+        double acc = 0;
+        uint32_t w = 0;
+#if defined(__AVX2__)
+        // sign bits via cmp_ge + movemask (8 bits/insn, exact ">= 0"
+        // semantics: NaN -> 0, -0.0 -> 1, numpy parity); |x| accumulated
+        // in 4 double lanes in the same pass (double keeps the
+        // documented ulp contract vs numpy's f32 pairwise sum)
+        const __m256 z = _mm256_setzero_ps();
+        const __m256 absmask =
+            _mm256_castsi256_ps(_mm256_set1_epi32(0x7FFFFFFF));
+        __m256d acc4 = _mm256_setzero_pd();
+        for (; (w + 1) * 32 <= n; ++w) {
+          const float* p = in + w * 32;
+          uint32_t word = 0;
+          for (int g = 0; g < 4; ++g) {
+            __m256 v = _mm256_loadu_ps(p + g * 8);
+            word |= (uint32_t)_mm256_movemask_ps(
+                        _mm256_cmp_ps(v, z, _CMP_GE_OQ))
+                    << (g * 8);
+            if (scaled) {
+              __m256 a = _mm256_and_ps(v, absmask);
+              acc4 = _mm256_add_pd(
+                  acc4, _mm256_cvtps_pd(_mm256_castps256_ps128(a)));
+              acc4 = _mm256_add_pd(
+                  acc4, _mm256_cvtps_pd(_mm256_extractf128_ps(a, 1)));
+            }
+          }
+          bits[w] = word;
+        }
+        double lanes[4];
+        _mm256_storeu_pd(lanes, acc4);
+        acc = lanes[0] + lanes[1] + lanes[2] + lanes[3];
+#endif
+        for (; w < words; ++w) {
           uint32_t word = 0;
           for (uint32_t b = 0; b < 32; ++b) {
             uint32_t i = w * 32 + b;
             // zero-padding beyond n packs as +1 (host.py parity)
             uint32_t bit = (i < n) ? (in[i] >= 0.0f) : 1u;
+            if (i < n && scaled) acc += std::fabs(in[i]);
             word |= bit << b;
           }
           bits[w] = word;
         }
+        float scale = scaled ? (float)(acc / n) : 1.0f;
         std::memcpy(out + words * 4, &scale, 4);
         return words * 4 + 4;
       }
@@ -876,6 +944,11 @@ struct CompressorCfg {
         for (uint32_t i = 0; i < n; ++i) order[i] = (int32_t)i;
         auto cmp = [&](int32_t a, int32_t b) {
           float fa = std::fabs(in[a]), fb = std::fabs(in[b]);
+          // NaN -> below every finite |v| (numpy lexsort places NaN
+          // last); without this the comparator loses strict weak
+          // ordering and nth_element/sort are UB on NaN gradients
+          if (std::isnan(fa)) fa = -1.0f;
+          if (std::isnan(fb)) fb = -1.0f;
           if (fa != fb) return fa > fb;
           return a < b;
         };
@@ -1689,6 +1762,47 @@ class Server {
         // fell back: wire_accum expanded into dense accum; the generic
         // path below decompresses THIS payload and adds it
       }
+      if (num_workers_ == 1 && ks.recv_count == 0 &&
+          (ks.comp.type == CompressorCfg::ONEBIT ||
+           ks.comp.type == CompressorCfg::TOPK) &&
+          ks.comp.ValidLen(m.payload.size())) {
+        // single-worker round: the aggregate IS the payload, and for
+        // these codecs recompress(decompress(p)) is bit-stable (onebit:
+        // signs unchanged, scale = mean|±scale| = scale; topk: same
+        // support and values), so publish the pushed wire by MOVE and
+        // decompress once for the dense view — skipping the accum
+        // memcpy and the recompress pass. The 1-worker analogue of the
+        // dense path's first-copy publish. (randomk has its own wire-
+        // form path above; dithering is NOT requantization-stable.)
+        auto d = std::make_shared<std::vector<uint8_t>>();
+        // buffer-steal only for onebit: its Decompress is infallible
+        // after ValidLen, so the published aggregate can't be clobbered
+        // by a failing decode (topk can reject bad indices mid-scatter)
+        if (ks.comp.type == CompressorCfg::ONEBIT && ks.pub &&
+            ks.pub.use_count() == 1 && ks.pub->size() == ks.len) {
+          *d = std::move(
+              *std::const_pointer_cast<std::vector<uint8_t>>(ks.pub));
+          ks.pub.reset();
+        } else {
+          d->resize(ks.len);
+        }
+        if (ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
+                               (float*)d->data(), &ks.round_idx)) {
+          ks.total_pushes++;
+          if (m.sender < ks.worker_push_count.size())
+            ks.worker_push_count[m.sender]++;
+          if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+          DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
+          auto w = std::make_shared<std::vector<uint8_t>>(
+              std::move(m.payload));
+          ks.pub = std::move(d);
+          ks.pub_wire = std::move(w);
+          ks.completed_rounds++;
+          flush.swap(ks.parked_pulls);
+          goto ack;
+        }
+        // invalid wire: fall through to the generic path's error report
+      }
       if (!ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
                               ks.scratch.data(),
                               ks.recv_count == 0 ? &ks.round_idx : nullptr)) {
@@ -2499,5 +2613,46 @@ void bps_client_destroy(void* c) {
   ((bps::Client*)c)->Close();
   delete (bps::Client*)c;
 }
+
+// ---------------------------------------------------------------- //
+// standalone codec API: the SAME CompressorCfg the server mirrors,
+// exposed to the worker host tier (ops/compression/native.py) so the
+// worker-side pack/unpack runs the vectorized C++ instead of numpy
+// (reference: the worker's OpenMP C++ compressors, onebit.cc:34-66)
+// ---------------------------------------------------------------- //
+
+void* bps_codec_create(const char* kwargs) {
+  auto* c = new bps::CompressorCfg();
+  if (!bps::CompressorCfg::Parse(kwargs, c)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+// allocation bound for a wire payload (== actual length for fixed formats)
+uint32_t bps_codec_wire_bound(void* h) {
+  return ((bps::CompressorCfg*)h)->WireLen();
+}
+
+// dense f32[n] -> wire payload in `out` (capacity >= wire_bound);
+// returns the actual payload length, or -1 on error
+int64_t bps_codec_compress(void* h, const float* in, uint8_t* out,
+                           uint64_t step) {
+  auto* c = (bps::CompressorCfg*)h;
+  std::vector<int32_t> idx;
+  if (c->type == bps::CompressorCfg::RANDOMK) c->RandomkIndices(step, &idx);
+  return (int64_t)c->Compress(in, out, step, idx);
+}
+
+// wire payload -> dense f32[n] in `out`; returns 0 ok, -1 on bad wire
+int bps_codec_decompress(void* h, const uint8_t* in, uint32_t len,
+                         float* out) {
+  return ((bps::CompressorCfg*)h)->Decompress(in, len, out, nullptr)
+             ? 0
+             : -1;
+}
+
+void bps_codec_destroy(void* h) { delete (bps::CompressorCfg*)h; }
 
 }  // extern "C"

@@ -437,6 +437,17 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
             seed=int(kwargs.get("seed", 0)), index_coding=coding)
     else:
         raise ValueError(f"unknown compressor {name!r}")
+    # hot-path acceleration: the deterministic codecs route through the
+    # C++ implementation the server already mirrors (native.py; kill
+    # switch BYTEPS_NATIVE_CODEC=0) — signs/indices/values bit-identical
+    # to the numpy golden, reduction-derived scalars (the onebit scale)
+    # within an ulp (module-top contract); numpy stays the golden model
+    # and the fallback
+    from .native import maybe_native
+
+    native = maybe_native(kwargs, codec.kwargs_wire(), n)
+    if native is not None:
+        codec = native
     stack = codec
     if kwargs.get("ef") == "vanilla":
         stack = HostErrorFeedback(stack)

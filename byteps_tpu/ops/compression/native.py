@@ -1,0 +1,134 @@
+"""ctypes wrapper over the C++ wire codec (native/ps.cc CompressorCfg).
+
+The server has always mirrored the worker's codec in C++; this exposes the
+SAME implementation to the worker host tier, replacing the numpy pack loop
+on the per-step hot path (reference: the worker-side compressors are
+OpenMP C++, byteps/common/compressor/impl/onebit.cc:34-66 — numpy was the
+rebuild's placeholder). Wire bytes are produced by the identical code the
+server parses, so worker/server bit-agreement is by construction.
+
+Routed by ``make_host_codec`` for onebit/topk/randomk when the native
+library is available (kill switch: BYTEPS_NATIVE_CODEC=0). Dithering stays
+on the numpy tier: its stochastic rounding keys off the norm scalar, and a
+norm that differs by an ulp from the numpy golden (C++ accumulates in
+double, numpy in f32 pairwise) could flip individual level draws — the
+deterministic codecs have no such scalar->bit feedback (the onebit scale
+rides the wire but never gates a bit).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_lib = None
+_lib_lock = threading.Lock()
+_LOAD_FAILED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the shared library; None if unavailable.
+    Never raises — callers fall back to the numpy tier."""
+    global _lib, _LOAD_FAILED
+    if _lib is not None:
+        return _lib
+    if _LOAD_FAILED or os.environ.get("BYTEPS_NATIVE_CODEC", "1") == "0":
+        return None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            from ...native.build import build
+
+            lib = ctypes.CDLL(build())
+            lib.bps_codec_create.restype = ctypes.c_void_p
+            lib.bps_codec_create.argtypes = [ctypes.c_char_p]
+            lib.bps_codec_wire_bound.restype = ctypes.c_uint32
+            lib.bps_codec_wire_bound.argtypes = [ctypes.c_void_p]
+            lib.bps_codec_compress.restype = ctypes.c_int64
+            lib.bps_codec_compress.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64]
+            lib.bps_codec_decompress.restype = ctypes.c_int
+            lib.bps_codec_decompress.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.c_void_p]
+            lib.bps_codec_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:  # noqa: BLE001 - no toolchain etc.
+            _LOAD_FAILED = True
+            return None
+    return _lib
+
+
+class NativeCodec:
+    """HostCodec-interface adapter over one C++ CompressorCfg instance."""
+
+    def __init__(self, kwargs_wire: str, n: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native codec library unavailable")
+        self._lib = lib
+        self.n = n
+        self._kwargs_wire = kwargs_wire
+        self._h = lib.bps_codec_create(kwargs_wire.encode())
+        if not self._h:
+            raise ValueError(f"native codec rejected {kwargs_wire!r}")
+        self._bound = int(lib.bps_codec_wire_bound(self._h))
+
+    def compress(self, x: np.ndarray, step: int = 0) -> np.ndarray:
+        """Wire payload as a uint8 ndarray — a buffer-protocol object,
+        interchangeable with the numpy tier's bytes everywhere the wire
+        is consumed (np.frombuffer / zpush) without the tobytes copy."""
+        x = np.ascontiguousarray(x, np.float32)
+        if x.size != self.n:
+            raise ValueError(f"expected {self.n} elements, got {x.size}")
+        out = np.empty(self._bound, np.uint8)
+        wl = self._lib.bps_codec_compress(self._h, x.ctypes.data,
+                                          out.ctypes.data, step)
+        if wl < 0:
+            raise RuntimeError("native compress failed")
+        return out[:wl]
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = np.ascontiguousarray(np.frombuffer(buf, np.uint8))
+        out = np.empty(self.n, np.float32)
+        rc = self._lib.bps_codec_decompress(self._h, raw.ctypes.data,
+                                            len(raw), out.ctypes.data)
+        if rc != 0:
+            raise ValueError("native decompress: bad wire payload")
+        return out
+
+    def wire_bytes(self) -> int:
+        return self._bound
+
+    def kwargs_wire(self) -> str:
+        return self._kwargs_wire
+
+    def __del__(self):  # noqa: D105
+        h, lib = getattr(self, "_h", None), getattr(self, "_lib", None)
+        if h and lib is not None:
+            try:
+                lib.bps_codec_destroy(h)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+            self._h = None
+
+
+_NATIVE_OK = ("onebit", "topk", "randomk")
+
+
+def maybe_native(kwargs: Dict[str, str], kwargs_wire: str,
+                 n: int) -> Optional[NativeCodec]:
+    """A NativeCodec for this config, or None when the config or the
+    environment calls for the numpy tier."""
+    if kwargs.get("compressor") not in _NATIVE_OK or _load() is None:
+        return None
+    try:
+        return NativeCodec(kwargs_wire, n)
+    except (RuntimeError, ValueError):
+        return None
