@@ -364,8 +364,14 @@ class PipelineScheduler:
         if self._tracer:
             self._tracer.begin(name, span)
         try:
-            self._client.zpush(task.partition.server, task.key, buf,
-                               task.cmd)
+            # async push: the payload hits the wire and the stage ends —
+            # no ACK round-trip on the critical path (the pull is the
+            # synchronization; per-key FIFO via the client's key-affine
+            # conns). A server reject poisons the conn and surfaces as
+            # the pull's error. The PUSH span therefore measures send
+            # time only; aggregation wait shows up in PULL.
+            self._client.zpush_async(task.partition.server, task.key, buf,
+                                     task.cmd)
         except Exception as e:  # noqa: BLE001
             self._finish(task, e)
             return

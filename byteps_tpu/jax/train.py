@@ -344,12 +344,12 @@ def make_ps_train_step(
                 if hasattr(leaf, "copy_to_host_async"):
                     leaf.copy_to_host_async()
             reg = None
+            mcb = min_compress_bytes
+            if mcb is None:
+                mcb = getattr(state.config, "min_compress_bytes", 0)
             if compression is not None:
                 if comp_state["client"] is not client:
                     from ..server.compressed import CompressedRegistry
-                    mcb = min_compress_bytes
-                    if mcb is None:
-                        mcb = getattr(state.config, "min_compress_bytes", 0)
                     comp_state["registry"] = CompressedRegistry(
                         client, state.config.num_workers, compression, mcb)
                     comp_state["client"] = client
@@ -386,17 +386,89 @@ def make_ps_train_step(
                 out = ps_round_trip(state, name, flat, average=True)
                 return lambda: out
 
-            waiters, shapes = [], []
-            for name, leaf in zip(names, leaves):
-                h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
-                shapes.append(h.shape)
-                if _route_rowsparse(name, h, state, rowsparse_params):
-                    # non-f32 grads upcast for the wire, cast back below
-                    waiters.append(submit_sparse(name, h, h.dtype))
+            # Bucket fusion (BYTEPS_FUSION_BYTES; the group-push cure):
+            # per-key cost (scheduler admission, handle, two syscall
+            # round-trips, server queue hop) is flat, so sub-threshold
+            # leaves — biases, norms, small projections — fuse into one
+            # concatenated key per dtype run and are sliced back after
+            # the round. The bucket name is a content-stable digest of
+            # (member names, sizes): every worker flattens the same tree
+            # in the same order, so all workers aggregate the same
+            # bucket; a changed model topology changes the digest and
+            # cleanly declares a new key. Codec granularity for a fused
+            # bucket is the bucket (matching the reference, where the
+            # codec unit is the partition, not the layer).
+            #
+            # Interaction rules:
+            # - bucket cap <= partition_bytes: a bucket must stay ONE
+            #   key, or the partitioner re-splits it and re-adds the
+            #   round trip fusion exists to remove;
+            # - with compression on and min_compress_bytes > 0, only
+            #   sub-mcb leaves fuse and the bucket stays < mcb, so
+            #   tensors the gate kept full-precision (biases, norms)
+            #   are NOT quantized via the fused key (mcb == 0 means the
+            #   user asked for everything compressed — buckets too).
+            fusion = getattr(state.config, "fusion_bytes", 0)
+            bucket_cap = min(4 << 20,
+                             getattr(state.config, "partition_bytes",
+                                     4 << 20))
+            if reg is not None and mcb > 0:
+                fusion = min(fusion, mcb)
+                bucket_cap = min(bucket_cap, mcb - 1)
+            results: list = [None] * len(names)
+            waiters = []   # (slot_or_slots, finisher)
+            bucket: list = []  # [(slot, name, flat_f-contig host array)]
+            bucket_bytes = 0
+
+            def flush_bucket():
+                nonlocal bucket, bucket_bytes
+                if not bucket:
+                    return
+                if len(bucket) == 1:
+                    slot, name, h = bucket[0]
+                    waiters.append((slot, submit(name, h.reshape(-1))))
                 else:
-                    waiters.append(submit(name, h.reshape(-1)))
-            results = [w().reshape(shape)
-                       for w, shape in zip(waiters, shapes)]
+                    import hashlib
+                    parts = [h.reshape(-1) for _, _, h in bucket]
+                    digest = hashlib.sha1(";".join(
+                        f"{n}:{h.size}" for _, n, h in bucket)
+                        .encode()).hexdigest()[:12]
+                    fused = np.concatenate(parts)
+                    slots = [s for s, _, _ in bucket]
+                    sizes = [h.size for _, _, h in bucket]
+                    w = submit(f"fused/{digest}", fused)
+
+                    def finish(w=w, sizes=sizes):
+                        out = w()
+                        outs = np.split(out, np.cumsum(sizes)[:-1])
+                        return outs
+
+                    waiters.append((slots, finish))
+                bucket, bucket_bytes = [], 0
+
+            for i, (name, leaf) in enumerate(zip(names, leaves)):
+                h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
+                if _route_rowsparse(name, h, state, rowsparse_params):
+                    flush_bucket()
+                    # non-f32 grads upcast for the wire, cast back below
+                    waiters.append((i, submit_sparse(name, h, h.dtype)))
+                elif h.nbytes < fusion:
+                    if bucket and (bucket[0][2].dtype != h.dtype
+                                   or bucket_bytes + h.nbytes > bucket_cap):
+                        flush_bucket()
+                    bucket.append((i, name, h))
+                    bucket_bytes += h.nbytes
+                else:
+                    flush_bucket()
+                    waiters.append((i, submit(name, h.reshape(-1))))
+            flush_bucket()
+            shapes = [np.shape(leaf) for leaf in leaves]
+            for slot, finish in waiters:
+                if isinstance(slot, list):
+                    for s, piece in zip(slot, finish()):
+                        results[s] = piece.reshape(shapes[s])
+                else:
+                    results[slot] = finish().reshape(shapes[slot])
             grads = treedef.unflatten(results)
         params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, loss

@@ -237,6 +237,26 @@ def run_role(command: Sequence[str]) -> int:
     return launch_workers(command)
 
 
+_USAGE = """\
+usage: bpslaunch <training command...>
+
+Launches DMLC_ROLE (worker | server | scheduler) from the environment
+(reference launcher/launch.py parity):
+  worker     spawn BYTEPS_LOCAL_SIZE copies of <training command> with
+             NUMA/core pinning, per-rank env, optional gdb wrap
+  server     run the C++ parameter server in-process
+  scheduler  no-op (static rendezvous via DMLC_PS_ROOT_URI/PORT)
+
+Key env: DMLC_ROLE, DMLC_NUM_WORKER, DMLC_NUM_SERVER, DMLC_WORKER_ID,
+DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, BYTEPS_LOCAL_SIZE,
+BYTEPS_FORCE_DISTRIBUTED. Multi-host SSH fan-out:
+python -m byteps_tpu.launcher.dist --help
+"""
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(_USAGE)
+        return 0
     return run_role(argv)

@@ -2189,6 +2189,10 @@ struct Waiter {
   uint32_t out_len = 0;
   uint32_t got_len = 0;
   bool ok = true;
+  // detached = fire-and-forget request (async push): nobody waits on cv;
+  // an error reply instead poisons the connection (fail-fast for the
+  // paired pull, which would otherwise park server-side forever)
+  bool detached = false;
 };
 
 class ServerConn {
@@ -2235,10 +2239,40 @@ class ServerConn {
     }
   }
 
+  // fire-and-forget request (async push): sends and returns immediately.
+  // The reply is drained by RecvLoop (detached waiter); an error reply
+  // poisons the conn. Per-key ordering with the paired pull comes from
+  // connection FIFO — callers MUST route the pull over the SAME conn
+  // (Client::pick is key-affine for exactly this reason). Removes the
+  // ACK round-trip from the worker's critical path: the pull is the
+  // only synchronization (the reference's ps-lite ZPush is equally
+  // async, its callback firing off the van thread).
+  bool RequestAsync(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
+                    const void* data, uint32_t len) {
+    if (sticky_err_.load()) return false;
+    auto w = std::make_shared<Waiter>();
+    w->detached = true;
+    uint32_t rid = next_rid_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      waiters_[rid] = w;
+    }
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
+    std::lock_guard<std::mutex> lk(send_mu_);
+    bool sent = chan_ ? chan_->send_msg(h, data)
+                      : send_msg_iov(fd_, h, data);
+    if (!sent) {
+      std::lock_guard<std::mutex> lk2(waiters_mu_);
+      waiters_.erase(rid);
+    }
+    return sent;
+  }
+
   // blocking request: returns got_len or ~0u on failure
   uint32_t Request(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
                    const void* data, uint32_t len, void* out,
                    uint32_t out_len) {
+    if (sticky_err_.load()) return ~0u;
     auto w = std::make_shared<Waiter>();
     w->out = out;
     w->out_len = out_len;
@@ -2386,6 +2420,20 @@ class ServerConn {
         }
       }
       bool server_err = (h.flags & 1) != 0;
+      if (w->detached) {
+        // async push ACK: success is silent; an error poisons the conn
+        // (sticky) and fails everything in flight on it NOW — the
+        // paired pull can never be answered (the server didn't count
+        // the push), so prompt failure beats a 600s client timeout
+        if (!(ok && !server_err)) {
+          sticky_err_.store(true);
+          std::fprintf(stderr, "[bps-client] async push rejected "
+                       "key=%llu; failing conn\n",
+                       (unsigned long long)h.key);
+          break;  // drop to the fail-all tail below
+        }
+        continue;
+      }
       {
         std::lock_guard<std::mutex> lk(w->mu);
         w->got_len = h.len;
@@ -2413,6 +2461,10 @@ class ServerConn {
   std::mutex waiters_mu_;
   std::unordered_map<uint32_t, std::shared_ptr<Waiter>> waiters_;
   std::atomic<uint32_t> next_rid_{1};
+  // set by a rejected detached (async) push: the conn is poisoned —
+  // every later Request fails fast instead of wedging on a round the
+  // server will never complete
+  std::atomic<bool> sticky_err_{false};
 };
 
 class Client {
@@ -2424,10 +2476,10 @@ class Client {
     // serializes all partitions on one send mutex + one kernel TCP flow;
     // K streams spread the copy/checksum work over cores and keep the
     // pipe full while a peer stream waits on an ack (the reference gets
-    // the same effect from ps-lite's multi-connection van). Safe because
-    // the protocol is rid-multiplexed and the worker's per-key ordering
-    // comes from the blocking push-then-pull call sequence, not from
-    // connection FIFO.
+    // the same effect from ps-lite's multi-connection van). Per-key
+    // ordering comes from key-affine conn picking (pick(server, key)):
+    // a key's async push and its pull share one FIFO stream; unordered
+    // ops (init/comp_init) block on their ACK and may round-robin.
     int k = 4;
     if (const char* e = ::getenv("BYTEPS_CLIENT_CONNS")) {
       k = std::atoi(e);
@@ -2470,15 +2522,25 @@ class Client {
 
   int Push(int server, uint64_t key, const void* data, uint32_t len,
            uint32_t cmd) {
-    uint32_t r = pick(server)->Request(PUSH, key, cmd, worker_id_, data,
-                                       len, nullptr, 0);
+    uint32_t r = pick(server, key)->Request(PUSH, key, cmd, worker_id_,
+                                            data, len, nullptr, 0);
     return r == ~0u ? -1 : 0;
+  }
+
+  // async push: returns once the bytes are on the wire; the ACK drains
+  // in the background (an error ACK poisons the conn). The paired Pull
+  // rides the same key-affine conn, so per-key push->pull FIFO holds
+  // end-to-end (conn stream -> server per-key engine queue).
+  int PushAsync(int server, uint64_t key, const void* data, uint32_t len,
+                uint32_t cmd) {
+    return pick(server, key)->RequestAsync(PUSH, key, cmd, worker_id_,
+                                           data, len) ? 0 : -1;
   }
 
   int Pull(int server, uint64_t key, void* out, uint32_t out_len,
            uint32_t cmd) {
-    uint32_t r = pick(server)->Request(PULL, key, cmd, worker_id_, nullptr,
-                                       0, out, out_len);
+    uint32_t r = pick(server, key)->Request(PULL, key, cmd, worker_id_,
+                                            nullptr, 0, out, out_len);
     return r == ~0u ? -1 : (int)r;
   }
 
@@ -2522,9 +2584,20 @@ class Client {
     std::atomic<uint32_t> rr{0};
   };
 
+  // round-robin pick: ops with no ordering requirement (init/comp_init
+  // block on their ACK, so cross-conn reorder can't hurt them)
   ServerConn* pick(int server) {
     ConnGroup& g = *groups_[server];
     return g.conns[g.rr.fetch_add(1) % g.conns.size()].get();
+  }
+
+  // key-affine pick: a key's push and pull MUST share a conn so async
+  // pushes stay FIFO with their pull. Mix the high half in — partition
+  // keys are (declared << 16) | part, so bare key % k would pile every
+  // single-partition tensor onto conn 0.
+  ServerConn* pick(int server, uint64_t key) {
+    ConnGroup& g = *groups_[server];
+    return g.conns[(size_t)((key ^ (key >> 16)) % g.conns.size())].get();
   }
 
   uint16_t worker_id_ = 0;
@@ -2592,6 +2665,11 @@ int bps_client_comp_init(void* c, int server, uint64_t key,
 int bps_client_push(void* c, int server, uint64_t key, const void* data,
                     uint32_t len, uint32_t cmd) {
   return ((bps::Client*)c)->Push(server, key, data, len, cmd);
+}
+
+int bps_client_push_async(void* c, int server, uint64_t key,
+                          const void* data, uint32_t len, uint32_t cmd) {
+  return ((bps::Client*)c)->PushAsync(server, key, data, len, cmd);
 }
 
 int bps_client_pull(void* c, int server, uint64_t key, void* out,

@@ -36,6 +36,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint32]
     lib.bps_client_push.restype = ctypes.c_int
     lib.bps_client_push.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_push_async.restype = ctypes.c_int
+    lib.bps_client_push_async.argtypes = lib.bps_client_init_key.argtypes
     lib.bps_client_pull.restype = ctypes.c_int
     lib.bps_client_pull.argtypes = lib.bps_client_init_key.argtypes
     lib.bps_client_comp_init.restype = ctypes.c_int
@@ -180,6 +182,20 @@ class PSClient:
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
         if rc != 0:
             raise RuntimeError(f"push failed key={key}")
+
+    def zpush_async(self, server: int, key: int, data: np.ndarray,
+                    cmd: int) -> None:
+        """Fire-and-forget push: returns once the payload is on the wire
+        (the native send copies it into the socket/ring, so ``data`` may
+        be reused immediately). The ACK drains in the background; a
+        server reject poisons the connection and surfaces on the paired
+        zpull. Removes the ACK round-trip from the pipeline's critical
+        path — the pull is the only synchronization, matching ps-lite's
+        asynchronous ZPush."""
+        rc = self._lib.bps_client_push_async(
+            self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+        if rc != 0:
+            raise RuntimeError(f"async push failed key={key}")
 
     def zpull(self, server: int, key: int, out: np.ndarray,
               cmd: int) -> int:

@@ -41,7 +41,10 @@ import byteps_tpu as bps
 from byteps_tpu.models import bert, llama, mlp, moe, resnet, vgg
 
 
-def build(model: str, batch_size: int):
+def build(model: str, batch_size: int, tiny: bool = False):
+    """``tiny``: swap every model for its smoke-scale config — CI hosts
+    can't turn the real configs' FLOPs over (bert-large fwd+bwd on one
+    CPU core is minutes per batch), and a smoke only checks the path."""
     rng = np.random.RandomState(0)
     key = jax.random.PRNGKey(0)
     if model == "mlp":
@@ -51,11 +54,12 @@ def build(model: str, batch_size: int):
                  "y": jnp.asarray(rng.randint(0, 10, batch_size), jnp.int32)}
         return params, batch, lambda p, b: mlp.loss_fn(p, b, cfg)
     if model == "resnet50":
-        cfg = resnet.ResNetConfig.resnet50()
+        cfg = resnet.ResNetConfig.tiny() if tiny \
+            else resnet.ResNetConfig.resnet50()
         params, bn_state = resnet.init_params(key, cfg)
         batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
                                   jnp.float32),
-                 "y": jnp.asarray(rng.randint(0, 1000, batch_size),
+                 "y": jnp.asarray(rng.randint(0, cfg.n_classes, batch_size),
                                   jnp.int32)}
         # throughput-only: BN runs in train mode against the initial
         # running stats every step (same FLOPs as real training; the
@@ -69,33 +73,40 @@ def build(model: str, batch_size: int):
     if model == "vgg16":
         # the reference's bandwidth-stress vehicle (138M params dominated
         # by fc layers; its largest reported wins, docs/performance.md:9)
-        cfg = vgg.VGGConfig.vgg16()
+        cfg = vgg.VGGConfig.tiny() if tiny else vgg.VGGConfig.vgg16()
         params = vgg.init_params(key, cfg)
         batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
                                   jnp.float32),
-                 "y": jnp.asarray(rng.randint(0, 1000, batch_size),
+                 "y": jnp.asarray(rng.randint(0, cfg.n_classes, batch_size),
                                   jnp.int32)}
         return params, batch, lambda p, b: vgg.loss_fn(p, b, cfg)
     if model == "bert":
-        cfg = bert.BertConfig.bert_large()
+        cfg = bert.BertConfig.tiny() if tiny \
+            else bert.BertConfig.bert_large()
         params = bert.init_params(key, cfg)
-        toks = rng.randint(0, cfg.vocab_size, (batch_size, 128))
-        labels = np.where(rng.rand(batch_size, 128) < 0.15,
-                          rng.randint(0, cfg.vocab_size, (batch_size, 128)),
+        seq = min(128, cfg.max_seq_len)
+        toks = rng.randint(0, cfg.vocab_size, (batch_size, seq))
+        labels = np.where(rng.rand(batch_size, seq) < 0.15,
+                          rng.randint(0, cfg.vocab_size, (batch_size, seq)),
                           -1)
         batch = {"tokens": jnp.asarray(toks, jnp.int32),
                  "labels": jnp.asarray(labels, jnp.int32)}
         return params, batch, lambda p, b: bert.loss_fn(p, b, cfg)
     if model == "llama":
-        cfg = llama.LlamaConfig.small()
+        cfg = llama.LlamaConfig.tiny() if tiny \
+            else llama.LlamaConfig.small()
         params = llama.init_params(key, cfg)
-        toks = rng.randint(0, cfg.vocab_size, (batch_size, 1025))
+        toks = rng.randint(0, cfg.vocab_size,
+                           (batch_size, (cfg.max_seq_len if tiny else 1024)
+                            + 1))
         batch = {"tokens": jnp.asarray(toks, jnp.int32)}
         return params, batch, lambda p, b: llama.loss_fn(p, b, cfg)
     if model == "moe":
-        cfg = moe.MoEConfig.small()
+        cfg = moe.MoEConfig.tiny() if tiny else moe.MoEConfig.small()
         params = moe.init_params(key, cfg)
-        toks = rng.randint(0, cfg.vocab_size, (batch_size, 513))
+        toks = rng.randint(0, cfg.vocab_size,
+                           (batch_size, (cfg.max_seq_len if tiny else 512)
+                            + 1))
         batch = {"tokens": jnp.asarray(toks, jnp.int32)}
         return params, batch, lambda p, b: moe.loss_fn(p, b, cfg)
     raise SystemExit(f"unknown model {model}")
@@ -109,6 +120,8 @@ def main() -> None:
     ap.add_argument("--num-warmup-batches", type=int, default=3)
     ap.add_argument("--num-batches-per-iter", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true",
+                    help="smoke-scale model configs (CI hosts)")
     ap.add_argument("--no-comm", action="store_true",
                     help="compute-only step (no gradient push_pull) for "
                          "A/B-ing the communication overhead")
@@ -120,7 +133,7 @@ def main() -> None:
         if bps.rank() == 0:
             print(s, flush=True)
 
-    params, batch, loss_fn = build(args.model, args.batch_size)
+    params, batch, loss_fn = build(args.model, args.batch_size, args.tiny)
     tx = optax.adam(1e-3)
 
     from byteps_tpu.core.state import get_state

@@ -1,0 +1,59 @@
+"""Packaging surface: the repo is pip-installable and ships the
+``bpslaunch`` console script (reference setup.py entry_points parity).
+A real venv (system-site-packages for the preinstalled jax stack) does an
+offline ``pip install -e .`` and runs ``bpslaunch --help``."""
+
+import os
+import site
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The test venv is created from THIS interpreter, itself usually a venv
+# (--system-site-packages only chains to the base python): expose the
+# running env's site-packages (setuptools for the offline build; jax etc.
+# for the import check) explicitly.
+_SITE = os.pathsep.join(site.getsitepackages())
+_ENV = {**os.environ, "PIP_NO_INPUT": "1", "PYTHONPATH": _SITE}
+
+
+@pytest.fixture(scope="module")
+def venv(tmp_path_factory):
+    vdir = tmp_path_factory.mktemp("pkg") / "venv"
+    r = subprocess.run(
+        [sys.executable, "-m", "venv", str(vdir)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return vdir
+
+
+def test_editable_install_and_bpslaunch(venv):
+    pip = venv / "bin" / "pip"
+    # --no-build-isolation: offline build against the exposed setuptools
+    r = subprocess.run(
+        [str(pip), "install", "--no-build-isolation", "--no-deps", "-e",
+         REPO],
+        capture_output=True, text=True, timeout=600, env=_ENV)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+    bpslaunch = venv / "bin" / "bpslaunch"
+    assert bpslaunch.exists(), "console script not installed"
+    r = subprocess.run([str(bpslaunch), "--help"], capture_output=True,
+                       text=True, timeout=120, env=_ENV)
+    assert r.returncode == 0, r.stdout[-1000:] + r.stderr[-1000:]
+    assert "bpslaunch" in (r.stdout + r.stderr).lower() or \
+        "usage" in (r.stdout + r.stderr).lower(), r.stdout[-500:]
+
+    # the installed package resolves and exposes the public API
+    py = venv / "bin" / "python"
+    r = subprocess.run(
+        [str(py), "-c",
+         "import byteps_tpu, byteps_tpu.launcher; "
+         "print(byteps_tpu.__name__, callable(byteps_tpu.launcher.main))"],
+        capture_output=True, text=True, timeout=120, cwd=str(venv),
+        env=_ENV)
+    assert r.returncode == 0, r.stdout[-1000:] + r.stderr[-1000:]
+    assert "byteps_tpu True" in r.stdout

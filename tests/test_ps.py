@@ -131,7 +131,17 @@ def test_two_workers_16bit_sum(dtype_name):
                   dtype_name == "float16" else 3e38)]
         x1[:4] = [npdt(6e-8), npdt(6e-8), npdt(-0.0), npdt(65000.0 if
                   dtype_name == "float16" else 3e38)]
-        expect = (x0 + x1).astype(npdt)
+        # expectation mirrors the server's arithmetic (f32 accumulate,
+        # then round to the wire dtype); errstate silences the DESIGNED
+        # overflow of lane 3 (65000+65000 > f16 max -> inf on both sides)
+        with np.errstate(over="ignore"):
+            expect = (x0.astype(np.float32)
+                      + x1.astype(np.float32)).astype(npdt)
+        # prove the comparison isn't inf==inf throughout: exactly the
+        # overflow lane is inf, every other lane is finite
+        as_f32 = expect.astype(np.float32)
+        assert not np.isfinite(as_f32[3])
+        assert np.isfinite(np.delete(as_f32, 3)).all()
 
     wire0 = x0.view(np.uint16)
     wire1 = x1.view(np.uint16)
