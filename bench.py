@@ -218,7 +218,13 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     variants = {"remat": cfg,
                 # 125M at B=16/S=1024: saved activations (~a few GB) fit
                 # v5e HBM, buying back the remat recompute FLOPs
-                "noremat": dataclasses.replace(cfg, remat=False)}
+                "noremat": dataclasses.replace(cfg, remat=False),
+                # chunked-vocab xent: the [B,S,V] logits never resident
+                # at once (llama.chunked_next_token_xent) — the MFU
+                # harness's HBM-traffic candidate, A/B'd here on real
+                # hardware every round
+                "chunked8": dataclasses.replace(cfg, remat=False,
+                                                xent_chunks=8)}
     results = {}
     for name, c in variants.items():
         try:

@@ -27,7 +27,7 @@ from .core.state import get_state
 from .core.types import DataType, QueueType, Status
 from .ops.push_pull import push_pull, broadcast
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # keep in sync with pyproject.toml
 
 __all__ = [
     "init", "shutdown", "suspend", "resume",

@@ -43,6 +43,13 @@ class LlamaConfig:
     # None = full recompute. On the 125M bench both time the same; the
     # policy trades activation memory back for recompute at larger scale.
     remat_policy: Optional[str] = None
+    # > 0: loss_fn computes the cross entropy per vocab chunk under a
+    # nothing-saveable checkpoint, so the [B, S, V] logits are never
+    # resident at once — trades an extra lm_head matmul in bwd for the
+    # logits' HBM round-trips (the MFU experiment harness's chunked-xent
+    # candidate, examples/mfu_experiments.py; bench.py A/Bs it). Vocab
+    # must divide evenly or the dense path is used.
+    xent_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -204,6 +211,46 @@ def next_token_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(lse - picked)
 
 
+def chunked_next_token_xent(hidden: jnp.ndarray, lm_head: jnp.ndarray,
+                            targets: jnp.ndarray,
+                            n_chunks: int) -> jnp.ndarray:
+    """Mean next-token cross-entropy WITHOUT materializing [B, S, V]:
+    per vocab chunk, project + logsumexp + pick under a nothing-saveable
+    checkpoint, then combine the per-chunk partials (logsumexp over
+    chunks; the picked logit lives in exactly one chunk, -inf in the
+    rest, so a max recovers it). Trades one extra lm_head matmul in the
+    backward for the logits' HBM round-trips — the MFU-experiment
+    winner shape at V=32k (examples/mfu_experiments.py). Identical math
+    to next_token_xent (a test asserts closeness)."""
+    import functools
+
+    V = lm_head.shape[1]
+    Vc = V // n_chunks
+
+    @functools.partial(jax.checkpoint,
+                       policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_lse_pick(h, Wc, base):
+        logits = (h @ Wc.astype(h.dtype)).astype(jnp.float32)  # [B,S,Vc]
+        lse_c = jax.scipy.special.logsumexp(logits, -1)
+        inrange = (targets >= base) & (targets < base + Vc)
+        loc = jnp.clip(targets - base, 0, Vc - 1)
+        picked_c = jnp.where(
+            inrange,
+            jnp.take_along_axis(logits, loc[..., None], -1)[..., 0],
+            -jnp.inf)
+        return lse_c, picked_c
+
+    Wr = lm_head.reshape(lm_head.shape[0], n_chunks, Vc)
+    lses, picks = [], []
+    for c in range(n_chunks):
+        lse_c, picked_c = chunk_lse_pick(hidden, Wr[:, c], c * Vc)
+        lses.append(lse_c)
+        picks.append(picked_c)
+    lse = jax.scipy.special.logsumexp(jnp.stack(lses, 0), 0)
+    picked = jnp.max(jnp.stack(picks, 0), 0)
+    return jnp.mean(lse - picked)
+
+
 def split_batch(batch: Dict[str, jnp.ndarray]) -> tuple:
     """(inputs, targets) from either a pre-shifted {'inputs','targets'}
     batch or a raw {'tokens'} batch (shifted here)."""
@@ -223,9 +270,12 @@ def _block(x, p, cos, sin, cfg: LlamaConfig, attn_impl=None):
     return x
 
 
-def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
-            attn_impl=None, sp_axis: Optional[str] = None) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (cfg.dtype).
+def forward_hidden(params: Dict[str, Any], tokens: jnp.ndarray,
+                   cfg: LlamaConfig, attn_impl=None,
+                   sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """The trunk: tokens [B, S] int32 -> final normed hidden [B, S, d]
+    (cfg.dtype). ``forward`` adds the lm_head projection; chunked-vocab
+    consumers (chunked_next_token_xent) project per chunk themselves.
 
     ``sp_axis``: when running inside shard_map with the sequence sharded
     over that mesh axis (ring attention), RoPE must use *global* positions:
@@ -262,7 +312,14 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
         return fn(x, layer_params, cos, sin, cfg, attn_impl), None
 
     x, _ = jax.lax.scan(body, x, blk)
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: LlamaConfig,
+            attn_impl=None, sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (cfg.dtype). See
+    forward_hidden for the trunk and the sp_axis contract."""
+    x = forward_hidden(params, tokens, cfg, attn_impl, sp_axis)
     # logits stay in cfg.dtype: materializing [B, S, V] fp32 costs ~2x the
     # HBM traffic of the whole lm_head matmul; consumers cast into their
     # fp32 reductions (next_token_xent), where the cast fuses
@@ -325,8 +382,13 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
             "({'inputs', 'targets'}): shifting a sharded 'tokens' "
             "locally would gap the global sequence")
     inputs, targets = split_batch(batch)
-    logits = forward(params, inputs, cfg, attn_impl, sp_axis)
-    loss = next_token_xent(logits, targets)
+    if cfg.xent_chunks > 0 and cfg.vocab_size % cfg.xent_chunks == 0:
+        hidden = forward_hidden(params, inputs, cfg, attn_impl, sp_axis)
+        loss = chunked_next_token_xent(hidden, params["lm_head"], targets,
+                                       cfg.xent_chunks)
+    else:
+        logits = forward(params, inputs, cfg, attn_impl, sp_axis)
+        loss = next_token_xent(logits, targets)
     if sp_axis is not None:
         loss = jax.lax.pmean(loss, sp_axis)
     return loss

@@ -97,7 +97,14 @@ def chunked_xent_loss(q, t, n_chunks=8):
 bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg), "baseline")
 for nc in (4, 8):
     bench_loss(functools.partial(chunked_xent_loss, n_chunks=nc),
-               f"chunked xent x{nc}")
+               f"chunked xent x{nc} (local impl)")
+# the LANDED implementation (llama.chunked_next_token_xent via
+# cfg.xent_chunks — what bench.py's chunked8 variant runs)
+import dataclasses as _dc
+for nc in (4, 8):
+    cfg_c = _dc.replace(cfg, xent_chunks=nc)
+    bench_loss(lambda q, t, c=cfg_c: llama.loss_fn(q, {"tokens": t}, c),
+               f"cfg.xent_chunks={nc}")
 
 # -- 2. S=2048, B=8 ------------------------------------------------------- #
 tok2 = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (8, 2049)), jnp.int32)

@@ -120,7 +120,6 @@ def test_min_compress_gate_survives_fusion(ps_env):
     be quantized via the fused key."""
     cfg, params, batch = _mlp_setup()
     dense, _ = _run_steps(ps_env, params, batch, cfg)
-    from byteps_tpu.core.state import GlobalState
     got, _ = _run_steps(
         ps_env, params, batch, cfg,
         compression={"compressor": "onebit", "ef": "vanilla"},

@@ -113,3 +113,37 @@ def test_vgg_trains(bps):
         params, opt_state, loss = step(params, opt_state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_llama_chunked_xent_matches_dense(bps):
+    """cfg.xent_chunks (the chunked-vocab loss that never materializes
+    [B,S,V]) must agree with the dense logsumexp loss in value AND
+    gradient — it is the same math under a different checkpoint/fusion
+    schedule."""
+    import dataclasses
+
+    from byteps_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq=16)
+    cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg_f32)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 17)), jnp.int32)
+
+    dense = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, {"tokens": tokens}, cfg_f32))
+    cfg_ck = dataclasses.replace(cfg_f32, xent_chunks=4)
+    chunk = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, {"tokens": tokens}, cfg_ck))
+
+    l0, g0 = dense(params)
+    l1, g1 = chunk(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # non-divisible vocab falls back to the dense path, silently correct
+    cfg_bad = dataclasses.replace(cfg_f32, xent_chunks=7)
+    l2 = llama.loss_fn(params, {"tokens": tokens}, cfg_bad)
+    np.testing.assert_allclose(float(l2), float(l0), rtol=1e-6)

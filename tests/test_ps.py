@@ -299,3 +299,35 @@ def test_elastic_reconnect():
     c2.zpull(0, 5, out2, CMD_F32)
     np.testing.assert_allclose(out2, 3.0)
     c2.close()
+
+
+def test_async_push_roundtrip_and_reject():
+    """zpush_async: (a) the happy path round-trips like zpush (the pull
+    is the synchronization — per-key FIFO via key-affine conns); (b) a
+    server-rejected async push poisons the connection so the paired pull
+    fails PROMPTLY (bounded seconds), not after the 600s client timeout:
+    the server never counted the push, so the round could otherwise
+    never complete."""
+    # (the 600s default client timeout is latched process-wide on first
+    # request — the <30s assertion below is what proves fail-fast)
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    x = np.arange(256, dtype=np.float32)
+    c.init_key(0, 9, np.zeros_like(x), CMD_F32)
+    c.zpush_async(0, 9, x, CMD_F32)
+    out = np.empty_like(x)
+    c.zpull(0, 9, out, CMD_F32)
+    np.testing.assert_array_equal(out, x)
+
+    # rejected push: a steady-state PUSH with a length that does not
+    # match the store is error-ACKed by the server
+    bad = np.zeros(7, np.float32)
+    c.zpush_async(0, 9, bad, CMD_F32)
+    t0 = time.time()
+    with pytest.raises(RuntimeError):
+        c.zpull(0, 9, out, CMD_F32)
+    assert time.time() - t0 < 30, "poisoned conn did not fail fast"
+    c.close()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
