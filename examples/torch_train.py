@@ -1,0 +1,129 @@
+"""PyTorch-adapter training example — the reference's torch example
+family in one script (example/pytorch/train_mnist_byteps.py +
+benchmark_byteps_ddp.py + benchmark_cross_barrier_byteps.py):
+
+    python examples/torch_train.py                  # DistributedOptimizer
+    python examples/torch_train.py --frontend ddp   # DistributedDataParallel
+    python examples/torch_train.py --frontend cross_barrier
+    python examples/torch_train.py --compression fp16
+
+Trains a small CNN on synthetic MNIST-shaped data through the real comm
+path: gradients ride the in-jit mesh collective, or the DCN PS when
+DMLC_NUM_SERVER > 0 (spawn roles with bpslaunch, docs/running.md). The
+three frontends are alternatives — each registers its own gradient
+hooks (combining them would double-push, see torch/__init__.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+import byteps_tpu.torch as bps  # noqa: E402
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 8, 3, stride=2)
+        self.conv2 = torch.nn.Conv2d(8, 16, 3, stride=2)
+        self.fc = torch.nn.Linear(16 * 6 * 6, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(x.flatten(1))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frontend", default="optimizer",
+                    choices=["optimizer", "ddp", "cross_barrier"])
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "fp16"],
+                    help="fp16 wire compression (optimizer/cross_barrier "
+                         "frontends; DistributedDataParallel has no "
+                         "compression hook, matching the reference)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    if args.frontend == "ddp" and args.compression != "none":
+        ap.error("--compression applies to the optimizer/cross_barrier "
+                 "frontends; DistributedDataParallel pushes raw grads")
+
+    bps.init()
+    torch.manual_seed(1234 + bps.rank())
+
+    model = Net()
+    comp = (bps.Compression.fp16 if args.compression == "fp16"
+            else bps.Compression.none)
+
+    opt = torch.optim.Adam(model.parameters(), lr=args.lr)
+    scheduler = None
+    if args.frontend == "ddp":
+        model = bps.DistributedDataParallel(model)
+    else:
+        opt = bps.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            compression=comp)
+        bps.broadcast_parameters(model.state_dict(), root_rank=0)
+        bps.broadcast_optimizer_state(opt, root_rank=0)
+        if args.frontend == "cross_barrier":
+            from byteps_tpu.torch.cross_barrier import CrossBarrier
+            # +2: the warmup steps below count against the poller's step
+            # budget (it drains and exits at the final step; accounting
+            # includes the broadcast-time call below)
+            scheduler = CrossBarrier(model, opt, num_steps=args.steps + 2)
+            # REQUIRED contract: one step() at parameter-broadcast time —
+            # step 0 runs the plain optimizer eagerly; from step 1 on the
+            # poller owns all updates (cross_barrier.py step())
+            scheduler.step()
+
+    rng = np.random.RandomState(bps.rank())
+    x = torch.from_numpy(rng.rand(args.batch_size, 1, 28, 28)
+                         .astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, args.batch_size))
+
+    stepper = scheduler if scheduler is not None else opt
+
+    def one_step():
+        stepper.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        if args.frontend == "ddp":
+            model.sync_gradients()
+        stepper.step()
+        return loss
+
+    # warmup outside the timer: the first step compiles the per-shape
+    # psum programs (mesh tier) / declares the PS keys
+    for _ in range(2):
+        one_step()
+
+    t0 = time.perf_counter()
+    loss = None
+    for step in range(args.steps):
+        loss = one_step()
+        if bps.rank() == 0 and step % 5 == 0:
+            print(f"step {step}: loss {loss.item():.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    if bps.rank() == 0 and loss is not None:
+        print(f"final loss {loss.item():.4f}  "
+              f"({args.steps * args.batch_size / dt:.0f} examples/sec, "
+              f"frontend={args.frontend})", flush=True)
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -58,3 +58,50 @@ def test_tf_train_runs():
     r = _run_example("tf_train.py", [])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "final loss" in r.stdout
+
+
+def test_torch_train_all_frontends():
+    """The torch-adapter example family (reference train_mnist_byteps +
+    benchmark_byteps_ddp + benchmark_cross_barrier_byteps in one script):
+    all three frontends run and report a final loss."""
+    for fe in ("optimizer", "ddp", "cross_barrier"):
+        r = _run_example("torch_train.py", ["--frontend", fe,
+                                            "--steps", "6"])
+        assert r.returncode == 0, (fe, r.stdout[-2000:] + r.stderr[-2000:])
+        assert "final loss" in r.stdout, (fe, r.stdout[-500:])
+
+
+def test_torch_train_distributed_ps():
+    """The same example through a REAL loopback PS (DMLC env + server
+    process): this is where CrossBarrier's poller/drain path and the
+    DistributedOptimizer's PS submits actually execute — the
+    single-worker run above never enters them."""
+    from byteps_tpu.utils.net import free_port
+
+    port = free_port()
+    env = {**os.environ,
+           "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+           "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port),
+           "BYTEPS_FORCE_DISTRIBUTED": "1",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    for fe in ("optimizer", "cross_barrier"):
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"],
+            env={**env, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        try:
+            path = os.path.join(REPO, "examples", "torch_train.py")
+            r = subprocess.run(
+                [sys.executable, "-c", _PIN, path, "--frontend", fe,
+                 "--steps", "6"],
+                cwd=REPO, capture_output=True, text=True, timeout=420,
+                env=env)
+            assert r.returncode == 0, \
+                (fe, r.stdout[-2000:] + r.stderr[-2000:])
+            assert "final loss" in r.stdout, (fe, r.stdout[-500:])
+            srv.wait(timeout=30)  # worker shutdown stops the server
+        finally:
+            if srv.poll() is None:
+                srv.kill()
