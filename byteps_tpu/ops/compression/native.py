@@ -8,12 +8,22 @@ rebuild's placeholder). Wire bytes are produced by the identical code the
 server parses, so worker/server bit-agreement is by construction.
 
 Routed by ``make_host_codec`` for onebit/topk/randomk when the native
-library is available (kill switch: BYTEPS_NATIVE_CODEC=0). Dithering stays
-on the numpy tier: its stochastic rounding keys off the norm scalar, and a
-norm that differs by an ulp from the numpy golden (C++ accumulates in
-double, numpy in f32 pairwise) could flip individual level draws — the
-deterministic codecs have no such scalar->bit feedback (the onebit scale
-rides the wire but never gates a bit).
+library is available (kill switch: BYTEPS_NATIVE_CODEC=0), plus
+dithering in its DEFAULT config (partition=linear, normalize=max): the
+max norm is computed exactly by both tiers and the level arithmetic
+mirrors the numpy op order, so the stochastic rounding draws are
+bit-identical. The non-default dithering configs stay numpy: l2's norm
+(C++ double accumulate vs numpy f32 pairwise) and natural's exp2f/log2f
+(libm-dependent) can differ by an ulp, and an ulp there can flip a level
+draw — unlike the deterministic codecs, where no reduction scalar gates
+a bit (the onebit scale rides the wire but never selects a sign).
+
+Parity contract scope: FINITE inputs. On NaN gradients the tiers diverge
+for dithering (numpy's max propagates NaN into the norm; C++ std::max
+skips it) — the same divergence the C++ server mirror has always had
+against the numpy golden. Onebit is NaN-parity-engineered (">= 0" is
+false for NaN on every tier); a NaN gradient round is garbage either
+way, so dithering's divergence is documented rather than mirrored.
 """
 
 from __future__ import annotations
@@ -122,11 +132,22 @@ class NativeCodec:
 _NATIVE_OK = ("onebit", "topk", "randomk")
 
 
+def _eligible(kwargs: Dict[str, str]) -> bool:
+    name = kwargs.get("compressor")
+    if name in _NATIVE_OK:
+        return True
+    if name == "dithering":
+        # only the bit-stable default config (see module docstring)
+        return (kwargs.get("partition_type", "linear") == "linear"
+                and kwargs.get("normalize_type", "max") == "max")
+    return False
+
+
 def maybe_native(kwargs: Dict[str, str], kwargs_wire: str,
                  n: int) -> Optional[NativeCodec]:
     """A NativeCodec for this config, or None when the config or the
     environment calls for the numpy tier."""
-    if kwargs.get("compressor") not in _NATIVE_OK or _load() is None:
+    if not _eligible(kwargs) or _load() is None:
         return None
     try:
         return NativeCodec(kwargs_wire, n)
