@@ -19,8 +19,10 @@ Documented divergences from the reference:
 - ``tf.IndexedSlices`` gradients ride the ROW-SPARSE PS path (only
   nonzero rows on the push wire — push_pull_rowsparse) and come back
   dense, instead of the reference's all-gathered IndexedSlices.
-- TF1 Session/graph-mode (``broadcast_global_variables`` hook) is out
-  of scope, like the reference marks it deprecated for TF2.
+- TF1 Session/graph-mode lives in ``byteps_tpu.tensorflow.v1``: the
+  ``compute_gradients``-override ``DistributedOptimizer`` +
+  ``broadcast_global_variables`` / ``BroadcastGlobalVariablesHook``
+  (reference __init__.py:141-268), built on the same push_pull.
 
 Single-worker (no PS configured) everything degrades to identity,
 matching the reference's size()==1 behavior.

@@ -1,0 +1,187 @@
+"""TF1 graph-mode surface (reference byteps/tensorflow/__init__.py:141-268):
+the ``compute_gradients``-override ``DistributedOptimizer`` (a
+``tf.compat.v1.train.Optimizer``) plus ``broadcast_global_variables`` /
+``BroadcastGlobalVariablesHook`` for Session-based training — the legacy
+API the reference still ships. Built on the same ``push_pull`` as the TF2
+adapter: inside a v1 graph it lowers to a ``py_function`` hop into the
+host scheduler, so Sessions, ``MonitoredTrainingSession`` and estimators
+drive the real comm path.
+
+Usage (classic v1 shape):
+
+    import byteps_tpu.tensorflow as bps
+    from byteps_tpu.tensorflow import v1 as bps_v1
+    bps.init()
+    opt = bps_v1.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.1))
+    train_op = opt.minimize(loss)          # compute_gradients push_pulls
+    hooks = [bps_v1.BroadcastGlobalVariablesHook(root_rank=0)]
+    with tf.compat.v1.train.MonitoredTrainingSession(hooks=hooks) as sess:
+        sess.run(train_op)
+
+Async mode (BYTEPS_ENABLE_ASYNC, reference __init__.py:246-268):
+``compute_gradients`` returns raw local gradients and ``apply_gradients``
+pushes the post-step WEIGHT DELTA through the server's async store.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from . import (
+    Compression, _handles, _submit, push_pull, rank, size,
+)
+
+
+def _enable_async() -> bool:
+    from ..core.state import get_state
+
+    return bool(get_state().config.enable_async)
+
+
+def _distributed() -> bool:
+    """True when gradient traffic must hit the wire: more than one
+    worker OR a PS scheduler is connected (BYTEPS_FORCE_DISTRIBUTED
+    single-worker runs exercise the full path — the torch adapter's
+    gate, torch/__init__.py)."""
+    from ..core.state import get_state
+
+    return size() > 1 or get_state().scheduler is not None
+
+
+def broadcast_global_variables(root_rank: int = 0) -> tf.Operation:
+    """A graph op that assigns every ``tf.compat.v1.global_variables()``
+    entry to the root's value (reference __init__.py:117-127). ONE
+    py_function broadcasts all variables (submit-all-then-wait, so
+    startup costs one round-trip depth, and cross-worker op scheduling
+    differences can't interleave per-variable rounds)."""
+    gvars = tf.compat.v1.global_variables()
+    if not gvars or not _distributed():
+        return tf.no_op()
+
+    def _bcast_all(*vals):
+        pending = []
+        for i, v in enumerate(vals):
+            host = v.numpy()
+            contrib = host if rank() == root_rank \
+                else np.zeros_like(host)
+            pending.append((_submit(contrib, f"tf1bcast/{i}", False, None),
+                            host.shape, host.dtype))
+        return [_handles.wait_and_clear(h.id).reshape(shape).astype(dt)
+                for h, shape, dt in pending]
+
+    outs = tf.py_function(_bcast_all, [v.value() for v in gvars],
+                          Tout=[v.dtype for v in gvars])
+    if len(gvars) == 1:  # py_function unwraps single-element lists
+        outs = [outs]
+    assigns = [
+        tf.compat.v1.assign(v, tf.reshape(o, tf.shape(v)))
+        for v, o in zip(gvars, outs)
+    ]
+    return tf.group(*assigns)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """SessionRunHook broadcasting all global variables from ``root_rank``
+    after session creation (reference __init__.py:141-173) — consistent
+    init whether training starts from random weights or a checkpoint."""
+
+    def __init__(self, root_rank: int, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.device = device
+        self.bcast_op: Optional[tf.Operation] = None
+
+    def begin(self):
+        if (self.bcast_op is None
+                or self.bcast_op.graph is not
+                tf.compat.v1.get_default_graph()):
+            with tf.device(self.device) if self.device \
+                    else tf.control_dependencies([]):
+                self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
+
+
+class DistributedOptimizer(tf.compat.v1.train.Optimizer):
+    """v1 optimizer wrapper: ``compute_gradients`` push_pulls every
+    gradient before returning it (reference __init__.py:186-240), so
+    ``minimize``/estimator training loops distribute without other code
+    changes. ``apply_gradients`` delegates — except in async mode, where
+    it pushes the post-step weight delta instead (reference
+    __init__.py:246-268)."""
+
+    def __init__(self, optimizer, name: Optional[str] = None,
+                 use_locking: bool = False,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = False):
+        if name is None:
+            name = "Distributed{}".format(type(optimizer).__name__)
+        super().__init__(name=name, use_locking=use_locking)
+        self._optimizer = optimizer
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def compute_gradients(self, *args, **kwargs):
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if not _distributed() or _enable_async():
+            # async: raw local grads; the delta push happens in
+            # apply_gradients against the server's authoritative weights
+            return gradients
+        averaged = []
+        for grad, var in gradients:
+            if grad is None:
+                averaged.append((None, var))
+                continue
+            name = "tf1grad/" + var.name.replace(":", "_")
+            averaged.append((push_pull(
+                grad, scope=self._name, average=True, name=name,
+                compression=self._compression,
+                sparse_as_dense=self._sparse_as_dense), var))
+        return averaged
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        if not _enable_async() or not _distributed():
+            # async without a PS has no authoritative store to fold
+            # deltas into — degrade to the plain optimizer (the module
+            # contract: single-worker/no-PS is identity)
+            return self._optimizer.apply_gradients(grads_and_vars, *args,
+                                                   **kwargs)
+        # async DP: apply locally, then push the weight DELTA — the
+        # server folds it into the authoritative weights and the pull
+        # returns them (no aggregation barrier)
+        gv = list(grads_and_vars)
+        tvars = [v for _, v in gv]
+        # tf.identity snapshots, and apply_op is built UNDER a control
+        # dependency on them: raw v1 graphs have no auto control edges
+        # (unlike tf.function), so without this the Session could read a
+        # variable AFTER the optimizer update and push a zero delta
+        old = [tf.identity(v) for v in tvars]
+        with tf.control_dependencies(old):
+            apply_op = self._optimizer.apply_gradients(gv, *args,
+                                                       **kwargs)
+        with tf.control_dependencies([apply_op]):
+            assigns = []
+            for v, o in zip(tvars, old):
+                delta = tf.subtract(v, o)
+                name = "tf1delta/" + v.name.replace(":", "_")
+                updated = push_pull(delta, scope=self._name, average=False,
+                                    name=name,
+                                    compression=self._compression)
+                assigns.append(tf.compat.v1.assign(v, updated))
+            return tf.group(*assigns)
+
+    # --- pure delegation (reference __init__.py:270-292) ------------- #
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)

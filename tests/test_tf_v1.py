@@ -1,0 +1,124 @@
+"""TF1 graph-mode adapter surface (byteps_tpu/tensorflow/v1.py): the
+compute_gradients-override DistributedOptimizer and
+BroadcastGlobalVariablesHook driving real Sessions — the reference's
+legacy API (tensorflow/__init__.py:141-268). Runs in subprocesses (graph
+mode is process-global state; the TF2 adapter tests must not inherit
+it)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PIN = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "jax.config.update('jax_num_cpu_devices', 8); ")
+
+
+def _run(body: str, env_extra=None, timeout=420):
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           **(env_extra or {})}
+    return subprocess.run([sys.executable, "-c", _PIN + body], cwd=REPO,
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env)
+
+
+_TRAIN_V1 = r"""
+import numpy as np
+import tensorflow as tf
+import byteps_tpu.tensorflow as bps
+from byteps_tpu.tensorflow import v1 as bps_v1
+
+bps.init()
+g = tf.Graph()
+with g.as_default():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 8).astype(np.float32)
+    Y = (X @ np.arange(8, dtype=np.float32)[:, None] * 0.1 + 0.5)
+    x = tf.compat.v1.placeholder(tf.float32, [None, 8])
+    y = tf.compat.v1.placeholder(tf.float32, [None, 1])
+    w = tf.compat.v1.get_variable("w", [8, 1], tf.float32,
+                                  tf.compat.v1.zeros_initializer())
+    b = tf.compat.v1.get_variable("b", [1], tf.float32,
+                                  tf.compat.v1.constant_initializer(7.0))
+    loss = tf.reduce_mean(tf.square(x @ w + b - y))
+    opt = bps_v1.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.1))
+    train_op = opt.minimize(loss)
+    bcast = bps_v1.broadcast_global_variables(0)
+    with tf.compat.v1.Session() as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        sess.run(bcast)
+        l0 = sess.run(loss, {x: X, y: Y})
+        for _ in range(40):
+            sess.run(train_op, {x: X, y: Y})
+        l1 = sess.run(loss, {x: X, y: Y})
+assert l1 < l0 * 0.2, (l0, l1)
+print("v1 train ok", l0, "->", l1)
+bps.shutdown()
+"""
+
+_HOOK_V1 = r"""
+import numpy as np
+import tensorflow as tf
+import byteps_tpu.tensorflow as bps
+from byteps_tpu.tensorflow import v1 as bps_v1
+
+bps.init()
+g = tf.Graph()
+with g.as_default():
+    v = tf.compat.v1.get_variable(
+        "v", [4], tf.float32,
+        tf.compat.v1.constant_initializer(float(bps.rank() + 1)))
+    hook = bps_v1.BroadcastGlobalVariablesHook(root_rank=0)
+    hook.begin()
+    with tf.compat.v1.Session() as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        hook.after_create_session(sess, None)
+        out = sess.run(v)
+# single worker: broadcast-from-root leaves root's value
+assert np.allclose(out, 1.0), out
+print("v1 hook ok", out)
+bps.shutdown()
+"""
+
+
+def test_v1_optimizer_trains_mesh_tier():
+    r = _run(_TRAIN_V1)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "v1 train ok" in r.stdout
+
+
+def test_v1_optimizer_trains_over_ps():
+    """The same graph through a real loopback PS: compute_gradients'
+    py_function hops land in the native client/server path."""
+    sys.path.insert(0, REPO)
+    from byteps_tpu.utils.net import free_port
+
+    port = free_port()
+    env = {"DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+           "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port),
+           "BYTEPS_FORCE_DISTRIBUTED": "1"}
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server"],
+        env={**os.environ, **env, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        r = _run(_TRAIN_V1, env_extra=env)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert "v1 train ok" in r.stdout
+        srv.wait(timeout=30)
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+
+
+def test_v1_broadcast_hook():
+    r = _run(_HOOK_V1)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "v1 hook ok" in r.stdout
