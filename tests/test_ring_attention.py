@@ -116,3 +116,30 @@ def test_llama_sp_trains(bps):
         params, opt, loss = stepj(params, opt, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_llama_sp_chunked_xent_matches_dense_loss(bps):
+    """cfg.xent_chunks composes with sequence parallelism: the chunked
+    loss under sp sharding (ring attention, pre-shifted batch) equals
+    the unsharded dense loss — the pmean-of-local-means reduction is
+    identical on both loss paths."""
+    import dataclasses
+    mesh = get_state().mesh
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64, seq=64),
+                              dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 65))
+    batch_full = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    ref = llama.loss_fn(params, batch_full, cfg)
+
+    cfg_ck = dataclasses.replace(cfg, xent_chunks=4)
+    sharded = {"inputs": jnp.asarray(tokens[:, :-1], jnp.int32),
+               "targets": jnp.asarray(tokens[:, 1:], jnp.int32)}
+    loss_sp = jax.jit(jax.shard_map(
+        lambda p, b: llama.loss_fn(p, b, cfg_ck,
+                                   attn_impl=make_ring_attn(axis="dp"),
+                                   sp_axis="dp"),
+        mesh=mesh, in_specs=(P(), P(None, "dp")), out_specs=P(),
+        check_vma=False))
+    got = loss_sp(params, sharded)
+    np.testing.assert_allclose(float(got), float(ref), rtol=5e-4)
