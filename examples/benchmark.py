@@ -57,7 +57,8 @@ def build(model: str, batch_size: int, tiny: bool = False):
         cfg = resnet.ResNetConfig.tiny() if tiny \
             else resnet.ResNetConfig.resnet50()
         params, bn_state = resnet.init_params(key, cfg)
-        batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
+        sz = 64 if tiny else 224  # global-pooled: any size is valid
+        batch = {"x": jnp.asarray(rng.rand(batch_size, sz, sz, 3),
                                   jnp.float32),
                  "y": jnp.asarray(rng.randint(0, cfg.n_classes, batch_size),
                                   jnp.int32)}
@@ -75,7 +76,8 @@ def build(model: str, batch_size: int, tiny: bool = False):
         # by fc layers; its largest reported wins, docs/performance.md:9)
         cfg = vgg.VGGConfig.tiny() if tiny else vgg.VGGConfig.vgg16()
         params = vgg.init_params(key, cfg)
-        batch = {"x": jnp.asarray(rng.rand(batch_size, 224, 224, 3),
+        sz = cfg.image_size  # the fc stack is sized for it (flatten)
+        batch = {"x": jnp.asarray(rng.rand(batch_size, sz, sz, 3),
                                   jnp.float32),
                  "y": jnp.asarray(rng.randint(0, cfg.n_classes, batch_size),
                                   jnp.int32)}

@@ -105,3 +105,18 @@ def test_torch_train_distributed_ps():
         finally:
             if srv.poll() is None:
                 srv.kill()
+
+
+def test_benchmark_model_zoo_tiny():
+    """examples/benchmark.py --tiny across the model zoo (the reference's
+    benchmark vehicle covers its zoo the same way); bert has a dedicated
+    smoke in test_bert_ps.py — this covers the rest."""
+    for model in ("mlp", "resnet50", "vgg16", "moe", "llama"):
+        r = _run_example(
+            "benchmark.py",
+            ["--model", model, "--tiny", "--num-iters", "1",
+             "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+             "--batch-size", "8"])
+        assert r.returncode == 0, \
+            (model, r.stdout[-2000:] + r.stderr[-2000:])
+        assert "img/sec" in r.stdout, (model, r.stdout[-500:])
