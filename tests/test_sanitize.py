@@ -51,6 +51,18 @@ def worker(w):
             x = rng.randn(3000).astype(np.float32)
             c.push_pull(ctx, x, average=True, num_workers=2)
         ct.push_pull(rng.randn(2048).astype(np.float32))
+        # async-push path (detached waiters drain in RecvLoop while the
+        # paired pull waits on the same key-affine conn): the round-4
+        # concurrency addition, stressed under the sanitizer like the
+        # rest of the protocol
+        actx = ctxs[step % len(ctxs)]
+        for p in actx.partitions:
+            c.zpush_async(p.server, p.key,
+                          rng.randn(p.length // 4).astype(np.float32)
+                          .view(np.uint8), CMD)
+        for p in actx.partitions:
+            out = np.empty(p.length, np.uint8)
+            c.zpull(p.server, p.key, out, CMD)
         c.barrier()
 
 threads = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
