@@ -58,10 +58,9 @@ def worker(w):
         actx = ctxs[step % len(ctxs)]
         for p in actx.partitions:
             c.zpush_async(p.server, p.key,
-                          rng.randn(p.length // 4).astype(np.float32)
-                          .view(np.uint8), CMD)
+                          rng.randn(p.length // 4).astype(np.float32), CMD)
         for p in actx.partitions:
-            out = np.empty(p.length, np.uint8)
+            out = np.empty(p.length // 4, np.float32)
             c.zpull(p.server, p.key, out, CMD)
         c.barrier()
 
