@@ -1,0 +1,85 @@
+"""TF1 Session-mode training example (reference
+example/tensorflow/tensorflow_mnist.py shape): the classic v1 loop —
+placeholders, ``minimize()``, ``MonitoredTrainingSession`` with
+``BroadcastGlobalVariablesHook`` — distributed by wrapping the optimizer
+in ``byteps_tpu.tensorflow.v1.DistributedOptimizer``.
+
+    python examples/tf1_train.py --steps 50
+
+Gradients ride the same comm path as the TF2 adapter (py_function hop
+into the host scheduler; the DCN PS when DMLC_NUM_SERVER > 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import byteps_tpu.tensorflow as bps  # noqa: E402
+from byteps_tpu.tensorflow import v1 as bps_v1  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    bps.init()
+    # ONE shared dataset (fixed seed) — but per-rank batch SAMPLING:
+    # each worker must draw different minibatches or the gradient
+    # average degenerates to one worker's gradient
+    data_rng = np.random.RandomState(1234)
+    X = data_rng.rand(512, 784).astype(np.float32)
+    W_true = data_rng.randn(784, 10).astype(np.float32)
+    Y = np.argmax(X @ W_true, -1).astype(np.int64)
+    rng = np.random.RandomState(4321 + bps.rank())
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 784])
+        y = tf.compat.v1.placeholder(tf.int64, [None])
+        w = tf.compat.v1.get_variable(
+            "w", [784, 10], tf.float32,
+            tf.compat.v1.glorot_uniform_initializer(seed=0))
+        b = tf.compat.v1.get_variable("b", [10], tf.float32,
+                                      tf.compat.v1.zeros_initializer())
+        logits = x @ w + b
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+        opt = bps_v1.DistributedOptimizer(
+            tf.compat.v1.train.GradientDescentOptimizer(args.lr))
+        global_step = tf.compat.v1.train.get_or_create_global_step()
+        train_op = opt.minimize(loss, global_step=global_step)
+
+        hooks = [bps_v1.BroadcastGlobalVariablesHook(root_rank=0),
+                 tf.compat.v1.train.StopAtStepHook(last_step=args.steps)]
+        final = None
+        with tf.compat.v1.train.MonitoredTrainingSession(
+                hooks=hooks) as sess:
+            i = 0
+            while not sess.should_stop():
+                hi = max(1, 512 - args.batch_size + 1)
+                lo = rng.randint(0, hi)
+                feed = {x: X[lo:lo + args.batch_size],
+                        y: Y[lo:lo + args.batch_size]}
+                _, final = sess.run([train_op, loss], feed)
+                if bps.rank() == 0 and i % 10 == 0:
+                    print(f"step {i}: loss {final:.4f}", flush=True)
+                i += 1
+    if bps.rank() == 0 and final is not None:
+        print(f"final loss {final:.4f}", flush=True)
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,11 @@ def test_benchmark_model_zoo_tiny():
         assert r.returncode == 0, \
             (model, r.stdout[-2000:] + r.stderr[-2000:])
         assert "img/sec" in r.stdout, (model, r.stdout[-500:])
+
+
+def test_tf1_train_runs():
+    """The v1 Session example (MonitoredTrainingSession + broadcast hook
+    + v1 DistributedOptimizer) trains."""
+    r = _run_example("tf1_train.py", ["--steps", "30"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "final loss" in r.stdout, r.stdout[-500:]
