@@ -1,0 +1,424 @@
+"""byteps_tpu.mxnet — Horovod-style MXNet adapter over the DCN PS.
+
+Reference parity (byteps/mxnet/__init__.py:35-360):
+
+- ``DistributedOptimizer`` — delegation wrapper around any
+  ``mx.optimizer.Optimizer``: sync mode push_pulls each gradient
+  (averaged) before the local update; async mode
+  (``BYTEPS_ENABLE_ASYNC``) updates locally, pushes the WEIGHT DELTA
+  (sum, no average) and pulls the server's authoritative weights back —
+  the server-side async-PS mode byteps_tpu.server implements.
+- ``DistributedTrainer`` — a ``mx.gluon.Trainer`` subclass whose
+  ``_allreduce_grads`` rides the priority pipeline: grads are
+  pre-scaled by 1/(batch*size), pushed as SUMs in declaration order
+  (priority=-index), intra-node compressed (fp16 / NAG / wd-momentum
+  wrappers from .compression), and per-parameter ``byteps_*``
+  attributes route the server-side codec (onebit/topk/randomk/
+  dithering with EF + momentum) exactly like the reference's
+  compression_params contract.
+- ``broadcast_parameters`` — zero-non-root + push_pull(sum).
+- ``lr.s`` — local-rank-0 publishes the current learning rate as a
+  little 8-byte double file every step (the reference's mmap channel
+  for error-feedback lr rescale, mxnet/__init__.py:326-331). The TPU
+  rebuild's EF rescale natively lives in the codec stack
+  (ops/compression/feedback.py), so the file is a compatibility
+  artifact kept for tooling parity.
+
+TPU-native note: MXNet is a host-side framework here (no MXNet TPU
+backend exists); gradients hop host->PS->host through the same
+priority-scheduled pipeline the torch/TF adapters use, proving the
+one-comm-stack/N-frameworks plugin boundary. MXNet itself is imported
+lazily so the module can be inspected without it installed.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import struct
+import warnings
+
+from .compression import Compression
+from .ops import (byteps_declare_tensor, byteps_push_pull,
+                  byteps_push_pull_async, init, local_rank, local_size,
+                  poll, rank, resume, shutdown, size, suspend, synchronize)
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "byteps_declare_tensor", "byteps_push_pull", "byteps_push_pull_async",
+    "poll", "synchronize",
+    "DistributedOptimizer", "DistributedTrainer", "broadcast_parameters",
+    "Compression",
+]
+
+parameter_index = 0
+
+
+def _mx():
+    import mxnet as mx
+    return mx
+
+
+def _base_trainer():
+    return _mx().gluon.Trainer
+
+
+class DistributedOptimizer:
+    """Wrap ``optimizer`` so every update first aggregates gradients
+    across workers (sync) or reconciles weights through the async PS
+    (``BYTEPS_ENABLE_ASYNC=1``). KVStore-style ``update``/
+    ``update_multi_precision`` hook points, delegation for everything
+    else (reference mxnet/__init__.py:35-122)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._seeded = set()
+        self._pool = None
+        self._enable_async = (
+            int(os.getenv("BYTEPS_ENABLE_ASYNC", 0)) != 0)
+        if self._enable_async:
+            assert int(os.getenv("DMLC_NUM_WORKER", "1")) > 1, \
+                "async training requires more than one worker"
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state(self, index, weight):
+        return self._optimizer.create_state(index, weight)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    # -- push_pull plumbing ------------------------------------------- #
+
+    def _push_pull_grads(self, index, grad):
+        indices = index if isinstance(index, (tuple, list)) else [index]
+        grads = grad if isinstance(grad, (tuple, list)) else [grad]
+        handles = []
+        for i, g in zip(indices, grads):
+            # own name space: a gluon DistributedTrainer in the same
+            # process declares gradient_{i} for ITS params; sharing the
+            # prefix would alternate two differently-shaped tensors under
+            # one first-wins PS key
+            nm = f"kv_gradient_{i}"
+            byteps_declare_tensor(nm)
+            handles.append(byteps_push_pull_async(
+                g, version=0, priority=-int(i), name=nm, is_average=True))
+        for h in handles:
+            synchronize(h)
+
+    def _push_pull_deltas(self, index, delta_weight, before):
+        """Async mode: push weight DELTAs through the async-PS protocol
+        (the server folds them into its authoritative weights, no round
+        barrier) and write the pulled weights back into the arrays. The
+        server store is first seeded with the PRE-update weights
+        (init-push defaults to zeros, first arrival wins) — the same
+        bootstrap as the JAX async path (jax/train.py init_weights +
+        push_delta_pull_weights)."""
+        import concurrent.futures
+
+        import numpy as np
+
+        from ..core.state import get_state
+        from ..server.client import get_or_init_ctx
+
+        indices = index if isinstance(index, (tuple, list)) else [index]
+        deltas = (delta_weight if isinstance(delta_weight, (tuple, list))
+                  else [delta_weight])
+        state = get_state()
+        if state.ps_client is None:
+            # no PS configured: the local update stands — the arrays hold
+            # deltas right now, so restore weight = before + delta
+            for d, b in zip(deltas, before):
+                d += b
+            return
+
+        def _host(t):
+            return np.ascontiguousarray(
+                t.asnumpy() if hasattr(t, "asnumpy") else t,
+                np.float32).reshape(-1)
+
+        jobs = []
+        for i, d, b in zip(indices, deltas, before):
+            nm = f"weight_{i}"
+            byteps_declare_tensor(nm)
+            host_d = _host(d)
+            ctx = get_or_init_ctx(state, nm, host_d)
+            if nm not in self._seeded:
+                state.ps_client.init_weights(ctx, _host(b))
+                self._seeded.add(nm)
+            jobs.append((d, ctx, host_d))
+        # overlap the per-param round trips (they'd otherwise serialize
+        # the step on sum-of-RTTs); one long-lived pool — per-step
+        # spawn/join would sit on the hot path
+        pool = self._pool
+        if pool is None:
+            pool = self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="bps-mx-async")
+        outs = list(pool.map(
+            lambda j: state.ps_client.push_delta_pull_weights(
+                j[1], j[2]), jobs))
+        for (d, _, _), out in zip(jobs, outs):
+            d[:] = out.reshape(d.shape)
+        state.telemetry.record(sum(j[2].nbytes * 2 for j in jobs))
+
+    def _update_impl(self, index, weight, grad, state, multi: bool):
+        upd = (self._optimizer.update_multi_precision if multi
+               else self._optimizer.update)
+        if self._enable_async:
+            weights = (weight if isinstance(weight, (tuple, list))
+                       else [weight])
+            before = [w.copy() for w in weights]
+            upd(index, weight, grad, state)
+            # weight now holds the local post-update value; turn it into
+            # the delta, push it, and the pull brings back the server's
+            # authoritative weights into the same arrays
+            for w, b in zip(weights, before):
+                w -= b
+            self._push_pull_deltas(
+                index,
+                weights if isinstance(weight, (tuple, list))
+                else weights[0],
+                before)
+        else:
+            self._push_pull_grads(index, grad)
+            upd(index, weight, grad, state)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi=True)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Make every worker's copy equal to the root's: non-root contributions
+    are zeroed and the PS sum therefore equals the root value (reference
+    mxnet/__init__.py:124-161). ``params``: dict name -> NDArray (e.g.
+    ``Module.get_params()[0]``). Gluon users should rely on
+    ``DistributedTrainer`` instead (it broadcasts at the first step)."""
+    global parameter_index
+    if not isinstance(params, dict):
+        raise ValueError(f"invalid params of type {type(params)}: "
+                         "pass a dict of name -> NDArray (gluon parameters "
+                         "are broadcast by DistributedTrainer)")
+    tensors = [p for _, p in sorted(params.items())]
+    handles = []
+    for t in tensors:
+        # own name space: the trainer declares parameter_{i} for its own
+        # params; sharing the prefix (as the reference does) collides PS
+        # keys between differently-shaped tensors when both surfaces are
+        # used in one process
+        nm = f"broadcast_parameter_{parameter_index}"
+        parameter_index += 1
+        byteps_declare_tensor(nm)
+        if rank() != root_rank:
+            t *= 0
+        handles.append(byteps_push_pull_async(
+            t, version=0, priority=0, name=nm, is_average=False))
+    for h in handles:
+        synchronize(h)
+    for t in tensors:
+        if hasattr(t, "wait_to_read"):
+            t.wait_to_read()
+
+
+class _DistributedTrainerMixin:
+    """The DistributedTrainer body; mixed onto mx.gluon.Trainer lazily so
+    importing this module never requires mxnet itself."""
+
+    def _bps_setup(self, params, optimizer, optimizer_params,
+                   root_rank, compression_params):
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+            warnings.warn("DistributedTrainer takes the raw optimizer, not "
+                          "DistributedOptimizer; unwrapped it for you")
+
+        if hasattr(params, "items"):   # ParameterDict / dict
+            param_list = [params[k] for k in sorted(params.keys())]
+        else:
+            param_list = list(params)
+
+        optimizer_params = dict(optimizer_params or {})
+        intra = self._register_compressor(param_list, optimizer_params,
+                                          compression_params)
+        return param_list, optimizer, optimizer_params, intra
+
+    def _bps_finish_init(self, param_list, intra, root_rank):
+        self._f = None
+        self._f_path = None
+        if local_rank() == 0:
+            self._f_path = os.path.abspath("lr.s")
+            self._f = open(self._f_path, "wb")
+            self._f.truncate(8)
+        self._bps_size = size()
+        self.root_rank = root_rank
+        self._intra_compressors = {}
+        for i, param in enumerate(self._params):
+            byteps_declare_tensor(f"parameter_{i}")
+            self._intra_compressors[param.name] = copy.deepcopy(intra)
+            if param.grad_req != "null":
+                byteps_params = {
+                    k: v for k, v in param.__dict__.items()
+                    if k.startswith("byteps_")}
+                byteps_declare_tensor(f"gradient_{i}", **byteps_params)
+
+    def __del__(self):
+        f = getattr(self, "_f", None)
+        if f is not None:
+            try:
+                f.close()
+                # absolute path recorded at open time — a later chdir
+                # must not make this delete some other trainer's lr.s
+                if os.path.exists(self._f_path):
+                    os.remove(self._f_path)
+            except Exception:
+                pass  # interpreter teardown: os may already be gone
+
+    def _register_compressor(self, param_list, optimizer_params,
+                             compression_params):
+        """Translate the compression_params dict into per-parameter
+        ``byteps_*`` attributes (consumed by byteps_declare_tensor) plus
+        the intra-node wrapper stack; strips momentum/wd from
+        optimizer_params when the comm stack takes them over (reference
+        mxnet/__init__.py:236-317)."""
+        intra = Compression.none
+        if not compression_params:
+            return intra
+        if compression_params.get("fp16"):
+            if "compressor" in compression_params:
+                # the server-side codecs are f32 transforms; an fp16 wire
+                # tensor would silently fall back to the dense path and
+                # lose the codec AND its momentum stage
+                warnings.warn("fp16 intra-compression is incompatible "
+                              "with a server-side compressor; ignoring "
+                              "fp16")
+            else:
+                intra = Compression.fp16
+        if "compressor" not in compression_params:
+            if not compression_params.get("fp16"):
+                warnings.warn("compression_params without a 'compressor' "
+                              "entry — only intra-node fp16 applies")
+            return intra
+
+        compressor = compression_params["compressor"]
+        for param in param_list:
+            for item in ("compressor", "ef", "momentum"):
+                val = compression_params.get(item)
+                if val:
+                    if not isinstance(val, str):
+                        raise TypeError(f"{item} should be str")
+                    setattr(param, f"byteps_{item}_type", val)
+            if compressor == "onebit":
+                setattr(param, "byteps_compressor_onebit_scaling",
+                        str(compression_params.get("scaling", False)))
+            elif compressor in ("topk", "randomk", "dithering"):
+                setattr(param, "byteps_compressor_k",
+                        compression_params["k"])
+            if compression_params.get("momentum"):
+                setattr(param, "byteps_momentum_mu",
+                        optimizer_params["momentum"])
+            if compression_params.get("seed") is not None:
+                setattr(param, "byteps_seed", compression_params["seed"])
+            partition = compression_params.get("partition")
+            if partition:
+                if partition not in ("linear", "natural"):
+                    raise ValueError(f"unsupported partition {partition!r}")
+                setattr(param, "byteps_dithering_partition", partition)
+            normalize = compression_params.get("normalize")
+            if normalize:
+                if normalize not in ("max", "l2"):
+                    raise ValueError(f"unsupported normalize {normalize!r}")
+                setattr(param, "byteps_dithering_normalize", normalize)
+
+        if compression_params.get("momentum"):
+            # the SAME resolver the codec tier uses — a divergent default
+            # would leave a size band with momentum from neither tier
+            from ..ops.compression import _resolve_min_compress_bytes
+            threshold = _resolve_min_compress_bytes(None)
+            mu = optimizer_params["momentum"]
+            if compressor == "onebit" and "wd" in optimizer_params:
+                intra = Compression.wdmom(intra, mu,
+                                          optimizer_params.pop("wd"),
+                                          threshold)
+            intra = Compression.nag(intra, mu, threshold)
+            del optimizer_params["momentum"]
+        return intra
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        # gluon normalizes grads by _scale; setting it to batch_size keeps
+        # the division from happening twice (we fold it into the pre-push
+        # scaling below)
+        self._scale = batch_size
+        super().step(batch_size, ignore_stale_grad)
+
+    def _allreduce_grads(self):
+        if self._f is not None:
+            self._f.seek(0)
+            self._f.write(struct.pack("d", self.learning_rate))
+            self._f.flush()
+
+        # submit every gradient async in declaration order, then drain:
+        # the pipeline overlaps PUSH/PULL across parameters (the engine-
+        # dependency overlap the reference gets from MXEnginePushAsync)
+        inflight = []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            grad = param._grad[0]
+            grad *= 1.0 / (self._scale * self._bps_size)
+            comp = self._intra_compressors[param.name]
+            compressed, ctx = comp.compress(grad)
+            h = byteps_push_pull_async(compressed, is_average=False,
+                                       name=f"gradient_{i}", priority=-i)
+            inflight.append((param, comp, compressed, ctx, h))
+        for param, comp, compressed, ctx, h in inflight:
+            synchronize(h)
+            param._grad[0][:] = comp.decompress(compressed, ctx,
+                                                x=param._data[0])
+
+    def _init_params(self):
+        """First-step broadcast: push root's values, zeroed elsewhere
+        (reference mxnet/__init__.py:344-360); deferred-init parameters
+        stay queued."""
+        deferred = []
+        for param in self._params_to_init:
+            if getattr(param, "_deferred_init", False):
+                deferred.append(param)
+                continue
+            idx = self._param2idx[param.name]
+            arr = param._data[0]
+            if rank() != self.root_rank:
+                arr *= 0
+            byteps_push_pull(arr, version=0, priority=0,
+                             name=f"parameter_{idx}", is_average=False)
+        self._params_to_init = deferred
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       root_rank: int = 0, compression_params=None):
+    """Build the gluon DistributedTrainer (reference
+    mxnet/__init__.py:164-343). Implemented as a factory so the gluon base
+    class is only resolved when MXNet is actually present."""
+    Trainer = _base_trainer()
+
+    cls = type("DistributedTrainer", (_DistributedTrainerMixin, Trainer), {})
+    self = cls.__new__(cls)
+    param_list, opt, opt_params, intra = self._bps_setup(
+        params, optimizer, optimizer_params, root_rank, compression_params)
+    Trainer.__init__(self, param_list, opt, optimizer_params=opt_params,
+                     kvstore=None)
+    if not hasattr(self, "_param2idx"):
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
+    self._bps_finish_init(param_list, intra, root_rank)
+    return self

@@ -1,0 +1,155 @@
+"""Intra-node ("pre-core") gradient transforms for the MXNet adapter.
+
+Reference parity: byteps/mxnet/compression.py:26-164 — a tiny Compressor
+interface (none / fp16) plus two optimizer-math wrappers that the
+DistributedTrainer stacks around the wire when ``compression_params``
+asks for momentum: NAG for tensors SMALL enough to skip the server-side
+codec (the codec tier applies its own momentum there), and the
+weight-decay momentum used with onebit.
+
+TPU-native note: these run on the HOST tier (the gradient is already a
+host array on its way to the DCN PS), so the math is written against the
+duck-typed NDArray surface (``astype`` / arithmetic) and works unchanged
+on real ``mx.nd.NDArray``s and numpy arrays — no ``nd._internal``
+engine-op calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _nbytes(tensor) -> int:
+    """Size in BYTES — the threshold unit (BYTEPS_MIN_COMPRESS_BYTES),
+    matching the codec tier's per-partition byte test
+    (server/compressed.py) so a tensor is never momentum'd twice."""
+    return _numel(tensor.shape) * np.dtype(tensor.dtype).itemsize
+
+
+class Compressor:
+    """Interface: ``compress`` before the wire, ``decompress`` after."""
+
+    def compress(self, tensor, *args, **kwargs):
+        raise NotImplementedError
+
+    def decompress(self, tensor, ctx, *args, **kwargs):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (the default)."""
+
+    def compress(self, tensor, *args, **kwargs):
+        return tensor, None
+
+    def decompress(self, tensor, ctx, *args, **kwargs):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Ship float gradients as 16-bit halves; restore the original dtype
+    on the way back (reference compression.py:50-67)."""
+
+    def compress(self, tensor, *args, **kwargs):
+        dtype = tensor.dtype
+        if "float" in str(dtype):
+            return tensor.astype("float16", copy=False), dtype
+        return tensor, dtype
+
+    def decompress(self, tensor, ctx, *args, **kwargs):
+        dtype = ctx
+        if dtype is not None and "float" in str(dtype):
+            return tensor.astype(dtype, copy=False)
+        return tensor
+
+
+class NagAdapter(Compressor):
+    """Nesterov momentum applied on the worker for tensors BELOW the
+    compression threshold (reference compression.py:70-101): the
+    server-side codec stack owns momentum for large tensors
+    (ops/compression/host.py HostNesterovMomentum), so small/uncompressed
+    ones replicate it locally to keep the optimizer math uniform after
+    ``momentum`` was stripped from optimizer_params."""
+
+    def __init__(self, compressor: Compressor, mu: float, threshold: int):
+        self.compressor = compressor
+        self.mu = float(mu)
+        self.threshold = int(threshold)
+        self.mom = None
+        self._apply = False
+        self._inited = False
+
+    def compress(self, tensor, *args, **kwargs):
+        return self.compressor.compress(tensor)
+
+    def decompress(self, tensor, ctx, *args, **kwargs):
+        tensor = self.compressor.decompress(tensor, ctx, *args, **kwargs)
+        if not self._inited:
+            self._apply = _nbytes(tensor) < self.threshold
+            if self._apply:
+                self.mom = tensor * 0
+            self._inited = True
+        if self._apply:
+            # m <- mu * (m + g); g <- g + m   (NAG lookahead form)
+            self.mom += tensor
+            self.mom *= self.mu
+            tensor += self.mom
+        return tensor
+
+
+class WeightDecayMomentumAdapter(Compressor):
+    """Weight-decay momentum for onebit (reference compression.py:104-147):
+    with ``wd`` stripped from the optimizer, the worker adds
+    m_t = mu*m_{t-1} + wd*x_t to the AGGREGATED gradient after the pull
+    (this wrapper runs outside the wire codec, in the reference too — the
+    sign codec quantizes the undecayed gradient; the decay reaches the
+    optimizer update). Needs the current weight via ``decompress(x=...)``.
+    Applied only ABOVE the threshold (where onebit actually runs)."""
+
+    def __init__(self, compressor: Compressor, mu: float, wd: float,
+                 threshold: int):
+        self.compressor = compressor
+        self.mu = float(mu)
+        self.wd = float(wd)
+        self.threshold = int(threshold)
+        self.mom = None
+        self._apply = False
+        self._inited = False
+
+    def compress(self, tensor, *args, **kwargs):
+        return self.compressor.compress(tensor)
+
+    def decompress(self, tensor, ctx, *args, **kwargs):
+        if "x" not in kwargs:
+            raise ValueError("WeightDecayMomentumAdapter.decompress needs "
+                             "the weight as x=")
+        x = kwargs.pop("x").astype(tensor.dtype, copy=False)
+        if not self._inited:
+            self._apply = _nbytes(tensor) >= self.threshold
+            if self._apply:
+                self.mom = tensor * 0
+            self._inited = True
+        decay = x * self.wd
+        if self._apply:
+            self.mom += decay
+            self.mom *= self.mu
+            tensor += self.mom
+        tensor += decay
+        return self.compressor.decompress(tensor, ctx, *args, **kwargs)
+
+
+class Compression:
+    """Namespace the trainer/optimizer surface exposes
+    (reference compression.py:149-164)."""
+
+    none = NoneCompressor()
+    fp16 = FP16Compressor()
+    nag = NagAdapter
+    wdmom = WeightDecayMomentumAdapter

@@ -1,0 +1,270 @@
+"""byteps_tpu.mxnet.ops — the MXNet op surface over the DCN PS.
+
+Reference parity: byteps/mxnet/ops.py:28-123 — ``byteps_declare_tensor``
+(carrying per-tensor ``byteps_*`` compression kwargs into the core) and
+``byteps_push_pull`` (an in-place engine op keyed by declared name,
+scheduled by declaration-order priority, mxnet/ops.cc:120-160).
+
+TPU-native redesign: there is no ``MXEnginePushAsync`` dependency chain
+to splice into — the priority-scheduled COMPRESS→PUSH→PULL→DECOMPRESS
+pipeline (core/scheduler.py) IS the engine. ``byteps_push_pull_async``
+submits the host array through it and returns an int handle;
+``synchronize`` writes the cross-worker aggregate back INTO the NDArray
+(the reference's in-place contract). The declared ``byteps_*`` kwargs
+are translated to the shared codec-registry names
+(ops/compression/host.make_host_codec — the same parameters the
+reference's compressor_registry.cc parses from the kwargs bag,
+common/__init__.py:102-135 there) and ride the compressed pipeline via
+server.compressed.CompressedRegistry.
+
+This module is framework-agnostic by design: it only touches the
+duck-typed NDArray surface (``.asnumpy()`` / ``tensor[:] = ndarray`` /
+``.dtype``), so real ``mx.nd.NDArray``s, the test tier's fake, and raw
+numpy arrays all work.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.state import get_state
+from ..core.types import DataType
+from ..utils.logging import log
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "byteps_declare_tensor", "byteps_push_pull",
+    "byteps_push_pull_async", "poll", "synchronize",
+]
+
+
+def init(*args, **kwargs) -> None:
+    get_state().init(*args, **kwargs)
+
+
+def shutdown() -> None:
+    get_state().shutdown()
+    reset_declarations()
+
+
+def suspend() -> None:
+    get_state().suspend()
+
+
+def resume(num_workers: int, num_servers: int,
+           global_rank: Optional[int] = None) -> None:
+    get_state().resume(num_workers, num_servers, global_rank)
+
+
+def rank() -> int:
+    return get_state().rank()
+
+
+def size() -> int:
+    return get_state().size()
+
+
+def local_rank() -> int:
+    return get_state().local_rank()
+
+
+def local_size() -> int:
+    return get_state().local_size()
+
+
+# --------------------------------------------------------------------- #
+# declaration table (mxnet/ops.py:83-101: name -> key order + comp kwargs)
+# --------------------------------------------------------------------- #
+
+_mu = threading.Lock()
+_decl: Dict[str, dict] = {}        # name -> {index, comp}
+_comp_regs: Dict[str, object] = {}  # name -> CompressedRegistry
+_pending: Dict[int, tuple] = {}    # handle -> (kind, ndarray, shape, dtype)
+_imm_next = [-1]                   # immediate-handle ids (negative space)
+
+_DITHER_PARTITION = {"0": "linear", "1": "natural",
+                     "linear": "linear", "natural": "natural"}
+_DITHER_NORMALIZE = {"0": "max", "1": "l2", "max": "max", "l2": "l2"}
+
+
+def reset_declarations() -> None:
+    """Drop the declaration/codec tables (new PS session = new keys)."""
+    with _mu:
+        _decl.clear()
+        _comp_regs.clear()
+        _pending.clear()
+
+
+def _codec_kwargs(byteps_params: dict) -> Optional[dict]:
+    """byteps_* attribute bag -> shared codec-registry kwargs (the same
+    translation the reference core does when the kwargs reach
+    byteps_declare_tensor, mxnet/ops.cc:139-160)."""
+    m: Dict[str, str] = {}
+    for k, v in byteps_params.items():
+        v = str(v)
+        if k == "byteps_compressor_type":
+            m["compressor"] = v
+        elif k == "byteps_ef_type":
+            m["ef"] = v
+        elif k == "byteps_momentum_type":
+            m["momentum"] = v
+        elif k == "byteps_momentum_mu":
+            m["momentum_mu"] = v
+        elif k == "byteps_compressor_k":
+            m["k"] = v
+        elif k == "byteps_seed":
+            m["seed"] = v
+        elif k == "byteps_compressor_onebit_scaling":
+            m["scaling"] = v
+        elif k == "byteps_dithering_partition":
+            m["partition_type"] = _DITHER_PARTITION[v]
+        elif k == "byteps_dithering_normalize":
+            m["normalize_type"] = _DITHER_NORMALIZE[v]
+        elif k.startswith("byteps_"):
+            log.warning("ignoring unknown compression kwarg %s", k)
+    return m if "compressor" in m else None
+
+
+def byteps_declare_tensor(name: str, **kwargs) -> None:
+    """Declare ``name`` so its PS key is assigned in declaration order
+    (deterministic across workers) and record any ``byteps_*`` compression
+    kwargs for its pushes. Idempotent — the reference re-declares on every
+    optimizer update (mxnet/__init__.py:53-60)."""
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError("byteps_tpu.mxnet: init() must be called first")
+    comp = _codec_kwargs(kwargs)
+    with _mu:
+        prev = _decl.get(name)
+        if prev is not None:
+            if comp is not None and prev["comp"] != comp:
+                # first declaration wins (keys and codec configs must be
+                # stable across workers); silent divergence would be a
+                # debugging trap
+                log.warning(
+                    "tensor %r was already declared with different "
+                    "compression kwargs; keeping the first declaration",
+                    name)
+            return
+        _decl[name] = {"index": len(_decl), "comp": comp}
+    state.registry.declare(name, DataType.FLOAT32)
+
+
+def _as_host(tensor) -> np.ndarray:
+    if hasattr(tensor, "asnumpy"):
+        return np.ascontiguousarray(tensor.asnumpy())
+    return np.ascontiguousarray(tensor)
+
+
+def _write_back(tensor, arr: np.ndarray) -> None:
+    if hasattr(tensor, "asnumpy"):
+        tensor[:] = arr
+    else:
+        np.copyto(tensor, arr)
+
+
+def byteps_push_pull_async(tensor, version: int = 0,
+                           priority: Optional[int] = 0,
+                           name: Optional[str] = None,
+                           is_average: bool = True) -> int:
+    """Submit an async in-place push_pull of ``tensor``; returns an int
+    handle for ``synchronize``/``poll``. Compressed when the name was
+    declared with compressor kwargs (f32 only — the codecs are f32
+    transforms, as in the reference), dense otherwise; identity when
+    single-worker with no PS."""
+    if name is None:
+        raise ValueError("byteps_push_pull requires a declared name "
+                         "(keys must match across workers)")
+    state = get_state()
+    if not state.initialized:
+        raise RuntimeError("byteps_tpu.mxnet: init() must be called first")
+    with _mu:
+        entry = _decl.get(name)
+    if entry is None:
+        byteps_declare_tensor(name)
+        with _mu:
+            entry = _decl[name]
+
+    host = _as_host(tensor)
+    flat = host.reshape(-1)
+
+    if state.scheduler is None:
+        # single worker, no PS: sum over one contributor == identity
+        with _mu:
+            hid = _imm_next[0]
+            _imm_next[0] -= 1
+            _pending[hid] = ("imm", tensor, host.shape, host.dtype)
+        return hid
+
+    if entry["comp"] is not None and flat.dtype == np.float32:
+        reg = _comp_regs.get(name)
+        if reg is None:
+            from ..ops.compression import _resolve_min_compress_bytes
+            from ..server.compressed import CompressedRegistry
+            reg = CompressedRegistry(state.ps_client,
+                                     state.config.num_workers,
+                                     entry["comp"],
+                                     _resolve_min_compress_bytes(None))
+            with _mu:
+                _comp_regs.setdefault(name, reg)
+                reg = _comp_regs[name]
+        hid = reg.push_pull_async(state, name, flat, average=is_average,
+                                  priority=priority)
+    else:
+        from ..server.client import get_or_init_ctx
+        ctx = get_or_init_ctx(state, name, flat)
+        handle = state.handles.allocate(name)
+        handle._shape = host.shape
+        state.scheduler.submit(ctx, flat, handle, is_average,
+                               state.config.num_workers,
+                               version=state.next_version(name),
+                               priority=priority)
+        hid = handle.id
+    with _mu:
+        _pending[hid] = ("sched", tensor, host.shape, host.dtype)
+    return hid
+
+
+def poll(handle: int) -> bool:
+    if handle < 0:
+        return True
+    return get_state().handles.poll(handle)
+
+
+def synchronize(handle: int, timeout: Optional[float] = None):
+    """Block until the push_pull behind ``handle`` completes and write the
+    aggregate back into the submitted NDArray; returns it. The pending
+    entry survives a timeout so the call can be retried."""
+    with _mu:
+        entry = _pending.get(handle)
+    if entry is None:
+        raise KeyError(f"unknown or already-synchronized push_pull "
+                       f"handle {handle}")
+    kind, tensor, shape, dtype = entry
+    if kind == "imm":
+        with _mu:
+            _pending.pop(handle, None)
+        return tensor
+    out = get_state().handles.wait_and_clear(handle, timeout)
+    with _mu:
+        _pending.pop(handle, None)
+    arr = out.reshape(shape)
+    if arr.dtype != dtype:
+        arr = arr.astype(dtype)
+    _write_back(tensor, arr)
+    return tensor
+
+
+def byteps_push_pull(tensor, version: int = 0,
+                     priority: Optional[int] = 0,
+                     name: Optional[str] = None,
+                     is_average: bool = True):
+    """Synchronous in-place push_pull (reference mxnet/ops.py:28-60
+    semantics: the NDArray holds the cross-worker aggregate on return)."""
+    h = byteps_push_pull_async(tensor, version=version, priority=priority,
+                               name=name, is_average=is_average)
+    return synchronize(h)

@@ -1,0 +1,234 @@
+"""Minimal MXNet stand-in for adapter tests.
+
+MXNet has no TPU build and is not in this image, so the adapter tests
+exercise byteps_tpu.mxnet against this shim: it implements exactly the
+NDArray / optimizer / gluon.Trainer surface the adapter touches (the
+same duck-typed contract real mx.nd.NDArray satisfies). Mirrors the
+reference's test approach of running adapter logic on one host
+(reference tests/test_mxnet.py) without requiring a GPU build.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+def _raw(x):
+    return x.arr if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    def __init__(self, arr, dtype=None):
+        self.arr = np.array(arr, dtype=dtype)
+
+    def asnumpy(self):
+        return self.arr.copy()
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def astype(self, dtype, copy=True):
+        return NDArray(self.arr.astype(dtype))
+
+    def copy(self):
+        return NDArray(self.arr.copy())
+
+    def reshape(self, *shape):
+        return NDArray(self.arr.reshape(*shape))
+
+    def wait_to_read(self):
+        pass
+
+    def __len__(self):
+        return len(self.arr)
+
+    def __getitem__(self, k):
+        return NDArray(self.arr[k])
+
+    def __setitem__(self, k, v):
+        self.arr[k] = _raw(v)
+
+    def __imul__(self, o):
+        self.arr *= _raw(o)
+        return self
+
+    def __iadd__(self, o):
+        self.arr += _raw(o)
+        return self
+
+    def __isub__(self, o):
+        self.arr -= _raw(o)
+        return self
+
+    def __mul__(self, o):
+        return NDArray(self.arr * _raw(o))
+
+    def __rmul__(self, o):
+        return NDArray(_raw(o) * self.arr)
+
+    def __add__(self, o):
+        return NDArray(self.arr + _raw(o))
+
+    def __sub__(self, o):
+        return NDArray(self.arr - _raw(o))
+
+
+def array(data, dtype=None):
+    return NDArray(data, dtype=dtype)
+
+
+def zeros(shape, dtype="float32"):
+    return NDArray(np.zeros(shape, dtype))
+
+
+def zeros_like(t):
+    return NDArray(np.zeros_like(_raw(t)))
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, **kwargs):
+        self.learning_rate = learning_rate
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+    def set_lr_mult(self, m):
+        self.lr_mult = m
+
+    def set_wd_mult(self, m):
+        self.wd_mult = m
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.0, wd=0.0, **kw):
+        super().__init__(learning_rate)
+        self.momentum = momentum
+        self.wd = wd
+
+    def update(self, index, weight, grad, state):
+        g = _raw(grad).astype(_raw(weight).dtype)
+        if self.wd:
+            g = g + self.wd * _raw(weight)
+        _raw(weight)[...] -= self.learning_rate * g
+
+
+_OPTIMIZERS = {"sgd": SGD}
+
+
+def create(name, **kwargs):
+    return _OPTIMIZERS[name](**kwargs)
+
+
+class Parameter:
+    def __init__(self, name, data, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = [NDArray(np.asarray(data, np.float32))]
+        self._grad = [NDArray(np.zeros_like(np.asarray(data, np.float32)))]
+        self._deferred_init = False
+
+    def list_data(self):
+        return self._data
+
+    def list_grad(self):
+        return self._grad
+
+
+class ParameterDict(dict):
+    pass
+
+
+class Trainer:
+    """Just enough of mx.gluon.Trainer: param bookkeeping, optimizer
+    creation, and a step() that runs init -> allreduce -> update. No
+    gradient rescaling here (the distributed subclass owns it)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        self._params = list(params)
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
+        self._params_to_init = list(self._params)
+        if isinstance(optimizer, str):
+            optimizer = create(optimizer, **(optimizer_params or {}))
+        elif optimizer_params:
+            for k, v in optimizer_params.items():
+                setattr(optimizer, k, v)
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def _init_params(self):
+        self._params_to_init = []
+
+    def _allreduce_grads(self):
+        pass
+
+    def _update(self):
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._optimizer.update(i, p._data[0], p._grad[0], None)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update()
+
+
+def install():
+    """Install the shim as ``mxnet`` in sys.modules (idempotent)."""
+    if "mxnet" in sys.modules and not getattr(
+            sys.modules["mxnet"], "_byteps_tpu_fake", False):
+        return sys.modules["mxnet"]
+    mx = types.ModuleType("mxnet")
+    mx._byteps_tpu_fake = True
+    nd = types.ModuleType("mxnet.ndarray")
+    nd.NDArray = NDArray
+    nd.array = array
+    nd.zeros = zeros
+    nd.zeros_like = zeros_like
+    opt_mod = types.ModuleType("mxnet.optimizer")
+    opt_mod.Optimizer = Optimizer
+    opt_mod.SGD = SGD
+    opt_mod.create = create
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    gluon.Parameter = Parameter
+    gluon.ParameterDict = ParameterDict
+    param_mod = types.ModuleType("mxnet.gluon.parameter")
+    param_mod.Parameter = Parameter
+    param_mod.ParameterDict = ParameterDict
+    gluon.parameter = param_mod
+    mx.nd = nd
+    mx.ndarray = nd
+    mx.optimizer = opt_mod
+    mx.gluon = gluon
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.ndarray"] = nd
+    sys.modules["mxnet.optimizer"] = opt_mod
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.gluon.parameter"] = param_mod
+    return mx
