@@ -1,0 +1,135 @@
+"""MXNet-adapter training example — the reference's mxnet example family
+in one script (example/mxnet/train_mnist_byteps.py +
+train_gluon_mnist_byteps.py):
+
+    python examples/mxnet_train.py                       # gluon DistributedTrainer
+    python examples/mxnet_train.py --frontend optimizer  # KVStore-style optimizer
+    python examples/mxnet_train.py --compression randomk # server-side codec
+    python examples/mxnet_train.py --compression onebit
+
+Trains a linear softmax classifier on synthetic MNIST-shaped data; the
+gradient is computed in closed form (numpy) and written into the
+parameter grads, so the script needs no autograd and runs on real MXNet
+(parameters built via initialize()) and — when MXNet is absent, as in
+this image — on the test shim that implements the same NDArray surface
+(tests/_fake_mxnet.py). Either way the comm path is real: gradients ride
+the DCN PS when DMLC_NUM_SERVER > 0 (spawn roles with bpslaunch,
+docs/running.md), identity otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+try:
+    import mxnet as mx
+except ImportError:
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _fake_mxnet
+    mx = _fake_mxnet.install()
+    print("mxnet not installed: using the NDArray-surface shim "
+          "(tests/_fake_mxnet.py) — the comm path below is the real one")
+
+import byteps_tpu.mxnet as bps  # noqa: E402
+
+
+def softmax_xent_grads(W, b, x, y):
+    """Closed-form grads of mean softmax cross entropy for logits=xW+b."""
+    logits = x @ W + b
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    n = x.shape[0]
+    loss = -np.log(p[np.arange(n), y] + 1e-12).mean()
+    d = p
+    d[np.arange(n), y] -= 1.0
+    d /= n
+    return loss, x.T @ d, d.sum(axis=0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frontend", default="trainer",
+                    choices=["trainer", "optimizer"])
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "onebit", "randomk", "fp16"],
+                    help="server-side codec via compression_params "
+                         "(trainer frontend only; fp16 = intra-node)")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    if args.frontend == "optimizer" and args.compression != "none":
+        ap.error("--compression maps to the trainer's compression_params "
+                 "(the reference's contract); the KVStore-style optimizer "
+                 "pushes raw grads")
+
+    bps.init()
+    rng = np.random.RandomState(1234 + bps.rank())
+    D, C = 28 * 28, 10
+    x = rng.rand(args.batch_size, D).astype(np.float32)
+    y = rng.randint(0, C, args.batch_size)
+
+    def make_param(name: str, arr: np.ndarray):
+        if getattr(mx, "_byteps_tpu_fake", False):
+            return mx.gluon.Parameter(name, arr)   # shim: data positional
+        p = mx.gluon.Parameter(name, shape=arr.shape, dtype="float32")
+        p.initialize(mx.init.Zero(), ctx=mx.cpu())
+        p.set_data(mx.nd.array(arr))
+        return p
+
+    pW = make_param("weight", np.zeros((D, C), np.float32))
+    pb = make_param("bias", np.zeros(C, np.float32))
+
+    if args.frontend == "trainer":
+        comp = None
+        if args.compression == "onebit":
+            comp = {"compressor": "onebit", "scaling": True,
+                    "ef": "vanilla"}
+        elif args.compression == "randomk":
+            comp = {"compressor": "randomk", "k": 64, "seed": 7}
+        elif args.compression == "fp16":
+            comp = {"fp16": True}
+        trainer = bps.DistributedTrainer(
+            [pW, pb], "sgd", {"learning_rate": args.lr},
+            compression_params=comp)
+    else:
+        opt = bps.DistributedOptimizer(
+            mx.optimizer.SGD(learning_rate=args.lr))
+        bps.broadcast_parameters(
+            {"weight": pW._data[0], "bias": pb._data[0]}, root_rank=0)
+
+    t0, loss = time.time(), float("nan")
+    for step in range(args.steps):
+        W = pW._data[0].asnumpy()
+        b = pb._data[0].asnumpy()
+        loss, gW, gb = softmax_xent_grads(W, b, x, y)
+        if args.frontend == "trainer":
+            pW._grad[0][:] = gW
+            pb._grad[0][:] = gb
+            trainer.step(1)   # grads already batch-normalized
+        else:
+            opt.update(0, pW._data[0], mx.nd.array(gW), None)
+            opt.update(1, pb._data[0], mx.nd.array(gb), None)
+        if step % 10 == 0 and bps.rank() == 0:
+            print(f"step {step:3d} loss {loss:.4f}")
+
+    dt = time.time() - t0
+    if bps.rank() == 0:
+        print(f"final loss {loss:.4f} "
+              f"({args.steps / dt:.1f} steps/s, frontend={args.frontend}, "
+              f"compression={args.compression})")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

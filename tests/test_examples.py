@@ -71,11 +71,10 @@ def test_torch_train_all_frontends():
         assert "final loss" in r.stdout, (fe, r.stdout[-500:])
 
 
-def test_torch_train_distributed_ps():
-    """The same example through a REAL loopback PS (DMLC env + server
-    process): this is where CrossBarrier's poller/drain path and the
-    DistributedOptimizer's PS submits actually execute — the
-    single-worker run above never enters them."""
+def _run_example_over_ps(name: str, argv: list, extra_env: dict = None):
+    """Run one example through a REAL loopback PS: DMLC env + a server
+    subprocess whose lifetime brackets the run (worker shutdown stops
+    it). Shared by every adapter-over-PS example test."""
     from byteps_tpu.utils.net import free_port
 
     port = free_port()
@@ -85,26 +84,37 @@ def test_torch_train_distributed_ps():
            "DMLC_PS_ROOT_PORT": str(port),
            "BYTEPS_FORCE_DISTRIBUTED": "1",
            "PYTHONPATH": REPO + os.pathsep
-           + os.environ.get("PYTHONPATH", "")}
-    for fe in ("optimizer", "cross_barrier"):
-        srv = subprocess.Popen(
-            [sys.executable, "-m", "byteps_tpu.server"],
-            env={**env, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
-        try:
-            path = os.path.join(REPO, "examples", "torch_train.py")
-            r = subprocess.run(
-                [sys.executable, "-c", _PIN, path, "--frontend", fe,
-                 "--steps", "6"],
-                cwd=REPO, capture_output=True, text=True, timeout=420,
-                env=env)
-            assert r.returncode == 0, \
-                (fe, r.stdout[-2000:] + r.stderr[-2000:])
-            assert "final loss" in r.stdout, (fe, r.stdout[-500:])
+           + os.environ.get("PYTHONPATH", ""),
+           **(extra_env or {})}
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server"],
+        env={**env, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        path = os.path.join(REPO, "examples", name)
+        r = subprocess.run(
+            [sys.executable, "-c", _PIN, path, *argv],
+            cwd=REPO, capture_output=True, text=True, timeout=420,
+            env=env)
+        if r.returncode == 0:
             srv.wait(timeout=30)  # worker shutdown stops the server
-        finally:
-            if srv.poll() is None:
-                srv.kill()
+        return r
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+
+
+def test_torch_train_distributed_ps():
+    """The torch example through the loopback PS: this is where
+    CrossBarrier's poller/drain path and the DistributedOptimizer's PS
+    submits actually execute — the single-worker run above never enters
+    them."""
+    for fe in ("optimizer", "cross_barrier"):
+        r = _run_example_over_ps("torch_train.py",
+                                 ["--frontend", fe, "--steps", "6"])
+        assert r.returncode == 0, \
+            (fe, r.stdout[-2000:] + r.stderr[-2000:])
+        assert "final loss" in r.stdout, (fe, r.stdout[-500:])
 
 
 def test_benchmark_model_zoo_tiny():
@@ -128,3 +138,35 @@ def test_tf1_train_runs():
     r = _run_example("tf1_train.py", ["--steps", "30"])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "final loss" in r.stdout, r.stdout[-500:]
+
+
+def _first_and_final_loss(stdout: str):
+    import re
+    first = re.search(r"step\s+0 loss ([\d.]+)", stdout)
+    final = re.search(r"final loss ([\d.]+)", stdout)
+    assert first and final, stdout[-500:]
+    return float(first.group(1)), float(final.group(1))
+
+
+def test_mxnet_train_runs():
+    """The mxnet-adapter example family (reference train_mnist_byteps +
+    train_gluon_mnist_byteps): both frontends run (against the NDArray
+    shim — mxnet is not in the image) and loss descends."""
+    for fe in ("trainer", "optimizer"):
+        r = _run_example("mxnet_train.py", ["--frontend", fe,
+                                            "--steps", "15"])
+        assert r.returncode == 0, (fe, r.stdout[-2000:] + r.stderr[-2000:])
+        first, final = _first_and_final_loss(r.stdout)
+        assert final < first, (fe, r.stdout[-500:])
+
+
+def test_mxnet_train_compressed_ps():
+    """The gluon trainer example through a REAL loopback PS with the
+    onebit codec — the compression_params path only engages when a PS is
+    configured."""
+    r = _run_example_over_ps(
+        "mxnet_train.py", ["--compression", "onebit", "--steps", "10"],
+        extra_env={"BYTEPS_MIN_COMPRESS_BYTES": "0"})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    first, final = _first_and_final_loss(r.stdout)
+    assert final < first, r.stdout[-500:]
