@@ -148,16 +148,21 @@ def test_d2h_payload_is_wire_sized(monkeypatch):
     def body(bps, state):
         dc = DeviceCompressor(state.ps_client, 1, {"compressor": "onebit"})
         plan = dc.plan(state, "big", n)
-        compress_fn, _ = dc._get_fns([plan], True)
-        payloads, _states = compress_fn(
+        compress_fn, _decompress_fn, spec = dc._get_fns([plan], True)
+        packed, _states = compress_fn(
             [jnp.ones(n, jnp.float32)], [plan.states], jnp.int32(0))
-        total = 0
-        for part in payloads[0]:
-            for v in part.values():
-                total += np.asarray(v).nbytes
+        # the D2H hop is now 1-2 dtype-bucketed buffers (not one array
+        # per partition payload) and their total is exactly wire-sized
+        assert len(packed) <= 2, list(packed)
+        total = sum(np.asarray(v).nbytes for v in packed.values())
         dense = n * 4
         assert total == plan.wire_bytes(), (total, plan.wire_bytes())
         assert total < dense / 25, (total, dense)
+        # host views must reassemble into the per-partition wire layout
+        payloads = spec.unpack_np({k: np.asarray(v)
+                                   for k, v in packed.items()})
+        assert len(payloads[0]) == len(plan.ctx.partitions)
+        assert set(payloads[0][0]) == {"bits", "scale"}
 
     _with_ps(monkeypatch, body)
 
