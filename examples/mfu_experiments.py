@@ -21,8 +21,11 @@ import os as _os
 import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+import sys
 import time, functools
 import jax, jax.numpy as jnp, numpy as np, optax
+
+_failed = []
 from byteps_tpu.models import llama
 
 cfg = llama.LlamaConfig.small(vocab_size=32000)
@@ -33,25 +36,33 @@ tok = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (B, S + 1)), jnp.in
 
 
 def bench_loss(loss_fn, label, B=B, S=S, tokens=None):
+    """One A/B variant; a failing variant (e.g. a compile-time OOM of the
+    no-remat program) must not kill the variants after it."""
     tokens = tok if tokens is None else tokens
-    p = jax.tree.map(jnp.copy, params0)
-    o = tx.init(p)
+    try:
+        p = jax.tree.map(jnp.copy, params0)
+        o = tx.init(p)
 
-    def step(p, o, t):
-        loss, g = jax.value_and_grad(lambda q: loss_fn(q, t))(p)
-        u, o = tx.update(g, o, p)
-        return optax.apply_updates(p, u), o, loss
+        def step(p, o, t):
+            loss, g = jax.value_and_grad(lambda q: loss_fn(q, t))(p)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
 
-    stepj = jax.jit(step, donate_argnums=(0, 1))
-    for _ in range(3):
-        p, o, loss = stepj(p, o, tokens)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, o, loss = stepj(p, o, tokens)
-    float(loss)
-    dt = time.perf_counter() - t0
-    print(f"{label}: {B*S*steps/dt:,.0f} tok/s  (loss {float(loss):.3f})", flush=True)
+        stepj = jax.jit(step, donate_argnums=(0, 1))
+        for _ in range(3):
+            p, o, loss = stepj(p, o, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, o, loss = stepj(p, o, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+        print(f"{label}: {B*S*steps/dt:,.0f} tok/s  "
+              f"(loss {float(loss):.3f})", flush=True)
+    except Exception as e:
+        _failed.append(label)
+        print(f"{label}: FAILED {type(e).__name__}: {str(e)[:160]}",
+              flush=True)
 
 
 # -- 1. chunked-vocab xent ------------------------------------------------ #
@@ -138,3 +149,7 @@ bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
 bench_loss(lambda q, t: llama.loss_fn(
                q, {"tokens": t}, cfg, attn_impl=make_flash_attn()),
            "pallas-flash B=2 S=8192", B=2, S=8192, tokens=tok8)
+
+if _failed:
+    print(f"{len(_failed)} variant(s) failed: {', '.join(_failed)}")
+    sys.exit(1)
