@@ -70,8 +70,11 @@ Tuning applied vs the anchor: bf16 activations/logits, logsumexp-form
 cross entropy (llama.next_token_xent), B=16 batch (MXU utilization),
 donated buffers, head_dim=128 attention layout (identical params/FLOPs;
 hd=64 wastes half of each 128-lane register tile — measured +40%), bf16
-adam first moment. Measured-but-rejected: Pallas flash attention (slower
-than XLA's fused dense attention at S=1024 on v5e), scan unroll, B=32.
+adam first moment. Measured-but-rejected: Pallas flash attention AND
+jax's production splash-attention kernel (74.0k vs 100.3k tok/s — XLA's
+fused dense attention wins at S=1024 on v5e; Pallas attention pays off
+past S≈4k, docs/performance.md), scan unroll, B=32, S=2048@B=8,
+dots_saveable remat, noremat (now OOMs, see variants below).
 Ceiling context: bare bf16 matmuls at this model's shapes (K=768) reach
 112-148 TF/s on v5e (not the 197 headline, which needs K>=4096), so the
 shape-mix-achievable MFU is ~0.6-0.75; we measure ~0.34 end-to-end with
@@ -221,15 +224,17 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
                 # compiles where noremat's HBM estimate does not)
                 "remat_dots_nb": dataclasses.replace(
                     cfg, remat_policy="dots_with_no_batch_dims_saveable"),
-                # 125M at B=16/S=1024: saved activations (~a few GB) fit
-                # v5e HBM, buying back the remat recompute FLOPs
-                "noremat": dataclasses.replace(cfg, remat=False),
-                # chunked-vocab xent: the [B,S,V] logits never resident
-                # at once (llama.chunked_next_token_xent) — the MFU
-                # harness's HBM-traffic candidate, A/B'd here on real
-                # hardware every round
-                "chunked8": dataclasses.replace(cfg, remat=False,
-                                                xent_chunks=8)}
+                # chunked-vocab xent OVER remat: the [B,S,V] logits never
+                # resident at once (llama.chunked_next_token_xent) — the
+                # HBM-traffic candidate, A/B'd on real hardware every
+                # round (98.6k vs the winner's 100.3-101.1k across
+                # same-day runs, 2026-07-31 — close enough to keep
+                # watching). The former noremat/chunked-noremat
+                # variants are gone: with the bf16-mu adam state donated
+                # alongside, noremat's saved activations now exceed v5e
+                # HBM (RESOURCE_EXHAUSTED at compile, ~30s of budget per
+                # attempt) — measured, not hypothetical
+                "chunked8": dataclasses.replace(cfg, xent_chunks=8)}
     results = {}
     for name, c in variants.items():
         try:
