@@ -547,7 +547,10 @@ def phase_scaling(workers: int = 2, steps: int = 200) -> dict:
                          (tns, lambda: bs.run_config(workers, args))):
             try:
                 vals.append(fn())
-            except BaseException as e:  # noqa: BLE001 - incl. SystemExit
+            except (Exception, SystemExit) as e:
+                # SystemExit: worker rendezvous hiccup costs the rep
+                # only. KeyboardInterrupt deliberately NOT caught — the
+                # operator must be able to stop the remaining reps.
                 sys.stderr.write(f"[bench] scaling run failed: {e}\n")
     if not t1s or not tns:
         raise RuntimeError("all scaling runs failed")

@@ -2255,6 +2255,13 @@ class ServerConn {
     uint32_t rid = next_rid_.fetch_add(1);
     {
       std::lock_guard<std::mutex> lk(waiters_mu_);
+      // re-check under the sweep's mutex: a poison landing between the
+      // entry check and this insert has already run the fail-all sweep,
+      // so a waiter registered now would never be completed. sticky is
+      // stored BEFORE the sweep takes waiters_mu_, so under this lock
+      // either we see it here, or the sweep runs after the insert and
+      // fails the waiter.
+      if (sticky_err_.load()) return false;
       waiters_[rid] = w;
     }
     MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
@@ -2279,6 +2286,11 @@ class ServerConn {
     uint32_t rid = next_rid_.fetch_add(1);
     {
       std::lock_guard<std::mutex> lk(waiters_mu_);
+      // same re-check-under-lock as RequestAsync: close the window
+      // between the entry check and the insert, where the fail-all
+      // sweep may already have run (a stranded waiter here would block
+      // for the full BYTEPS_CLIENT_TIMEOUT_S).
+      if (sticky_err_.load()) return ~0u;
       waiters_[rid] = w;
     }
     MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
@@ -2443,7 +2455,11 @@ class ServerConn {
       w->cv.notify_one();
       if (!ok) break;
     }
-    // connection dead: fail all waiters
+    // connection dead: poison first (nothing will ever read a reply off
+    // this conn again — without this, a Request registered after the
+    // sweep below would block for the full client timeout even though
+    // the recv thread is gone), then fail all waiters
+    sticky_err_.store(true);
     std::lock_guard<std::mutex> lk(waiters_mu_);
     for (auto& [rid, w] : waiters_) {
       std::lock_guard<std::mutex> lk2(w->mu);

@@ -84,7 +84,9 @@ def _make_randomk(kwargs: Dict[str, str], size: int) -> Codec:
 def _make_dithering(kwargs: Dict[str, str], size: int) -> Codec:
     return DitheringCodec(
         size=size,
-        s=int(kwargs.get("s", 127)),
+        # "s" with "k" fallback: the reference passes dithering's level
+        # count as compressor_k (dithering.cc:31)
+        s=int(kwargs.get("s", kwargs.get("k", 127))),
         partition=kwargs.get("partition_type", "linear"),
         normalize=kwargs.get("normalize_type", "max"),
         seed=int(kwargs.get("seed", 0)),

@@ -431,7 +431,12 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
         if coding not in ("dense", "varint"):
             raise ValueError(f"unknown index_coding {coding!r}")
         codec = HostDithering(
-            n=n, s=int(kwargs.get("s", 127)),
+            # level count: "s" with fallback to "k" — the reference
+            # passes dithering's levels as compressor_k
+            # (dithering.cc:31), so adapter attribute bags arrive as
+            # "k"; the server inherits the resolved value via
+            # kwargs_wire either way
+            n=n, s=int(kwargs.get("s", kwargs.get("k", 127))),
             partition=kwargs.get("partition_type", "linear"),
             normalize=kwargs.get("normalize_type", "max"),
             seed=int(kwargs.get("seed", 0)), index_coding=coding)

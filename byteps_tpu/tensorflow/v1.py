@@ -125,6 +125,7 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
         self._optimizer = optimizer
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
+        self._seeded: set = set()  # async store: names already init-pushed
 
     def compute_gradients(self, *args, **kwargs):
         gradients = self._optimizer.compute_gradients(*args, **kwargs)
@@ -145,17 +146,45 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
         return averaged
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
-        if not _enable_async() or not _distributed():
+        from ..core.state import get_state
+
+        if (not _enable_async() or not _distributed()
+                or get_state().ps_client is None):
             # async without a PS has no authoritative store to fold
             # deltas into — degrade to the plain optimizer (the module
-            # contract: single-worker/no-PS is identity)
+            # contract: single-worker/no-PS is identity; the ps_client
+            # guard keeps multi-process ICI runs off the delta path,
+            # where summing deltas would destroy the weights)
+            if (_enable_async() and _distributed()
+                    and get_state().ps_client is None):
+                # loudly: compute_gradients also skipped averaging (the
+                # async gate), so this configuration trains fully
+                # UNSYNCHRONIZED — each worker diverges independently
+                from ..utils.logging import log
+
+                log.warning(
+                    "BYTEPS_ENABLE_ASYNC with multiple workers but no "
+                    "PS configured: gradients are neither averaged nor "
+                    "folded into an async store — training is local-"
+                    "only. Configure DMLC_NUM_SERVER/DMLC_PS_ROOT_* or "
+                    "unset BYTEPS_ENABLE_ASYNC.")
             return self._optimizer.apply_gradients(grads_and_vars, *args,
                                                    **kwargs)
         # async DP: apply locally, then push the weight DELTA — the
         # server folds it into the authoritative weights and the pull
-        # returns them (no aggregation barrier)
+        # returns them (no aggregation barrier). The store must be
+        # SEEDED with pre-update weights on each tensor's first step
+        # (the reference's first init push, server.cc:266-295): the
+        # generic push_pull path init-pushes ZEROS, which would make the
+        # pull return bare delta sums and silently destroy the model —
+        # so this path rides client.init_weights +
+        # push_delta_pull_weights directly, like the jax
+        # (jax/train.py make_async_ps_train_step) and mxnet async
+        # siblings. The delta wire is uncompressed, also like them.
         gv = list(grads_and_vars)
-        tvars = [v for _, v in gv]
+        # frozen variables (grad None) never change, so their delta is
+        # identically zero — skip the per-step seed + round trip
+        tvars = [v for g, v in gv if g is not None]
         # tf.identity snapshots, and apply_op is built UNDER a control
         # dependency on them: raw v1 graphs have no auto control edges
         # (unlike tf.function), so without this the Session could read a
@@ -164,16 +193,68 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
         with tf.control_dependencies(old):
             apply_op = self._optimizer.apply_gradients(gv, *args,
                                                        **kwargs)
+        names = ["tf1delta/" + v.name.replace(":", "_") for v in tvars]
         with tf.control_dependencies([apply_op]):
+            # Seed ALL stores in ONE py_function, in variable order,
+            # BEFORE any delta round trip: init_weights blocks until
+            # every worker init-pushes that key, and the per-variable
+            # py_functions run in executor order — nondeterministic
+            # across workers — so lazy per-variable seeding could
+            # cross-block on disjoint keys (worker 0 parked on key A,
+            # worker 1 on key B). A single deterministic seeding pass
+            # makes every worker hit the barriers in the same order.
+            # Idempotent: after the first step it is a no-op hop.
+            seed_op = self._seed_all_op(names, old)
             assigns = []
-            for v, o in zip(tvars, old):
-                delta = tf.subtract(v, o)
-                name = "tf1delta/" + v.name.replace(":", "_")
-                updated = push_pull(delta, scope=self._name, average=False,
-                                    name=name,
-                                    compression=self._compression)
-                assigns.append(tf.compat.v1.assign(v, updated))
+            with tf.control_dependencies([seed_op]):
+                for v, o, name in zip(tvars, old, names):
+                    delta = tf.subtract(v, o)
+                    updated = self._async_delta(delta, name)
+                    assigns.append(tf.compat.v1.assign(v, updated))
             return tf.group(*assigns)
+
+    def _seed_all_op(self, names, olds):
+        def _seed(*o_ts):
+            from ..core.state import get_state
+            from ..server.client import get_or_init_ctx
+
+            state = get_state()
+            client = state.ps_client
+            for name, o_t in zip(names, o_ts):
+                if name in self._seeded:
+                    continue
+                host_o = np.ascontiguousarray(o_t.numpy(),
+                                              np.float32).reshape(-1)
+                ctx = get_or_init_ctx(state, name, host_o)
+                client.init_weights(ctx, host_o)
+                self._seeded.add(name)
+            return np.int32(0)
+
+        return tf.py_function(_seed, list(olds), Tout=tf.int32)
+
+    def _async_delta(self, delta, name: str):
+        """One py_function hop per variable: push the post-step weight
+        delta and pull the server's authoritative weights (the store was
+        seeded by _seed_all_op)."""
+
+        def _op(d_t):
+            from ..core.state import get_state
+            from ..server.client import get_or_init_ctx
+
+            state = get_state()
+            client = state.ps_client
+            host_d = np.ascontiguousarray(d_t.numpy(),
+                                          np.float32).reshape(-1)
+            ctx = get_or_init_ctx(state, name, host_d)
+            out = client.push_delta_pull_weights(ctx, host_d)
+            state.telemetry.record(out.nbytes * 2)
+            return tf.constant(
+                out.reshape(tuple(d_t.shape)).astype(
+                    d_t.dtype.as_numpy_dtype()))
+
+        result = tf.py_function(_op, [delta], Tout=delta.dtype)
+        result.set_shape(delta.shape)
+        return result
 
     # --- pure delegation (reference __init__.py:270-292) ------------- #
 

@@ -386,3 +386,30 @@ def test_native_codec_parity():
                          "normalize_type": "l2"}, "", 16) is None
     assert maybe_native({"compressor": "dithering",
                          "partition_type": "natural"}, "", 16) is None
+
+
+def test_dithering_levels_from_k_alias():
+    """The reference passes dithering's level count as compressor_k
+    (dithering.cc:31), so adapter attribute bags (e.g. the mxnet
+    compression_params path, mxnet/ops.py _codec_kwargs) arrive with
+    "k" — both codec tiers must honor it rather than silently running
+    at the default 127 levels."""
+    from byteps_tpu.ops.compression import make_compressor
+    from byteps_tpu.ops.compression.host import make_host_codec
+
+    # device tier: the parsed level count is inspectable
+    assert make_compressor({"compressor": "dithering", "k": "4"},
+                           64).codec.s == 4
+
+    # host tier (may be numpy or the native C ABI mirror): behavioral —
+    # "k" must produce the same wire as an explicit "s", and differ
+    # from the 127-level default
+    x = np.random.RandomState(0).randn(64).astype(np.float32)
+    via_k = make_host_codec({"compressor": "dithering", "k": "4"},
+                            64).compress(x.copy())
+    via_s = make_host_codec({"compressor": "dithering", "s": "4"},
+                            64).compress(x.copy())
+    default = make_host_codec({"compressor": "dithering"},
+                              64).compress(x.copy())
+    assert bytes(via_k) == bytes(via_s)
+    assert bytes(via_k) != bytes(default)

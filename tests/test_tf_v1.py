@@ -122,3 +122,78 @@ def test_v1_broadcast_hook():
     r = _run(_HOOK_V1)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "v1 hook ok" in r.stdout
+
+
+_TRAIN_V1_ASYNC = r"""
+import numpy as np
+import tensorflow as tf
+import byteps_tpu.tensorflow as bps
+from byteps_tpu.tensorflow import v1 as bps_v1
+
+bps.init()
+from byteps_tpu.core.state import get_state
+assert get_state().config.enable_async
+assert get_state().ps_client is not None
+g = tf.Graph()
+with g.as_default():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 8).astype(np.float32)
+    Y = (X @ np.arange(8, dtype=np.float32)[:, None] * 0.1 + 0.5)
+    x = tf.compat.v1.placeholder(tf.float32, [None, 8])
+    y = tf.compat.v1.placeholder(tf.float32, [None, 1])
+    w = tf.compat.v1.get_variable("w", [8, 1], tf.float32,
+                                  tf.compat.v1.zeros_initializer())
+    b = tf.compat.v1.get_variable("b", [1], tf.float32,
+                                  tf.compat.v1.constant_initializer(7.0))
+    loss = tf.reduce_mean(tf.square(x @ w + b - y))
+    opt = bps_v1.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.05))
+    train_op = opt.minimize(loss)
+    with tf.compat.v1.Session() as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        l0 = sess.run(loss, {x: X, y: Y})
+        sess.run(train_op, {x: X, y: Y})
+        b1 = float(sess.run(b)[0])
+        # the async store is seeded with the INITIAL weights before the
+        # first delta push: one small step must leave b near its 7.0
+        # init. The zero-seeded-store bug made the pull return the bare
+        # delta (~-0.65), collapsing b by ~7.
+        assert abs(b1 - 7.0) < 2.0, b1
+        for _ in range(80):
+            sess.run(train_op, {x: X, y: Y})
+        l1 = sess.run(loss, {x: X, y: Y})
+assert l1 < l0 * 0.2, (l0, l1)
+print("v1 async ok", l0, "->", l1)
+bps.shutdown()
+"""
+
+
+def test_v1_async_delta_over_ps():
+    """Async mode (BYTEPS_ENABLE_ASYNC) through a real async-mode PS:
+    apply_gradients must seed the server's authoritative store with the
+    initial weights before the first delta push — the generic push_pull
+    path's zero init would make every pull return bare delta sums and
+    silently destroy the model (round-4 review regression test)."""
+    sys.path.insert(0, REPO)
+    from byteps_tpu.utils.net import free_port
+
+    port = free_port()
+    env = {"DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+           "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port),
+           "BYTEPS_FORCE_DISTRIBUTED": "1",
+           "BYTEPS_ENABLE_ASYNC": "1"}
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server"],
+        env={**os.environ, **env, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        r = _run(_TRAIN_V1_ASYNC, env_extra=env)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert "v1 async ok" in r.stdout
+        srv.wait(timeout=30)
+    finally:
+        if srv.poll() is None:
+            srv.kill()
