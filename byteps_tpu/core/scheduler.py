@@ -242,14 +242,37 @@ class HandleManager:
 
     def get(self, hid: int) -> Handle:
         with self._mu:
-            return self._handles[hid]
+            try:
+                return self._handles[hid]
+            except KeyError:
+                raise KeyError(f"unknown or already-synchronized handle "
+                               f"{hid}") from None
 
     def poll(self, hid: int) -> bool:
-        return self.get(hid).done()
+        # a cleared id reports done (the reference PollHandle contract,
+        # torch/handle_manager.cc): poll loops racing a synchronize()
+        # elsewhere must terminate, not crash
+        with self._mu:
+            h = self._handles.get(hid)
+        return True if h is None else h.done()
 
     def wait_and_clear(self, hid: int, timeout=None) -> np.ndarray:
         h = self.get(hid)
-        out = h.wait(timeout)
+        try:
+            out = h.wait(timeout)
+        except Exception as e:
+            # drop the handle ONLY when the raised exception is the
+            # handle's own stored error: that round is over, and a
+            # leaked entry would pin gradient-sized buffers via the
+            # error traceback's frames for the life of the process. A
+            # wait TimeoutError must keep the handle — the completion
+            # may race the deadline (done() flipping true just after
+            # wait() returned False), and popping then would silently
+            # drop a successful result the caller's retry could fetch.
+            if h._err is e:
+                with self._mu:
+                    self._handles.pop(hid, None)
+            raise
         with self._mu:
             self._handles.pop(hid, None)
         return out
@@ -293,9 +316,44 @@ class PipelineScheduler:
         self._inflight = 0
         self._inflight_mu = threading.Lock()
         self._inflight_cv = threading.Condition(self._inflight_mu)
+        # per-key pinned priority (see _pin_priority)
+        self._prio_mu = threading.Lock()
+        self._key_priority: Dict[int, int] = {}
+        self._prio_warned: set = set()
         self._dispatcher = threading.Thread(
             target=self._dispatch, name="bps-sched-dispatch", daemon=True)
         self._dispatcher.start()
+
+    def _pin_priority(self, ctx: TensorContext,
+                      priority: Optional[int]) -> int:
+        """The first submission's priority is PINNED per key. The queue
+        pops by (priority desc, submission order), so two queued rounds
+        of one tensor carrying different priorities would be admitted in
+        priority order, not round order — and the server counts pushes
+        positionally per worker per key, so the swap would silently sum
+        round N+1's payload into round N across workers. The reference's
+        priority is static per key by construction (-declared_key,
+        tensorflow/ops.cc:155-158); an explicit per-call value sticks on
+        first use, and later differing values warn and are ignored
+        (same guard server/compressed.py applies to compressed rounds)."""
+        if priority is None:
+            priority = -ctx.declared_key
+        with self._prio_mu:
+            pinned = self._key_priority.setdefault(ctx.declared_key,
+                                                   priority)
+            warn = (pinned != priority
+                    and ctx.declared_key not in self._prio_warned)
+            if warn:
+                self._prio_warned.add(ctx.declared_key)
+        if warn:
+            # once per key — a caller passing per-round priorities would
+            # otherwise flood the submit hot path every step
+            log.warning(
+                "tensor %r: per-round priority %d ignored; %d was pinned "
+                "at first submission (cross-round reorder guard; "
+                "further mismatches for this tensor are silent)",
+                ctx.name, priority, pinned)
+        return pinned
 
     # ---- stage plumbing ------------------------------------------------ #
 
@@ -501,8 +559,7 @@ class PipelineScheduler:
             handle._finish(out if err is None else None, err)
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
-        if priority is None:
-            priority = -ctx.declared_key
+        priority = self._pin_priority(ctx, priority)
         for i, p in enumerate(ctx.partitions):
             stack = comp.stacks[i] if comp is not None else None
             task = PartitionTask(
@@ -535,8 +592,7 @@ class PipelineScheduler:
             handle._finish(replies if err is None else None, err)
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
-        if priority is None:
-            priority = -ctx.declared_key
+        priority = self._pin_priority(ctx, priority)
         for i, p in enumerate(ctx.partitions):
             task = PartitionTask(
                 ctx, p, priority, version, None, replies[i], group,
@@ -576,8 +632,7 @@ class PipelineScheduler:
                            err)
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
-        if priority is None:
-            priority = -ctx.declared_key
+        priority = self._pin_priority(ctx, priority)
         for p in ctx.partitions:
             try:
                 wire = build_rowsparse_payload(p, nz, host2d)

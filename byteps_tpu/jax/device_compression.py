@@ -284,9 +284,9 @@ class DeviceCompressor:
         with self._lock:
             if plan.installed:
                 return
-            nbytes = plan.n * 4
-            self.client.init_tensor(
-                plan.ctx, np.zeros(nbytes, np.uint8).view(np.float32))
+            # per-partition zeros (ensure_init): the transient allocation
+            # is bounded by partition_bytes, not the whole tensor
+            self.client.ensure_init(plan.ctx, plan.n * 4)
             for p, hb in zip(plan.ctx.partitions, plan.host_base):
                 if hb is not None:
                     self.client.comp_init(p.server, p.key, hb.kwargs_wire())
@@ -373,7 +373,22 @@ class DeviceCompressor:
         pipeline, decompress the aggregate on device. ``leaves``: device
         arrays (any float dtype/shape); returns device arrays of the same
         shapes/dtypes. Blocking (the internal pipeline overlaps)."""
-        plans = [self.plan(state, nm, int(np.prod(lf.shape)) or 1)
+        # zero-size leaves carry no data: pass them through unchanged (a
+        # padded 1-element plan would trace a size-1 dynamic_slice of a
+        # 0-element array and crash the step at compile time)
+        live = [(i, nm, lf)
+                for i, (nm, lf) in enumerate(zip(names, leaves))
+                if int(np.prod(lf.shape))]
+        if len(live) < len(leaves):
+            out = list(leaves)
+            if live:
+                sub = self.push_pull_leaves(
+                    state, [nm for _, nm, _ in live],
+                    [lf for _, _, lf in live], average)
+                for (i, _, _), r in zip(live, sub):
+                    out[i] = r
+            return out
+        plans = [self.plan(state, nm, int(np.prod(lf.shape)))
                  for nm, lf in zip(names, leaves)]
         for p in plans:
             self._install(p)

@@ -83,9 +83,11 @@ class CompressedTensor:
 
     def _install(self, nbytes: int) -> None:
         """Dense init-push (allocates the store, init barrier) then the
-        per-key kwargs push."""
-        self.client.init_tensor(self.ctx,
-                                np.zeros(nbytes, np.uint8).view(np.float32))
+        per-key kwargs push. ensure_init pushes per-partition zeros, so
+        the transient allocation is bounded by partition_bytes, not the
+        whole tensor (a fused multi-hundred-MB bucket would otherwise
+        spike host memory at startup)."""
+        self.client.ensure_init(self.ctx, nbytes)
         for p, stack in zip(self.ctx.partitions, self.stacks):
             if stack is not None:
                 self.client.comp_init(p.server, p.key, stack.kwargs_wire())
@@ -129,13 +131,14 @@ class CompressedTensor:
             if stack is None:
                 buf = np.ascontiguousarray(view[lo:hi])
                 self.client.zpush(p.server, p.key, buf, CMD_F32)
-                dst = np.empty(p.length, np.uint8)
-                self.client.zpull(p.server, p.key, dst, CMD_F32)
+                # pull straight into the output slot (contiguous view) —
+                # no scratch buffer + copy on the hot path
+                self.client.zpull(p.server, p.key, out_view[lo:hi],
+                                  CMD_F32)
                 moved.append(2 * p.length)
-                res = dst.view(np.float32)
                 if average and self.num_workers > 1:
-                    res = res / self.num_workers
-                out_view[lo:hi] = res.view(np.uint8)
+                    res = out_view[lo:hi].view(np.float32)
+                    res /= self.num_workers
                 return
             wire = compress_partition(stack, view[lo:hi], step)
             self.client.zpush(p.server, p.key, wire, CMD_COMP_F32)

@@ -230,7 +230,24 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
                 self._seeded.add(name)
             return np.int32(0)
 
-        return tf.py_function(_seed, list(olds), Tout=tf.int32)
+        # gate behind tf.cond: a bare py_function would fetch EVERY
+        # variable's pre-update snapshot host-side on every step
+        # (full-weights D2H per step forever) just to no-op. The pred is
+        # a no-input scalar py_function reading the python-side seeded
+        # set, so after step 1 the untaken branch's seeding py_function
+        # never executes and no weight snapshot crosses to the host.
+        # (Deliberately not a tf.Variable flag: that would ride the
+        # GLOBAL_VARIABLES collection into broadcast/initializer paths.)
+        pred = tf.py_function(
+            lambda: np.bool_(len(self._seeded) >= len(names)), [],
+            Tout=tf.bool)
+
+        def _do_seed():
+            op = tf.py_function(_seed, list(olds), Tout=tf.int32)
+            with tf.control_dependencies([op]):
+                return tf.constant(0, tf.int32)
+
+        return tf.cond(pred, lambda: tf.constant(0, tf.int32), _do_seed)
 
     def _async_delta(self, delta, name: str):
         """One py_function hop per variable: push the post-step weight

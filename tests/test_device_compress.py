@@ -227,3 +227,28 @@ def test_device_vs_host_tier_parity(monkeypatch):
         np.testing.assert_array_equal(dev, hostout)
 
     _with_ps(monkeypatch, body)
+
+
+def test_zero_size_leaf_passes_through(monkeypatch):
+    """A pytree with a 0-element leaf (e.g. an optional bias of shape
+    (0,)) must not crash the device-compressed round: zero-size leaves
+    carry no data and pass through unchanged while the rest of the tree
+    still aggregates (round-4 review regression)."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.jax.device_compression import DeviceCompressor
+
+    def body(bps, state):
+        dc = DeviceCompressor(state.ps_client, 1,
+                              {"compressor": "onebit"})
+        lf = jnp.asarray(np.random.RandomState(0).randn(512), jnp.float32)
+        empty = jnp.zeros((0,), jnp.float32)
+        out = dc.push_pull_leaves(state, ["zlive", "zempty"],
+                                  [lf, empty], average=False)
+        assert out[1].shape == (0,)
+        # the live leaf still went through the codec (onebit: sign*scale)
+        assert np.asarray(out[0]).shape == (512,)
+        assert np.sign(np.asarray(out[0])).tolist() == \
+            np.sign(np.asarray(lf)).tolist()
+
+    _with_ps(monkeypatch, body)

@@ -203,3 +203,67 @@ def test_queue_priority_with_compressed_tasks():
     got = [q.get_task() for _ in range(3)]
     assert [t.key for t in got] == [1, 2, 3]
     assert got[1].stack is stack and got[0].stack is None
+
+
+def test_handle_manager_error_and_cleared_semantics():
+    """Round-4 review regressions: an errored handle is removed by
+    wait_and_clear (a leaked entry pins gradient-sized buffers via the
+    error traceback); poll on a cleared id reports done (the reference
+    PollHandle contract) instead of raising."""
+    hm = HandleManager()
+    h = hm.allocate("bad")
+    h._finish(None, RuntimeError("boom"))
+    with pytest.raises(RuntimeError, match="boom"):
+        hm.wait_and_clear(h.id)
+    # the errored handle is gone, not leaked
+    with pytest.raises(KeyError, match="already-synchronized"):
+        hm.get(h.id)
+    # poll on the cleared id reports done rather than crashing
+    assert hm.poll(h.id) is True
+    # a pending handle that times out is KEPT for retry
+    h2 = hm.allocate("slow")
+    with pytest.raises(TimeoutError):
+        hm.wait_and_clear(h2.id, timeout=0.01)
+    assert not hm.poll(h2.id)
+    h2._finish(np.zeros(1), None)
+    hm.wait_and_clear(h2.id)
+
+
+def test_per_key_priority_is_pinned(monkeypatch):
+    """Two rounds of one tensor submitted with different explicit
+    priorities must NOT reorder in the queue: the server counts pushes
+    positionally per worker per key, so admitting round N+1 before
+    round N would silently swap aggregation rounds. The first
+    submission's priority is pinned per key (round-4 review fix)."""
+    from byteps_tpu.core.state import GlobalState
+
+    port = _PORT[0]
+    _PORT[0] += 1
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    server = threading.Thread(
+        target=run_server, args=(port, Config(num_workers=1, num_servers=1)),
+        daemon=True)
+    server.start()
+
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        from byteps_tpu.core.state import get_state
+
+        sched = get_state().scheduler
+        x = np.ones(256, np.float32)
+        h1 = bps.push_pull_async(x, "pinp", average=False, priority=5)
+        bps.synchronize(h1, timeout=30)
+        # a different per-round priority is ignored (pinned at 5)
+        h2 = bps.push_pull_async(x, "pinp", average=False, priority=9)
+        bps.synchronize(h2, timeout=30)
+        ctx = get_state().registry.get("pinp")
+        assert sched._key_priority[ctx.declared_key] == 5
+    finally:
+        bps.shutdown()
+        server.join(timeout=10)
+        GlobalState._instance = None
