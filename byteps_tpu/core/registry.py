@@ -128,7 +128,15 @@ class TensorRegistry:
                 # _partition_locked's retire step doesn't go negative
                 ctx.partitions = []
                 if ctx.nbytes:
-                    self._partition_locked(ctx, ctx.nbytes)
+                    # preserve the declared alignment: row-sparse tensors
+                    # partition on whole rows, and a resumed worker must
+                    # rebuild the exact partition lengths/counts the
+                    # declaration produced, or its key->server assignment
+                    # history (mixed/least-loaded hashing) diverges from
+                    # freshly-joined workers
+                    self._partition_locked(
+                        ctx, ctx.nbytes,
+                        getattr(ctx, "align_bytes", None))
 
     # ------------------------------------------------------------------ #
     # partitioning + server assignment
@@ -229,8 +237,12 @@ class TensorRegistry:
         bound = self._config.mixed_mode_bound
         bps_check(bound >= num_servers,
                   f"BYTEPS_MIXED_MODE_BOUND {bound} < num_servers")
-        ratio = (2.0 * noncolo * (num_workers - 1)) / (
-            num_workers * (num_workers + noncolo) - 2 * noncolo)
+        denom = num_workers * (num_workers + noncolo) - 2 * noncolo
+        bps_check(denom > 0,
+                  "mixed mode requires >= 2 workers (the reference ratio "
+                  "formula, global.cc:576-584, is undefined at 1 worker: "
+                  f"workers={num_workers} servers={num_servers})")
+        ratio = (2.0 * noncolo * (num_workers - 1)) / denom
         bps_check(0 <= ratio <= 1,
                   "mixed mode requires num_noncolocated <= num_workers")
         threshold = ratio * bound

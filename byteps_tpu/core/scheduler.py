@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..utils.logging import log
-from .types import Partition, TensorContext
+from .types import Partition, TensorContext, trunc_divide_inplace
 
 # Credit default when scheduling is off: effectively unlimited
 # (the reference uses 32 GB, scheduled_queue.cc:33-45).
@@ -195,7 +195,18 @@ class TaskGroup:
             self._remaining -= 1
             fire = self._remaining == 0
         if fire:
-            self._callback(self._error)
+            try:
+                self._callback(self._error)
+            except Exception:  # noqa: BLE001 - then re-raised
+                # a completion-callback bug must be LOUD: swallowed (the
+                # stage pools drop future exceptions), it strands the
+                # waiter until its timeout with no diagnostic at all —
+                # exactly how a 4-line closure bug once became a silent
+                # 30s hang
+                log.exception(
+                    "completion callback for %r raised; the waiting "
+                    "handle may never resolve", self.ctx.name)
+                raise
 
 
 class Handle:
@@ -553,7 +564,11 @@ class PipelineScheduler:
         def on_complete(err: Optional[Exception]) -> None:
             if err is None and average and num_workers > 1:
                 if np.issubdtype(out.dtype, np.integer):
-                    np.floor_divide(out, num_workers, out=out)
+                    # truncation toward zero (reference div_(size));
+                    # in-place so ``out`` is never rebound — an
+                    # assignment here would make it a LOCAL of this
+                    # closure and break the _finish line below
+                    trunc_divide_inplace(out, num_workers)
                 else:
                     np.divide(out, num_workers, out=out)
             handle._finish(out if err is None else None, err)

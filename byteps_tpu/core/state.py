@@ -39,8 +39,11 @@ class _Telemetry:
         self._window_start = time.monotonic()
         self._window_bytes = 0
         self._last_sample = (0.0, 0.0)  # (timestamp, MB/s)
+        self.enabled = True  # BYTEPS_TELEMETRY_ON; set by GlobalState.init
 
     def record(self, nbytes: int) -> None:
+        if not self.enabled:
+            return
         with self._lock:
             now = time.monotonic()
             self._window_bytes += nbytes
@@ -98,6 +101,7 @@ class GlobalState:
                 return
             refresh_level()
             self.config = config or Config.from_env()
+            self.telemetry.enabled = self.config.telemetry_on
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.

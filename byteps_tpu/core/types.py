@@ -214,3 +214,18 @@ class TensorTask:
         if self.queue_idx < len(self.queue_list):
             return self.queue_list[self.queue_idx]
         return None
+
+
+def trunc_divide_inplace(out: np.ndarray, n: int) -> None:
+    """``out //= n`` with C-style truncation toward zero — the
+    reference's ``div_(size)`` semantics for integer averaging (floor
+    division would skew every negative element by one). Exact for ALL
+    int values including INT_MIN: the tempting ``sign * (abs // n)``
+    trick wraps at abs(INT_MIN) and flips the sign. Shared by the
+    scheduler's completion callback and the blocking PS client so the
+    two host paths cannot diverge. Requires n > 0."""
+    rem = np.remainder(out, n)
+    np.floor_divide(out, n, out=out)
+    # trunc = floor + 1 exactly when the division was inexact and the
+    # dividend was negative (post-division, out < 0 iff dividend < 0)
+    np.add(out, (rem != 0) & (out < 0), out=out, casting="unsafe")

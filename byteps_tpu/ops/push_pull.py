@@ -56,7 +56,10 @@ def psum_tree(tree: Any, axis: str = DP_AXIS, average: bool = True) -> Any:
 
         def avg(g):
             if jnp.issubdtype(g.dtype, jnp.integer):
-                return g // n
+                # lax.div truncates toward zero like the reference's C++
+                # div_(size) — floor division would skew every negative
+                # element by one
+                return jax.lax.div(g, jnp.asarray(n, g.dtype))
             return g / n
 
         summed = jax.tree.map(avg, summed)
@@ -79,7 +82,14 @@ def _scatter_leaf(g: jnp.ndarray, axis: str, average: bool) -> jnp.ndarray:
     out = jax.lax.psum_scatter(flat.reshape(n, -1), axis_name=axis,
                                scatter_dimension=0, tiled=False)
     if average:
-        out = out / n
+        if jnp.issubdtype(out.dtype, jnp.integer):
+            # keep int dtype + truncating semantics, matching psum_tree
+            # (true division would silently promote shards to float and
+            # make the scatter/gather pair disagree with the allreduce
+            # path on int tensors)
+            out = jax.lax.div(out, jnp.asarray(n, out.dtype))
+        else:
+            out = out / n
     return out
 
 
@@ -157,8 +167,23 @@ def _cached_push_pull(mesh: Mesh, shape, dtype, average: bool, axis: str):
     return jax.jit(_pp)
 
 
+@functools.lru_cache(maxsize=512)
+def _cached_push_pull_replicated(mesh: Mesh, shape, dtype, average: bool,
+                                 axis: str):
+    """Unstacked variant: the input is the replicated value every device
+    contributes (in_specs=P()), so the eager path never materializes an
+    n_devices-times-larger stacked copy just to reshard it."""
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+    def _pp(x):
+        return psum_tree(x, axis=axis, average=average)
+
+    return jax.jit(_pp)
+
+
 def push_pull(tensor, name: Optional[str] = None, average: bool = True,
-              axis: str = DP_AXIS, priority: int = 0, stacked: bool = False):
+              axis: str = DP_AXIS, priority: Optional[int] = None,
+              stacked: bool = False):
     """Horovod-compatible eager push_pull.
 
     With ``stacked=True``, ``tensor`` carries one slice per mesh device on
@@ -178,6 +203,7 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
     mesh = state.mesh
     n = mesh.shape.get(axis, 1)
 
+    replicated = False
     if _mesh_spans_processes(mesh):
         # Global-mesh multi-process mode: this process contributes values
         # for its own devices; the global array is assembled across
@@ -192,21 +218,31 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
                     f"stacked push_pull expects leading dim {n} (mesh "
                     f"'{axis}' size), got shape {x.shape}")
         else:
-            x = jnp.broadcast_to(x, (n,) + x.shape)
+            # the replicated value feeds a P()-in_specs shard_map
+            # directly — no n_devices-times stacked copy is built
+            replicated = True
+
+    out_shape = tuple(x.shape) if replicated else tuple(x.shape[1:])
+    if int(np.prod(out_shape)) == 0:
+        # zero-element tensors carry no data: skip the collectives and
+        # the PS tier entirely (init_tensor rejects zero-size
+        # declarations, and the sum of nothing is nothing)
+        return jnp.zeros(out_shape, x.dtype)
 
     if name is not None:
-        ctx = state.registry.init_tensor(
-            name, int(np.prod(x.shape[1:]) or 1) * x.dtype.itemsize,
+        state.registry.init_tensor(
+            name, int(np.prod(out_shape)) * x.dtype.itemsize,
             DataType.from_np(x.dtype))
-        ctx.priority = priority
-
-    if name is not None:
         from ..utils.logging import debug_sample
         # pass the raw array: debug_sample only materializes (np.asarray →
         # device sync + D2H) after its needle check, keeping the hot
         # collective path free of forced transfers when sampling is off
         debug_sample(state.config, name, "INPUT", tensor)
-    fn = _cached_push_pull(mesh, tuple(x.shape[1:]), str(x.dtype), average, axis)
+    if replicated:
+        fn = _cached_push_pull_replicated(mesh, out_shape, str(x.dtype),
+                                          average, axis)
+    else:
+        fn = _cached_push_pull(mesh, out_shape, str(x.dtype), average, axis)
     out = fn(x)
     state.telemetry.record(out.nbytes * n)
 
@@ -221,7 +257,8 @@ def push_pull(tensor, name: Optional[str] = None, average: bool = True,
         from ..server.client import ps_round_trip
         host = np.asarray(out).reshape(-1)
         out = jnp.asarray(
-            ps_round_trip(state, name, host, average).reshape(out.shape))
+            ps_round_trip(state, name, host, average,
+                          priority=priority).reshape(out.shape))
 
     if name is not None:
         from ..utils.logging import debug_sample
@@ -252,6 +289,7 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
         # same local-stack contract as multi-process push_pull; root_rank
         # indexes the GLOBAL device order on the axis
         x = _local_stack(tensor, mesh, axis, stacked, "broadcast")
+        out = _cached_broadcast(mesh, root_rank % n, axis)(x)
     else:
         x = jnp.asarray(tensor)
         if stacked:
@@ -259,9 +297,10 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
                 raise ValueError(
                     f"stacked broadcast expects leading dim {n} (mesh "
                     f"'{axis}' size), got shape {x.shape}")
+            out = _cached_broadcast(mesh, root_rank % n, axis)(x)
         else:
-            x = jnp.broadcast_to(x, (n,) + x.shape)
-    out = _cached_broadcast(mesh, root_rank % n, axis)(x)
+            # replicated input: no n-times stacked copy (see push_pull)
+            out = _cached_broadcast_replicated(mesh, root_rank % n, axis)(x)
 
     if state.ps_client is not None and state.config.num_workers > 1:
         # cross-worker tier: the reference's broadcast IS zero-non-root +
@@ -288,6 +327,21 @@ def _cached_broadcast(mesh: Mesh, root_rank: int, axis: str):
         local = v.reshape(v.shape[1:])
         idx = jax.lax.axis_index(axis)
         contrib = jnp.where(idx == root_rank, local, jnp.zeros_like(local))
+        return jax.lax.psum(contrib, axis_name=axis)
+
+    return jax.jit(_bcast)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_broadcast_replicated(mesh: Mesh, root_rank: int, axis: str):
+    """Unstacked variant (replicated input, in_specs=P()): the collective
+    still runs — asserting device agreement and keeping parity with the
+    stacked path — without building an n-times stacked copy first."""
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+    def _bcast(v):
+        idx = jax.lax.axis_index(axis)
+        contrib = jnp.where(idx == root_rank, v, jnp.zeros_like(v))
         return jax.lax.psum(contrib, axis_name=axis)
 
     return jax.jit(_bcast)

@@ -21,6 +21,7 @@ import numpy as np
 from ..config import Config
 from ..core.types import (
     DataType, Partition, RequestType, TensorContext, get_command_type,
+    trunc_divide_inplace,
 )
 from ..native.build import build
 from ..utils.logging import log
@@ -107,7 +108,8 @@ def build_rowsparse_payload(p: Partition, nz: np.ndarray,
 
 
 def ps_round_trip(state, name: str, host: np.ndarray,
-                  average: bool) -> np.ndarray:
+                  average: bool,
+                  priority: Optional[int] = None) -> np.ndarray:
     """Shared get-or-declare + server round-trip for one flat host tensor:
     used by both the eager push_pull PS tier and make_ps_train_step.
 
@@ -121,7 +123,8 @@ def ps_round_trip(state, name: str, host: np.ndarray,
         handle = state.handles.allocate(name)
         state.scheduler.submit(ctx, host, handle, average,
                                state.config.num_workers,
-                               version=state.next_version(name))
+                               version=state.next_version(name),
+                               priority=priority)
         # scheduler records telemetry per-partition on completion
         return state.handles.wait_and_clear(handle.id)
     out = state.ps_client.push_pull(
@@ -333,7 +336,9 @@ class PSClient:
         self._round_trip(ctx, flat, out)
         if average and num_workers and num_workers > 1:
             if np.issubdtype(dtype, np.integer):
-                out //= num_workers
+                # truncation toward zero (the reference's C++
+                # div_(size)); shared helper — exact incl. INT_MIN
+                trunc_divide_inplace(out, num_workers)
             else:
                 out /= num_workers
         return out

@@ -87,3 +87,40 @@ def test_rank_size_defaults(bps):
     assert bps.size() == 1
     assert bps.local_rank() == 0
     assert bps.local_size() == 1
+
+
+def test_int_average_truncates_toward_zero(bps):
+    """Integer averaging must truncate toward zero (the reference's C++
+    div_(size) semantics): floor division would skew every negative
+    element by one (round-4 review regression)."""
+    from byteps_tpu.core.state import get_state
+    mesh = get_state().mesh
+
+    # per-device contributions summing to (-3, 3) over n=8: trunc(-3/8)
+    # is 0 where floor(-3/8) would be -1 — the distinguishing case
+    x = np.zeros((8, 2), np.int32)
+    x[0] = (-3, 3)  # sum over devices: (-3, 3); /8 trunc -> (0, 0)
+    out = np.asarray(bps.push_pull(x, average=True, stacked=True))
+    np.testing.assert_array_equal(out, np.array([0, 0], np.int32))
+    assert out.dtype == np.int32
+
+    # in-jit reduce_scatter keeps int dtype and truncating semantics
+    def f(t):
+        shards = reduce_scatter_tree(t, axis="dp", average=True)
+        return all_gather_tree(shards, t, axis="dp")
+
+    # replicated -3 per device: psum=-24, /8 trunc = -3 exactly; the
+    # point here is int dtype preservation through scatter/gather
+    tree = {"g": jnp.full((8,), -3, jnp.int32)}
+    out2 = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                                 check_vma=False))(tree)
+    assert out2["g"].dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out2["g"]),
+                                  np.full((8,), -3, np.int32))
+
+
+def test_zero_size_tensor_passes_through(bps):
+    """Zero-element tensors skip the collectives and the PS tier (the
+    registry rejects zero-size declarations) — round-4 review fix."""
+    out = bps.push_pull(np.zeros((0, 4), np.float32), name="zempty")
+    assert np.asarray(out).shape == (0, 4)
