@@ -12,7 +12,6 @@ pjit/shard_map instead of autograd hooks.
 
 from __future__ import annotations
 
-import io
 import pickle
 from typing import Any, Optional
 
@@ -22,7 +21,7 @@ import numpy as np
 import optax
 
 from ..core.state import get_state
-from ..ops.push_pull import psum_tree, reduce_scatter_tree, all_gather_tree, broadcast
+from ..ops.push_pull import psum_tree, broadcast
 from ..parallel.mesh import DP_AXIS
 
 __all__ = [
@@ -155,16 +154,28 @@ def broadcast_parameters(params: Any, root_rank: int = 0,
     return treedef.unflatten(out)
 
 
-def broadcast_object(obj: Any, root_rank: int = 0, axis: str = DP_AXIS) -> Any:
+def broadcast_object(obj: Any, root_rank: int = 0, axis: str = DP_AXIS,
+                     name: str = "obj") -> Any:
     """Broadcast an arbitrary picklable object from the root.
 
     Reference: byteps/torch/__init__.py:419-459 (cloudpickle -> byte tensor ->
     push_pull). In a single-controller JAX process all mesh devices are driven
     by the same Python, so the object is already shared; the byte-tensor round
     trip is kept for behavioral parity (it exercises the same collective path
-    and will matter in multi-process mode).
+    and matters in multi-worker PS mode). Like the reference, the payload
+    LENGTH is broadcast first: each worker's pickle of its local object can
+    differ in size, and the PS tier needs every worker pushing equal-sized
+    buffers under one key. ``name`` disambiguates concurrent broadcasts
+    (stable keys must match across workers).
     """
     buf = pickle.dumps(obj)
-    arr = jnp.frombuffer(np.frombuffer(buf, dtype=np.uint8), dtype=jnp.uint8)
-    out = broadcast(arr, root_rank=root_rank, axis=axis)
+    nm = "bcastobj/" + name
+    ln = broadcast(np.asarray([len(buf)], np.int32), root_rank=root_rank,
+                   axis=axis, name=nm + "/len")
+    root_len = int(np.asarray(ln)[0])
+    payload = np.zeros(root_len, np.uint8)
+    take = min(len(buf), root_len)
+    payload[:take] = np.frombuffer(buf, np.uint8)[:take]
+    out = broadcast(payload, root_rank=root_rank, axis=axis,
+                    name=nm + "/payload")
     return pickle.loads(np.asarray(out).tobytes())

@@ -516,7 +516,12 @@ def make_async_ps_train_step(
         local_step, mesh=mesh, in_specs=(P(), P(), P(axis)),
         out_specs=(P(), P(), P()), check_vma=False))
 
-    seeded = set()  # names whose initial weights were init-pushed
+    # seeding is keyed to the client that received it: suspend/resume
+    # replaces state.ps_client with fresh (unseeded) servers, and a stale
+    # `seeded` set would skip init_weights — the pull would then return
+    # bare deltas and silently destroy the model (the sync paths carry
+    # the same client-keyed guard on their compression registry)
+    seed_state = {"client": None, "names": set()}
 
     def step(params, opt_state, batch):
         state = get_state()
@@ -525,6 +530,10 @@ def make_async_ps_train_step(
         if client is None:
             params = jax.tree.map(jnp.add, params, delta)
             return params, opt_state, loss
+        if seed_state["client"] is not client:
+            seed_state["client"] = client
+            seed_state["names"] = set()
+        seeded = seed_state["names"]
         paths, treedef = jax.tree_util.tree_flatten_with_path(params)
         deltas = jax.tree.leaves(delta)
         leaves = []
@@ -539,19 +548,17 @@ def make_async_ps_train_step(
             leaves.append((ctx, leaf, np.asarray(d).reshape(-1)))
 
         # overlap the per-leaf round trips (they'd otherwise serialize the
-        # step on sum-of-RTTs); a dedicated pool, NOT client._pool — these
-        # calls block on client-pool futures and would deadlock it
-        import concurrent.futures
-
+        # step on sum-of-RTTs) on the shared tensor-level pool — NOT
+        # client._pool (these calls block on client-pool futures and
+        # would deadlock it), and not a per-step executor (spawn/join of
+        # 16 threads every step on the hot path)
         def one(item):
             ctx, leaf, d = item
             out = client.push_delta_pull_weights(ctx, d)
             state.telemetry.record(out.nbytes * 2)
             return jnp.asarray(out.reshape(leaf.shape))
 
-        with concurrent.futures.ThreadPoolExecutor(
-                max_workers=min(16, len(leaves) or 1)) as pool:
-            pulled = list(pool.map(one, leaves))
+        pulled = list(_comp_pool().map(one, leaves))
         params = treedef.unflatten(pulled)
         return params, opt_state, loss
 

@@ -9,9 +9,11 @@ with ``byteps_tpu.jax.distributed_optimizer``.
 """
 
 from .mixed_precision import (  # noqa: F401
+    LossScaleState,
     MixedPrecisionPolicy,
     cast_to_compute,
     cast_to_param,
+    current_loss_scale,
     dynamic_loss_scaling,
     mixed_precision_optimizer,
 )

@@ -86,6 +86,29 @@ def local_size() -> int:
 _handles = HandleManager()
 
 
+def _to_host(t: torch.Tensor) -> np.ndarray:
+    """torch tensor -> numpy for the wire. ``bfloat16`` has no torch
+    ``.numpy()`` path (TypeError), but the wire layer speaks BFLOAT16
+    (DataType.BFLOAT16, travels as uint16; server sums via f32
+    accumulate): view the bits as int16 and re-view as
+    ``ml_dtypes.bfloat16`` — bit-exact, no f32 round trip."""
+    t = t.detach()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return (t.contiguous().cpu().view(torch.int16).numpy()
+                .view(ml_dtypes.bfloat16))
+    return t.cpu().numpy()
+
+
+def _from_host(out: np.ndarray) -> torch.Tensor:
+    """Inverse of _to_host for the pulled aggregate (torch.from_numpy
+    rejects ml_dtypes.bfloat16 arrays)."""
+    out = np.ascontiguousarray(out)
+    if out.dtype.name == "bfloat16":
+        return torch.from_numpy(out.view(np.int16)).view(torch.bfloat16)
+    return torch.from_numpy(out)
+
+
 def _submit(host: np.ndarray, name: str, average: bool,
             priority: Optional[int]) -> Handle:
     state = get_state()
@@ -141,7 +164,7 @@ def push_pull_async(tensor: torch.Tensor, average: bool = True,
         raise ValueError(
             "push_pull_async requires a stable tensor name (keys must "
             "match across workers; operations.cc:420-427)")
-    h = _submit(tensor.detach().cpu().numpy(), name, average, priority)
+    h = _submit(_to_host(tensor), name, average, priority)
     h._torch_out = tensor
     return h.id
 
@@ -156,8 +179,7 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> torch.Tensor:
     out = out.reshape(h._shape)
     target: torch.Tensor = h._torch_out
     with torch.no_grad():
-        target.copy_(torch.from_numpy(np.ascontiguousarray(out))
-                     .to(target.dtype))
+        target.copy_(_from_host(out).to(target.dtype))
     return target
 
 
@@ -201,7 +223,7 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> None:
     is_root = state.config.worker_id == root_rank
     handles = []
     for name, t in _named_tensors(params):
-        host = t.detach().cpu().numpy()
+        host = _to_host(t)
         if not is_root:
             host = np.zeros_like(host)
         h = _submit(host, "bcast_param/" + name, False, None)
@@ -313,7 +335,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 # densify locally, ship only the nonzero rows
                 # (kRowSparsePushPull); the aggregated grad comes back
                 # dense, which every torch optimizer accepts
-                host2d = grad.coalesce().to_dense().detach().cpu().numpy()
+                host2d = _to_host(grad.coalesce().to_dense())
                 h = _submit_rowsparse(host2d, "grad/" + name, True)
                 self._handles[p] = h
                 self._wire_shape[p] = host2d.shape
@@ -324,7 +346,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 # format: densify and take the ordinary dense path
                 grad = grad.coalesce().to_dense()
             comp, ctx = self._compression.compress(grad)
-            host = comp.detach().cpu().numpy()
+            host = _to_host(comp)
             h = _submit(host, "grad/" + name, True, None)
             self._handles[p] = h
             self._ctx[p] = ctx
@@ -335,7 +357,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def synchronize(self) -> None:
         for p, h in list(self._handles.items()):
             out = _wait(h).reshape(self._wire_shape[p])
-            t = torch.from_numpy(np.ascontiguousarray(out))
+            t = _from_host(out)
             if p in self._sparse:
                 # the aggregate is dense; REPLACE the sparse grad object
                 with torch.no_grad():
@@ -386,6 +408,7 @@ class DistributedDataParallel(torch.nn.Module):
         self.module = module
         broadcast_parameters(module.state_dict(), root_rank=0)
         self._handles: dict = {}
+        self._sparse: set = set()
         self._hook_refs = []
         for name, p in module.named_parameters():
             if p.requires_grad:
@@ -395,7 +418,18 @@ class DistributedDataParallel(torch.nn.Module):
 
     def _make_hook(self, name):
         def hook(p):
-            h = _submit(p.grad.detach().cpu().numpy(),
+            grad = p.grad
+            if grad.is_sparse and grad.dim() == 2:
+                # sparse embedding grads ride the row-sparse wire, like
+                # the optimizer's hook (nonzero rows only)
+                host2d = _to_host(grad.coalesce().to_dense())
+                self._handles[p] = _submit_rowsparse(
+                    host2d, "ddp_grad/" + name, True)
+                self._sparse.add(p)
+                return
+            if grad.is_sparse:
+                grad = grad.coalesce().to_dense()
+            h = _submit(_to_host(grad),
                         "ddp_grad/" + name, True, None)
             self._handles[p] = h
 
@@ -403,11 +437,17 @@ class DistributedDataParallel(torch.nn.Module):
 
     def sync_gradients(self) -> None:
         for p, h in list(self._handles.items()):
-            out = _wait(h).reshape(p.grad.shape)
+            out = _wait(h).reshape(p.shape)
+            t = _from_host(out)
             with torch.no_grad():
-                p.grad.copy_(torch.from_numpy(
-                    np.ascontiguousarray(out)).to(p.grad.dtype))
+                if p in self._sparse:
+                    # the aggregate is dense; REPLACE the sparse grad
+                    # (copy_ into a sparse tensor is not defined)
+                    p.grad = t.to(p.device, p.dtype)
+                else:
+                    p.grad.copy_(t.to(p.grad.dtype))
         self._handles.clear()
+        self._sparse.clear()
 
     def forward(self, *args, **kwargs):
         return self.module(*args, **kwargs)

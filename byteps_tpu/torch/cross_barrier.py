@@ -32,11 +32,10 @@ import queue
 import threading
 import time
 
-import numpy as np
 import torch
 
 from ..core.state import get_state
-from . import _submit, size
+from . import _from_host, _submit, _submit_rowsparse, _to_host, size
 
 
 class CrossBarrier:
@@ -58,6 +57,15 @@ class CrossBarrier:
         self._final_step = num_steps
         self._locks = {p: threading.Lock()
                        for p in self._opt._all_params()}
+        # fail at WRAP time for option flags whose update math the
+        # replicas below do not carry (maximize would even step the
+        # wrong direction); _update_one re-checks as a backstop
+        for group in self._opt.param_groups:
+            for flag in ("maximize", "amsgrad", "centered"):
+                if group.get(flag):
+                    raise ValueError(
+                        f"CrossBarrier does not replicate {flag}=True "
+                        f"update math; unwrap or drop the flag")
         self._inflight: dict = {}
         self._pushed_at: dict = {}   # param -> step of its last submit
         self._poller_error: Exception = None
@@ -104,15 +112,32 @@ class CrossBarrier:
         opt = self._opt
         name = opt._param_name.get(p, f"param.{id(p)}")
         grad = p.grad
+        if grad.is_sparse and grad.dim() == 2:
+            # sparse embedding grads ride the row-sparse wire like the
+            # adapter's own hook (torch/__init__.py): only nonzero rows
+            # travel; the aggregate comes back dense
+            if opt._backward_passes_per_step > 1:
+                grad = grad / opt._backward_passes_per_step
+            host2d = _to_host(grad.coalesce().to_dense())
+            self._locks[p].acquire()
+            self._pushed_at[p] = self._step
+            h = _submit_rowsparse(host2d, "grad/" + name, True)
+            self._inflight[p] = h
+            self._event_queue.put((p, h, None, host2d.shape, True))
+            return
+        if grad.is_sparse:
+            # non-2D sparse: densify onto the dense wire (no row
+            # structure; .numpy() on sparse raises inside backward)
+            grad = grad.coalesce().to_dense()
         if opt._backward_passes_per_step > 1:
             grad = grad / opt._backward_passes_per_step
         comp, ctx = opt._compression.compress(grad)
-        host = comp.detach().cpu().numpy()
+        host = _to_host(comp)
         self._locks[p].acquire()
         self._pushed_at[p] = self._step
         h = _submit(host, "grad/" + name, True, None)
         self._inflight[p] = h
-        self._event_queue.put((p, h, ctx, host.shape))
+        self._event_queue.put((p, h, ctx, host.shape, False))
 
     def _poll(self) -> None:
         """FIFO completion poller (cross_barrier.py:161-190): when a
@@ -122,23 +147,36 @@ class CrossBarrier:
             item = self._event_queue.get()
             if item[0] is None:
                 return
-            p, h, ctx, wire_shape = item
+            p, h, ctx, wire_shape, sparse = item
             if not h.done():
                 self._event_queue.put(item)
                 time.sleep(0.0005)
                 continue
             try:
                 out = h.wait().reshape(wire_shape)
-                t = torch.from_numpy(np.ascontiguousarray(out))
-                t = self._opt._compression.decompress(t, ctx)
+                t = _from_host(out)
+                if not sparse:
+                    t = self._opt._compression.decompress(t, ctx)
                 with torch.no_grad():
-                    p.grad.copy_(t.to(p.grad.dtype).reshape(p.grad.shape))
+                    dt = p.dtype if sparse else p.grad.dtype
+                    t = t.to(dt).reshape(p.shape)
+                    if sparse or p.grad.is_sparse:
+                        # the aggregate is dense; REPLACE the sparse
+                        # grad object (the update replicas assume dense)
+                        p.grad = t.to(p.device)
+                    else:
+                        p.grad.copy_(t)
                 self._update_one(p)
                 p.grad.zero_()
             except Exception as e:  # noqa: BLE001 - re-raised in step()
                 self._poller_error = e
                 self._inflight.pop(p, None)
                 self._locks[p].release()
+                # the poller exits: other in-flight params keep their
+                # locks held (releasing them from here would race a
+                # pre_forward waiter mid-acquire into a double release);
+                # pre_forward's error-aware acquire surfaces
+                # _poller_error instead of hanging on them
                 return
             self._inflight.pop(p, None)
             self._locks[p].release()
@@ -161,9 +199,16 @@ class CrossBarrier:
         def pre_forward(mod, _inputs):
             for p in mod.parameters(recurse=False):
                 lock = self._locks.get(p)
-                if lock is not None:
-                    with lock:
-                        pass
+                if lock is None:
+                    continue
+                # error-aware block: if the poller died, in-flight
+                # params' locks are never released — poll with a
+                # timeout and surface the poller's error instead of
+                # hanging the forward pass forever
+                while not lock.acquire(timeout=0.5):
+                    if self._poller_error is not None:
+                        raise self._poller_error
+                lock.release()
 
         for mod in leaves:
             mod.register_forward_pre_hook(pre_forward)
@@ -211,7 +256,7 @@ class CrossBarrier:
             return
         while self._inflight and self._poller_error is None:
             time.sleep(0.001)
-        self._event_queue.put((None, None, None, None))
+        self._event_queue.put((None, None, None, None, None))
         self._poller.join(timeout=30)
         if self._poller_error is not None:
             raise self._poller_error
@@ -233,6 +278,15 @@ class CrossBarrier:
         # would silently accept subclasses with DIFFERENT update math
         # (torch's AdamW subclasses Adam)
         base = type(opt).__mro__[1]
+        # option flags that change the update MATH (not just
+        # hyperparameters) and are not replicated below: accepting them
+        # would silently apply a different — for maximize, opposite —
+        # update than torch would
+        for flag in ("maximize", "amsgrad", "centered"):
+            if group.get(flag):
+                raise ValueError(
+                    f"CrossBarrier does not replicate {flag}=True "
+                    f"update math; unwrap or drop the flag")
         if base is torch.optim.SGD:
             self._sgd(p, group)
         elif base is torch.optim.Adam:

@@ -138,3 +138,47 @@ def test_cross_barrier_rejects_unsupported_optimizer(bps_torch):
     loss.backward()              # hooks submit; poller hits _update_one
     with pytest.raises(ValueError, match="supports SGD"):
         opt.drain()
+
+
+def test_cross_barrier_rejects_unreplicated_flags(bps_torch):
+    """Option flags that change the update math (maximize/amsgrad/
+    centered) must fail at wrap time, not silently step differently
+    (round-4 review regression)."""
+    from byteps_tpu.torch.cross_barrier import CrossBarrier
+
+    model = _mk_model(3)
+    opt = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1, maximize=True),
+        named_parameters=model.named_parameters())
+    with pytest.raises(ValueError, match="maximize"):
+        CrossBarrier(model, opt, num_steps=5)
+
+
+def test_cross_barrier_sparse_embedding(bps_torch):
+    """Sparse embedding grads ride the row-sparse wire through the
+    barrier-crossing hook (previously crashed in .numpy() inside
+    backward) and training still converges."""
+    from byteps_tpu.torch.cross_barrier import CrossBarrier
+
+    torch.manual_seed(11)
+    model = torch.nn.Sequential(
+        torch.nn.Embedding(40, 6, sparse=True),
+        torch.nn.Flatten(), torch.nn.Linear(6 * 4, 4))
+    opt = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.2),
+        named_parameters=model.named_parameters())
+    cb = CrossBarrier(model, opt, num_steps=12)
+    cb.step()  # step 0: broadcast-time eager step
+    rng = np.random.RandomState(0)
+    x = torch.from_numpy(rng.randint(0, 40, (32, 4)))
+    y = torch.from_numpy(rng.randint(0, 4, 32).astype(np.int64))
+    losses = []
+    for _ in range(10):
+        cb.zero_grad()
+        loss = torch.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        cb.step()
+        losses.append(float(loss))
+    cb.drain()
+    assert cb._poller_error is None
+    assert losses[-1] < losses[0], losses

@@ -261,3 +261,31 @@ def test_sparse_embedding_gradients(bpt_ps):
         np.testing.assert_allclose(p1.detach().numpy(),
                                    p2.detach().numpy(),
                                    rtol=2e-5, atol=2e-5, err_msg=n1)
+
+
+def test_bf16_push_pull_roundtrip(bpt_ps):
+    """bfloat16 tensors must reach the wire (DataType.BFLOAT16) instead
+    of crashing in .numpy() — round-4 review regression. Bit-exact
+    through the 1-worker PS sum."""
+    x = torch.randn(257, dtype=torch.float32).to(torch.bfloat16)
+    out = bpt_ps.push_pull(x.clone(), average=True, name="bf16t")
+    assert out.dtype == torch.bfloat16
+    assert torch.equal(out, x)
+
+
+def test_bf16_optimizer_grad_hook(bpt_ps):
+    """A bf16 model trains through the grad-hook path (the hook exports
+    grads host-side; bf16 previously raised inside backward)."""
+    model = torch.nn.Linear(8, 4).to(torch.bfloat16)
+    opt = bpt_ps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    x = torch.randn(16, 8).to(torch.bfloat16)
+    loss0 = None
+    for _ in range(5):
+        opt.zero_grad()
+        loss = model(x).square().mean()
+        loss.backward()
+        opt.step()
+        loss0 = loss0 if loss0 is not None else float(loss)
+    assert float(loss) < loss0
