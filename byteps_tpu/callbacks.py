@@ -111,14 +111,39 @@ class MetricAverageCallback(Callback):
     name -> float dict."""
 
     def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None:
+        import os
+
         import byteps_tpu as bps
+        from .core.state import get_state
 
         metrics = state.get("metrics")
         if not metrics:
             return
-        for name in sorted(metrics):
-            v = np.asarray([float(metrics[name])], np.float32)
-            out = bps.push_pull(v, name=f"metric/{name}", average=True)
+        if get_state().scheduler is None:
+            # no PS: the ICI mean cannot stall on a missing peer push
+            for name in sorted(metrics):
+                v = np.asarray([float(metrics[name])], np.float32)
+                out = bps.push_pull(v, name=f"metric/{name}", average=True)
+                metrics[name] = float(np.asarray(out)[0])
+            return
+        # PS tier: submit all, then drain under a deadline — a metric
+        # key logged by only one worker can never reach num_workers
+        # contributions, and hanging the job at epoch end with no
+        # diagnostic is the worst failure mode
+        timeout = float(os.environ.get("BYTEPS_METRIC_TIMEOUT_S", "60"))
+        hs = {name: bps.push_pull_async(
+                  np.asarray([float(metrics[name])], np.float32),
+                  f"metric/{name}", average=True)
+              for name in sorted(metrics)}
+        for name, h in hs.items():
+            try:
+                out = bps.synchronize(h, timeout=timeout)
+            except TimeoutError as e:
+                raise TimeoutError(
+                    f"metric {name!r}: cross-worker average timed out "
+                    f"after {timeout:.0f}s — every worker must log the "
+                    f"SAME metric keys each epoch; "
+                    f"BYTEPS_METRIC_TIMEOUT_S overrides") from e
             metrics[name] = float(np.asarray(out)[0])
 
 

@@ -178,20 +178,29 @@ def launch_workers(command: Sequence[str],
     cmd = _maybe_gdb(list(command))
 
     procs: List[subprocess.Popen] = []
-    for r in range(local_size):
-        cores = core_sets[r]
+    try:
+        for r in range(local_size):
+            cores = core_sets[r]
 
-        def preexec(cores=cores):
-            if cores:
-                try:
-                    os.sched_setaffinity(0, set(cores))
-                except OSError:
-                    pass
+            def preexec(cores=cores):
+                if cores:
+                    try:
+                        os.sched_setaffinity(0, set(cores))
+                    except OSError:
+                        pass
 
-        log.info("launching worker local_rank=%d cores=%s cmd=%s",
-                 r, cores or "any", shlex.join(cmd))
-        procs.append(subprocess.Popen(
-            cmd, env=_child_env(r, local_size), preexec_fn=preexec))
+            log.info("launching worker local_rank=%d cores=%s cmd=%s",
+                     r, cores or "any", shlex.join(cmd))
+            procs.append(subprocess.Popen(
+                cmd, env=_child_env(r, local_size), preexec_fn=preexec))
+    except Exception:
+        # a failed spawn (fork ENOMEM, missing gdb wrapper...) must tear
+        # down already-launched ranks — they would otherwise sit forever
+        # in the collective init barrier waiting for the missing peers
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        raise
 
     # wait in completion order, not rank order: a crashed rank must tear
     # down survivors that are blocked on it (e.g. in a collective), which

@@ -95,8 +95,14 @@ def run_plans(plans: List[Dict[str, str]], log_dir: str = "sshlog") -> int:
     os.makedirs(log_dir, exist_ok=True)
     procs: List[Optional[subprocess.Popen]] = []
     codes: List[Optional[int]] = []
+    # per-plan log names: a host listed N times (N workers on one box)
+    # must not truncate/interleave one shared file
+    seen: dict = {}
     for p in plans:
-        path = os.path.join(log_dir, f"{p['role']}-{p['host']}.log")
+        n = seen.get((p["role"], p["host"]), 0)
+        seen[(p["role"], p["host"])] = n + 1
+        suffix = f"-{n}" if n else ""
+        path = os.path.join(log_dir, f"{p['role']}-{p['host']}{suffix}.log")
         try:
             f = open(path, "wb")
             procs.append(subprocess.Popen(shlex.split(p["ssh_cmd"]),

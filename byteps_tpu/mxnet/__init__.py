@@ -312,6 +312,13 @@ class _DistributedTrainerMixin:
             return intra
 
         compressor = compression_params["compressor"]
+        if (compression_params.get("momentum")
+                and "momentum" not in optimizer_params):
+            raise ValueError(
+                "compression_params momentum requires a 'momentum' value "
+                "in optimizer_params (the comm stack replaces the "
+                "framework momentum and needs its mu; reference "
+                "mxnet/__init__.py:236-317)")
         for param in param_list:
             for item in ("compressor", "ef", "momentum"):
                 val = compression_params.get(item)

@@ -249,7 +249,18 @@ def synchronize(handle: int, timeout: Optional[float] = None):
         with _mu:
             _pending.pop(handle, None)
         return tensor
-    out = get_state().handles.wait_and_clear(handle, timeout)
+    try:
+        out = get_state().handles.wait_and_clear(handle, timeout)
+    except TimeoutError:
+        raise  # still pending: keep the entry so the call can retry
+    except Exception:
+        # resolved with an error: the round is over — drop the entry
+        # (keeping it would pin the NDArray for the process lifetime,
+        # and a retry would hit a misleading 'unknown handle' KeyError
+        # from the core manager, masking this error)
+        with _mu:
+            _pending.pop(handle, None)
+        raise
     with _mu:
         _pending.pop(handle, None)
     arr = out.reshape(shape)

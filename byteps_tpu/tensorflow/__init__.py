@@ -38,6 +38,8 @@ Reference parity map:
 
 from __future__ import annotations
 
+import itertools
+import os
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -140,6 +142,20 @@ def _submit_rowsparse(host2d: np.ndarray, name: str,
     return handle
 
 
+# per-wrapper-instance scope ids: two optimizers/tapes in one process
+# (e.g. GAN G/D) must not collide on positional PS keys — instance
+# construction order is the cross-worker contract (same script, same
+# order), exactly like declaration order for layer keys
+_instance_ids = itertools.count()
+
+def _metric_timeout_s() -> float:
+    """Cross-worker metric averaging deadline (read per call so setting
+    the env after import still works, like callbacks.py): a metric key
+    logged by only one worker can never aggregate; fail loudly instead
+    of hanging."""
+    return float(os.environ.get("BYTEPS_METRIC_TIMEOUT_S", "60"))
+
+
 def _auto_name(prefix: str, tensor) -> str:
     """Shape-derived default name. Names key the PS registry across
     steps, so repeated push_pulls of the same logical tensor MUST reuse
@@ -148,6 +164,10 @@ def _auto_name(prefix: str, tensor) -> str:
     explicitly, as the adapter's own tape/optimizer/broadcast paths
     do)."""
     shape = tuple(getattr(tensor, "shape", ()))
+    if any(d is None for d in shape):
+        raise ValueError(
+            f"{prefix}: tensor has dynamic dims {shape} — auto-names "
+            f"derive from the static shape, so pass an explicit name=")
     return f"{prefix}.{'x'.join(str(int(d)) for d in shape)}"
 
 
@@ -276,10 +296,32 @@ class _TapeWrapper:
     tensorflow/__init__.py:343-417 — same contract, delegation instead
     of dynamic subclassing)."""
 
-    def __init__(self, tape, compression, sparse_as_dense: bool):
+    def __init__(self, tape, compression, sparse_as_dense: bool,
+                 scope: Optional[str] = None):
         self._tape = tape
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
+        self._scope = scope  # None -> derived from the gradient shapes
+
+    def _resolve_scope(self, flat) -> str:
+        """Stable per-LOGICAL-tape scope: tapes are typically
+        re-constructed every step (the documented wrapping pattern), so
+        an instance counter would mint fresh PS keys each step and grow
+        the registry/server without bound; deriving the scope from the
+        gradient shape signature keeps keys stable across steps and
+        workers while two different models (e.g. GAN G/D) still get
+        distinct scopes. Two models with IDENTICAL shape signatures must
+        pass an explicit ``scope=`` to DistributedGradientTape."""
+        if self._scope is None:
+            import hashlib
+
+            sig = repr([None if g is None else
+                        (str(getattr(g, "shape", ())),
+                         str(getattr(g, "dtype", "")))
+                        for g in flat])
+            digest = hashlib.md5(sig.encode()).hexdigest()[:10]
+            self._scope = f"tfgrad_{digest}"
+        return self._scope
 
     def __enter__(self):
         self._tape.__enter__()
@@ -296,43 +338,97 @@ class _TapeWrapper:
         if size() <= 1:
             return grads
         flat = tf.nest.flatten(grads)
-        out = []
-        for i, g in enumerate(flat):
-            if g is None:
-                out.append(None)
-                continue
-            out.append(push_pull(
-                g, scope="tape", name=f"tfgrad/{i}",
-                compression=self._compression,
-                sparse_as_dense=self._sparse_as_dense))
+        out = _reduce_grads(flat, self._compression,
+                            self._sparse_as_dense,
+                            scope=self._resolve_scope(flat))
         return tf.nest.pack_sequence_as(grads, out)
 
 
 def DistributedGradientTape(gradtape, compression=Compression.none,
                             sparse_as_dense: bool = False,
                             device_dense: str = "", device_sparse: str = "",
-                            op=None):
+                            op=None, scope: Optional[str] = None):
     """Wrap a ``tf.GradientTape`` so ``gradient()`` returns
     cross-worker-averaged gradients. ``device_*``/``op`` accepted for
     reference signature compatibility (devices are meaningless on the
     host-side wire; the reduction is always average)."""
     del device_dense, device_sparse, op
-    return _TapeWrapper(gradtape, compression, sparse_as_dense)
+    return _TapeWrapper(gradtape, compression, sparse_as_dense, scope)
 
 
-def _reduce_grads(grads: List, compression, sparse_as_dense: bool) -> List:
-    """push_pull every non-None gradient under stable position names."""
+def _eager_sparse_submit(g, nm: str, compression, sparse_as_dense: bool):
+    """Submit phase for an eager IndexedSlices gradient (densified; rides
+    the row-sparse wire when 2D); returns resolve() -> dense tf.Tensor."""
+    dense_shape = [int(d) for d in g.dense_shape]
+    idx = _to_numpy(g.indices)
+    vals = _to_numpy(g.values).astype(np.float32)
+    host = np.zeros(dense_shape, np.float32)
+    np.add.at(host, idx, vals)  # duplicate ids accumulate
+    if sparse_as_dense or len(dense_shape) != 2:
+        wire, cctx = compression.compress(host)
+        h = _submit(wire, nm, True, None)
+        shape = wire.shape
+
+        def resolve():
+            out = _handles.wait_and_clear(h.id).reshape(shape)
+            return tf.constant(compression.decompress(out, cctx))
+
+        return resolve
+    h = _submit_rowsparse(host, nm, True)
+
+    def resolve():
+        return tf.constant(np.asarray(_handles.wait_and_clear(h.id)))
+
+    return resolve
+
+
+def _eager_dense_submit(g, nm: str, compression):
+    """Submit phase of an eager dense push_pull; returns resolve()."""
+    host = _to_numpy(g)
+    wire, cctx = compression.compress(host)
+    h = _submit(wire, nm, True, None)
+    shape = wire.shape
+
+    def resolve():
+        out = _handles.wait_and_clear(h.id).reshape(shape)
+        return tf.constant(compression.decompress(out, cctx))
+
+    return resolve
+
+
+def _reduce_grads(grads: List, compression, sparse_as_dense: bool,
+                  scope: str = "tfopt") -> List:
+    """push_pull every non-None gradient under stable position names,
+    submit-all-then-drain: an eager step pays one round-trip depth
+    instead of sum-of-RTTs over the layer count (the same argument
+    broadcast_variables makes for startup, applied to the hot path).
+    Graph mode already overlaps — independent py_function ops run
+    concurrently. ``scope`` is per-wrapper-instance (see _instance_ids).
+    """
     if size() <= 1:
         return list(grads)
-    out = []
+    resolvers = []
     for i, g in enumerate(grads):
+        nm = f"{scope}/{i}"
         if g is None:
-            out.append(None)
-            continue
-        out.append(push_pull(g, scope="opt", name=f"tfopt/{i}",
-                             compression=compression,
-                             sparse_as_dense=sparse_as_dense))
-    return out
+            resolvers.append(None)
+        elif isinstance(g, tf.IndexedSlices) and tf.executing_eagerly():
+            # eager sparse: same submit/resolve split as the dense path —
+            # a blocking push_pull here would re-serialize every later
+            # gradient behind the sparse round trip
+            resolvers.append(_eager_sparse_submit(g, nm, compression,
+                                                  sparse_as_dense))
+        elif (isinstance(g, tf.IndexedSlices)
+              or (tf.is_tensor(g) and not tf.executing_eagerly())):
+            # graph mode: builds a py_function op (non-blocking here;
+            # independent ops run concurrently under the Session/function)
+            res = push_pull(g, scope=scope, name=nm,
+                            compression=compression,
+                            sparse_as_dense=sparse_as_dense)
+            resolvers.append(lambda res=res: res)
+        else:
+            resolvers.append(_eager_dense_submit(g, nm, compression))
+    return [r() if r is not None else None for r in resolvers]
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
@@ -360,7 +456,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     if hasattr(base, "apply"):
         def _apply(self, grads, trainable_variables=None, **kwargs):
             grads = _reduce_grads(list(grads), self._bps_compression,
-                                  self._bps_sparse_as_dense)
+                                  self._bps_sparse_as_dense,
+                                  scope=self._bps_scope)
             if trainable_variables is None:
                 return base.apply(self, grads, **kwargs)
             return base.apply(self, grads, trainable_variables, **kwargs)
@@ -371,7 +468,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             pairs = list(grads_and_vars)
             grads = _reduce_grads([g for g, _ in pairs],
                                   self._bps_compression,
-                                  self._bps_sparse_as_dense)
+                                  self._bps_sparse_as_dense,
+                                  scope=self._bps_scope)
             return base.apply_gradients(
                 self, [(g, v) for g, (_, v) in zip(grads, pairs)],
                 *args, **kwargs)
@@ -382,6 +480,7 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     new = cls.from_config(optimizer.get_config())
     new._bps_compression = compression
     new._bps_sparse_as_dense = sparse_as_dense
+    new._bps_scope = f"tfopt{next(_instance_ids)}"
     return new
 
 
@@ -397,8 +496,21 @@ def load_model(filepath, custom_objects=None,
     opt = getattr(model, "optimizer", None)
     if opt is not None:
         wrapped = DistributedOptimizer(opt, compression=compression)
-        loss = getattr(model, "loss", None)
-        model.compile(optimizer=wrapped, loss=loss)
+        # preserve the saved compile settings (metrics, loss_weights,
+        # weighted_metrics...) — recompiling with only optimizer+loss
+        # would silently drop them; get_compile_config carries the full
+        # serialized set and compile() deserializes its entries
+        kw = {}
+        try:
+            ccfg = dict(model.get_compile_config() or {})
+        except Exception:  # noqa: BLE001 - older keras: no compile cfg
+            ccfg = {}
+        for key in ("metrics", "loss_weights", "weighted_metrics",
+                    "jit_compile", "steps_per_execution"):
+            if ccfg.get(key) is not None:
+                kw[key] = ccfg[key]
+        loss = ccfg.get("loss", getattr(model, "loss", None))
+        model.compile(optimizer=wrapped, loss=loss, **kw)
     return model
 
 
@@ -434,8 +546,18 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
     def on_epoch_end(self, epoch, logs=None):
         if not logs or size() <= 1:
             return
-        for k in sorted(logs):
-            val = np.asarray([logs[k]], np.float32)
-            out = _handles.wait_and_clear(
-                _submit(val, f"tfmetric/{k}", True, None).id)
+        hs = {k: _submit(np.asarray([logs[k]], np.float32),
+                         f"tfmetric/{k}", True, None)
+              for k in sorted(logs)}
+        for k, h in hs.items():
+            timeout = _metric_timeout_s()
+            try:
+                out = _handles.wait_and_clear(h.id, timeout=timeout)
+            except TimeoutError as e:
+                raise TimeoutError(
+                    f"metric {k!r}: cross-worker average timed out after "
+                    f"{timeout:.0f}s — every worker must log "
+                    f"the SAME metric keys each epoch (a key logged by "
+                    f"one worker alone can never aggregate); "
+                    f"BYTEPS_METRIC_TIMEOUT_S overrides") from e
             logs[k] = float(np.asarray(out)[0])

@@ -246,6 +246,30 @@ def restore(path: str, step: Optional[int] = None,
                 f"step {step} missing under {path} (non-root workers need "
                 f"example= to join the restore broadcast without a local "
                 f"checkpoint)")
+        # the zeros template is ONLY valid as this worker's contribution
+        # to a multi-worker restore broadcast (the root's values win);
+        # without one it would be handed back as the restored state and
+        # the caller would silently resume from a zeroed model
+        from ..core.state import get_state
+        from ..ops.push_pull import _mesh_spans_processes
+
+        st = get_state()
+        # repopulation happens via either tier: the PS broadcast
+        # (client + >1 workers) or the multi-process global mesh (ICI
+        # collectives, num_servers=0). A lazy PS that never connected
+        # cannot repopulate — raising there is correct, the old code
+        # silently returned zeros.
+        spans = st.mesh is not None and _mesh_spans_processes(st.mesh)
+        will_repopulate = broadcast and (
+            spans or (st.ps_client is not None
+                      and st.config.num_workers > 1))
+        if not will_repopulate:
+            raise FileNotFoundError(
+                f"step {step} missing under {path} and no multi-worker "
+                f"broadcast will repopulate it (broadcast={broadcast}, "
+                f"workers="
+                f"{st.config.num_workers if st.initialized else 1}); "
+                f"refusing to return a zeroed state")
         state = jax.tree.map(lambda leaf: np.zeros_like(np.asarray(leaf)),
                              example)
     if broadcast:
