@@ -30,7 +30,9 @@ def _env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None or v == "":
         return default
-    return v not in ("0", "false", "False", "FALSE", "off")
+    # case-insensitive, and "no" counts as false — an operator explicitly
+    # disabling a flag (OFF/No) must not silently enable it
+    return v.lower() not in ("0", "false", "off", "no")
 
 
 def _env_str(name: str, default: str) -> str:

@@ -148,9 +148,13 @@ def _route(x_flat: jnp.ndarray, router_w: jnp.ndarray, cfg: MoEConfig,
     # before any slot 1 (Switch priority: primary routes never lose space
     # to secondary ones).
     idx_flat = gate_idx.T.reshape(-1)                    # [k*T]
-    onehot = jax.nn.one_hot(idx_flat, E, dtype=jnp.float32)   # [k*T, E]
-    pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot)     # exclusive
-    pos = jnp.sum(pos_in_expert * onehot, axis=-1)       # [k*T]
+    # int32 cumsum: positions are exact for any token count (a float32
+    # cumsum stops representing consecutive integers past 2^24 routed
+    # slots, silently corrupting capacity assignment)
+    onehot_i = jax.nn.one_hot(idx_flat, E, dtype=jnp.int32)   # [k*T, E]
+    onehot = onehot_i.astype(jnp.float32)
+    pos_in_expert = (jnp.cumsum(onehot_i, axis=0) - onehot_i)  # exclusive
+    pos = jnp.sum(pos_in_expert * onehot_i, axis=-1)     # [k*T]
     keep = pos < cap
 
     slot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
@@ -222,7 +226,8 @@ def _moe_block(x, p, cos, sin, cfg: MoEConfig,
 
 def forward(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
             ep_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [B, S] -> (logits [B, S, vocab] fp32, mean aux loss)."""
+    """tokens [B, S] -> (logits [B, S, vocab] in cfg.dtype, mean aux
+    loss); next_token_xent does its math in fp32 (llama.py)."""
     B, S = tokens.shape
     cos, sin = L.rope_cache(cfg.as_llama(), S)
     x = params["embed"].astype(cfg.dtype)[tokens]

@@ -760,8 +760,11 @@ struct CompressorCfg {
     uint32_t base = (uint32_t)(s0 & 0xFFFFFFFFULL) ^ (uint32_t)step;
     out->resize(k);
     for (uint32_t i = 0; i < k; ++i) {
-      int32_t j = (int32_t)(uniform_at(i, base) * (float)n);
-      (*out)[i] = j < (int32_t)n - 1 ? j : (int32_t)n - 1;
+      // full 32-bit hash modulo n (bit-parity with rng.np_index_parallel):
+      // the float-uniform form had 24-bit granularity, capping distinct
+      // indices at 2^24 — wrong past n = 16.7M elements
+      uint32_t h = mm3_fin(i * 0x9E3779B1U + base);
+      (*out)[i] = (int32_t)(h % n);
     }
   }
 

@@ -54,6 +54,23 @@ def parse_bool_kwarg(kwargs: Dict[str, str], name: str,
     return str(kwargs.get(name, default)).lower() in ("1", "true", "yes")
 
 
+def parse_ef_kwarg(kwargs) -> bool:
+    """ONE truthiness rule for the ``ef`` kwarg across every tier
+    (device/collective, host/PS, server wire): the reference type string
+    "vanilla" or any boolean-true spelling enables vanilla error
+    feedback. Tier-divergent parsing silently dropped EF when a config
+    moved from the collective tier to the PS tier."""
+    v = str(kwargs.get("ef", "")).lower()
+    if v in ("vanilla", "true", "1", "yes"):
+        return True
+    if v in ("", "0", "false", "no", "none", "off"):
+        return False
+    # a typo ('vanila') must not silently drop EF — the exact failure
+    # mode this helper exists to prevent
+    raise ValueError(f"unknown ef type {kwargs.get('ef')!r}; "
+                     f"use 'vanilla' (or a boolean spelling)")
+
+
 def register_codec(name: str):
     def deco(fn):
         _REGISTRY[name] = fn
@@ -101,9 +118,21 @@ def make_compressor(kwargs: Dict[str, str], size: int) -> CompressorStack:
         raise ValueError(f"unknown compressor {name!r}; "
                          f"have {sorted(_REGISTRY)}")
     codec = _REGISTRY[name](kwargs, size)
-    use_ef = kwargs.get("ef", "") in ("vanilla", "true", "1")
+    use_ef = parse_ef_kwarg(kwargs)
     mu = None
-    if kwargs.get("momentum", "") == "nesterov":
+    mom = str(kwargs.get("momentum", "")).lower()
+    if mom and mom not in ("nesterov", "none", "0", "false", "no", "off"):
+        raise ValueError(f"unknown momentum type "
+                         f"{kwargs.get('momentum')!r}; use 'nesterov'")
+    if mom == "nesterov":
+        if not use_ef:
+            # same contract as the host tier (make_host_codec) and the
+            # reference stacking order (compressor.h:28-52: Momentum
+            # wraps ErrorFeedback wraps the codec) — a tier-divergent
+            # rule would silently change training semantics when a
+            # config moves between the collective and PS paths
+            raise ValueError("momentum requires ef=vanilla (reference "
+                             "stacking order, compressor.h:28-52)")
         mu = float(kwargs.get("momentum_mu", 0.9))
     return CompressorStack(codec=codec, use_ef=use_ef, momentum_mu=mu)
 

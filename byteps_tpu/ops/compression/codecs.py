@@ -95,6 +95,14 @@ class OnebitCodec(Codec):
         return flat * payload["scale"]
 
     def wire_bytes(self) -> int:
+        # report what this codec's active layout actually moves: the
+        # Pallas sublane-folded payload pads n to full 256-row blocks,
+        # so the portable ceil(n/32) count would under-report telemetry
+        # and scheduling credit by up to a block (badly for small
+        # leaves, whose minimum payload is one block)
+        if self.use_pallas and _on_tpu():
+            from .pallas_kernels import _LANES, _padded_rows
+            return (_padded_rows(self.size) * _LANES // 32) * 4 + 4
         return ((self.size + 31) // 32) * 4 + 4
 
 
@@ -166,8 +174,8 @@ class RandomkCodec(Codec):
             return randomk_indices(
                 jnp.asarray(uniform_base(self.seed, step)),
                 jnp.int32(self.size), self.k)
-        u = jnp_uniform_parallel(self.seed, self.k, mix=step)
-        return jnp.minimum((u * self.size).astype(jnp.int32), self.size - 1)
+        from .rng import jnp_index_parallel
+        return jnp_index_parallel(self.seed, self.k, self.size, mix=step)
 
     def compress(self, x: jnp.ndarray, step: int = 0) -> Dict[str, Any]:
         idx = self._indices(step)

@@ -18,9 +18,9 @@ rtol=1e-6):
 Wire layouts (little-endian):
 - onebit:    uint32 bits[ceil(n/32)], then f32 scale
 - topk:      int32 idx[k], then f32 val[k]
-- randomk:   int32 idx[k], then f32 val[k] (idx from the counter-based
-             murmur3 generator ``np_uniform_parallel``, seeded by
-             (seed, step) so worker and server agree)
+- randomk:   int32 idx[k], then f32 val[k] (idx = 32-bit counter-murmur3
+             hash mod n, ``np_index_parallel``, seeded by (seed, step)
+             so worker and server agree)
 - dithering: int8 levels[n], then f32 norm
 
 Error feedback (vanilla) and momentum (nesterov) run worker-side only, as
@@ -136,9 +136,10 @@ class HostRandomk(HostCodec):
 
     def indices(self, step: int) -> np.ndarray:
         # counter-based generator (parity with RandomkCodec._indices):
-        # vectorized, no per-draw Python loop on the per-step hot path
-        u = np_uniform_parallel(self.seed, self.k, mix=step)
-        return np.minimum((u * self.n).astype(np.int32), self.n - 1)
+        # full-32-bit hash modulo n — see rng.np_index_parallel for why
+        # the float-uniform form was wrong past n = 2^24
+        from .rng import np_index_parallel
+        return np_index_parallel(self.seed, self.k, self.n, mix=step)
 
     def compress(self, x: np.ndarray, step: int = 0) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
@@ -241,6 +242,17 @@ class HostDithering(HostCodec):
     partition: str = "linear"
     normalize: str = "max"
     seed: int = 0
+
+    def __post_init__(self):
+        # same bound as DitheringCodec and the C++ parser (ps.cc): a
+        # level must fit signed int8; s=255 (plausible under the
+        # reference's compressor_k convention) would silently wrap the
+        # int8 cast and flip signs on the wire while the server rejects
+        # the same kwargs — fail fast and symmetrically instead
+        if not 1 <= self.s <= 127:
+            raise ValueError(
+                f"dithering levels s={self.s} out of range [1, 127] "
+                f"(levels ship as signed int8 on the wire)")
     # "varint": delta+LEB128-coded nonzero indices + int8 levels on the
     # wire — the reference's coded sparse dithering format
     # (impl/dithering.cc:25-80, compressor/utils.h BitWriter), byte-
@@ -454,9 +466,14 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
     if native is not None:
         codec = native
     stack = codec
-    if kwargs.get("ef") == "vanilla":
+    from . import parse_ef_kwarg
+    if parse_ef_kwarg(kwargs):
         stack = HostErrorFeedback(stack)
-    if kwargs.get("momentum") == "nesterov":
+    mom = str(kwargs.get("momentum", "")).lower()
+    if mom and mom not in ("nesterov", "none", "0", "false", "no", "off"):
+        raise ValueError(f"unknown momentum type "
+                         f"{kwargs.get('momentum')!r}; use 'nesterov'")
+    if mom == "nesterov":
         if not isinstance(stack, HostErrorFeedback):
             raise ValueError("momentum requires ef=vanilla (reference "
                              "stacking order, compressor.h:28-52)")

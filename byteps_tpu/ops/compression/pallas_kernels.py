@@ -220,11 +220,16 @@ def dithering_levels(x: jnp.ndarray, norm: jnp.ndarray, base: jnp.ndarray,
     return levels.reshape(-1)[:n]
 
 
-def _randomk_idx_kernel(base_ref, size_ref, out_ref):
-    u = _kernel_uniform(_global_counter(base_ref[0], _BLOCK_ROWS))
-    size = size_ref[0]
-    idx = (u * size.astype(jnp.float32)).astype(jnp.int32)
-    out_ref[:] = jnp.minimum(idx, size - 1)
+def _randomk_hash_kernel(base_ref, out_ref):
+    """Raw murmur3 hash per lane (bitcast to int32 for VMEM); the caller
+    takes ``% size`` in plain XLA — keeping the mod outside the kernel
+    avoids relying on Mosaic uint32 remainder support while preserving
+    the full 32-bit index range (a float-uniform derivation caps
+    distinct indices at 2^24, wrong for size > 16.7M)."""
+    from .rng import mm3_finalize
+
+    h = mm3_finalize(_global_counter(base_ref[0], _BLOCK_ROWS))
+    out_ref[:] = pltpu.bitcast(h, jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -236,17 +241,16 @@ def randomk_indices(base: jnp.ndarray, size: jnp.ndarray, k: int,
     uncompressed element count."""
     rows = _padded_rows(k)
     base_arr = jnp.asarray(base, jnp.uint32).reshape(1)
-    size_arr = jnp.asarray(size, jnp.int32).reshape(1)
-    idx = pl.pallas_call(
-        _randomk_idx_kernel,
+    h = pl.pallas_call(
+        _randomk_hash_kernel,
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
         grid=(rows // _BLOCK_ROWS,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(base_arr, size_arr)
-    return idx.reshape(-1)[:k]
+    )(base_arr)
+    hu = h.reshape(-1)[:k].astype(jnp.uint32)
+    return (hu % jnp.asarray(size).astype(jnp.uint32)).astype(jnp.int32)

@@ -165,6 +165,31 @@ def jnp_uniform_parallel(seed: int, n: int, mix=0,
     return ((h >> jnp.uint32(8)).astype(jnp.float32) / float(1 << 24)).astype(dtype)
 
 
+def np_index_parallel(seed: int, k: int, size: int,
+                      mix: int = 0) -> np.ndarray:
+    """k pseudo-random indices in [0, size): the full 32-bit murmur3 hash
+    modulo size. The former float-uniform derivation ((u * size) with a
+    24-bit u) capped the distinct reachable indices at 2^24 — on leaves
+    past 16.7M elements most coordinates were deterministically NEVER
+    selected (never trained without EF; unbounded residual with EF).
+    Golden model; jnp/Pallas/C++ must stay bit-identical."""
+    base = uniform_base(seed, mix)
+    with np.errstate(over="ignore"):
+        h = (np.arange(k, dtype=np.uint32) * np.uint32(0x9E3779B1) + base) \
+            & np.uint32(0xFFFFFFFF)
+    h = _np_mm3(h)
+    return (h % np.uint32(size)).astype(np.int32)
+
+
+def jnp_index_parallel(seed: int, k: int, size, mix=0) -> jnp.ndarray:
+    """Bit-exact jnp twin of np_index_parallel; ``mix``/``size`` may be
+    traced."""
+    base = jnp.asarray(uniform_base(seed, mix))
+    h = mm3_finalize(jnp.arange(k, dtype=jnp.uint32)
+                     * jnp.uint32(0x9E3779B1) + base)
+    return (h % jnp.asarray(size).astype(jnp.uint32)).astype(jnp.int32)
+
+
 def np_uniform(seed: int, n: int, mix: int = 0, dtype=np.float32) -> np.ndarray:
     """[0,1) floats from the top 24 bits of each golden draw."""
     bits = np_xorshift128p(seed, n, mix)

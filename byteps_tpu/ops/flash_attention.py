@@ -47,27 +47,33 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Exact attention streaming over KV blocks: peak residency
     O(S * block_k) instead of O(S^2). q [B,S,H,D], k/v [B,S,Hkv,D]."""
     B, S, H, D = q.shape
-    groups = H // k.shape[2]
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
+    Hkv = k.shape[2]
+    groups = H // Hkv
     block_k = min(block_k, S)
     if S % block_k:
         raise ValueError(f"S={S} not divisible by block_k={block_k}")
     nk = S // block_k
     scale = 1.0 / np.sqrt(D)
     q32 = q.astype(jnp.float32)
-    # [nk, B, bk, H, D] so scan carries one block per step
-    ks = k.astype(jnp.float32).reshape(B, nk, block_k, H, D) \
-        .transpose(1, 0, 2, 3, 4)
-    vs = v.astype(jnp.float32).reshape(B, nk, block_k, H, D) \
-        .transpose(1, 0, 2, 3, 4)
+    # [nk, B, bk, Hkv, D] so scan carries one block per step. KV stay in
+    # COMPACT Hkv heads and original dtype here: a whole-sequence GQA
+    # repeat (+fp32 cast) before the scan would multiply KV residency by
+    # (H/Hkv)*(32/16) in HBM — on the backward-recompute path this module
+    # exists to keep small. The per-block expand happens in body (same
+    # arrangement as ring_attention.body).
+    ks = k.reshape(B, nk, block_k, Hkv, D).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(B, nk, block_k, Hkv, D).transpose(1, 0, 2, 3, 4)
     qpos = jnp.arange(S)
     kpos_blk = jnp.arange(block_k)
 
     def body(carry, blk):
         m, l, o = carry
         j, kb, vb = blk
+        kb = kb.astype(jnp.float32)
+        vb = vb.astype(jnp.float32)
+        if groups > 1:
+            kb = jnp.repeat(kb, groups, axis=2)
+            vb = jnp.repeat(vb, groups, axis=2)
         if causal:
             mask = qpos[:, None] >= (j * block_k + kpos_blk)[None, :]
         else:

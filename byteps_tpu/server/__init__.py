@@ -37,7 +37,8 @@ def run_server(port: Optional[int] = None,
     # per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
     # + BYTEPS_SERVER_DEBUG_KEY, server.cc:120-144,439-442)
     debug_key = -1
-    if os.environ.get("BYTEPS_SERVER_DEBUG", "") in ("1", "true"):
+    from ..config import _env_bool
+    if _env_bool("BYTEPS_SERVER_DEBUG"):
         debug_key = int(os.environ.get("BYTEPS_SERVER_DEBUG_KEY", "0"))
     srv = lib.bps_server_create_dbg(
         port, max(1, config.num_workers), config.server_engine_threads,

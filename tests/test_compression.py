@@ -117,9 +117,9 @@ def test_randomk_matches_golden():
     x = rng.randn(n).astype(np.float32)
     codec = RandomkCodec(size=n, k=k, seed=seed)
     payload = jax.jit(lambda x, s: codec.compress(x, s))(x, jnp.int32(step))
-    # golden indices from the shared counter-based stream
-    u = bps_rng.np_uniform_parallel(seed, k, mix=step)
-    golden_idx = np.minimum((u * n).astype(np.int32), n - 1)
+    # golden indices from the shared counter-based stream (32-bit hash
+    # mod n: the float-uniform form capped distinct indices at 2^24)
+    golden_idx = bps_rng.np_index_parallel(seed, k, n, mix=step)
     np.testing.assert_array_equal(np.asarray(payload["indices"]), golden_idx)
     np.testing.assert_allclose(np.asarray(payload["values"]), x[golden_idx])
     out = np.asarray(codec.decompress(payload))
@@ -413,3 +413,18 @@ def test_dithering_levels_from_k_alias():
                               64).compress(x.copy())
     assert bytes(via_k) == bytes(via_s)
     assert bytes(via_k) != bytes(default)
+
+
+def test_randomk_indices_cover_beyond_24_bits():
+    """The float-uniform index derivation had 24-bit granularity: for
+    size = 2^25 every index was even (multiples of size/2^24), leaving
+    half the coordinates permanently unselected — and far worse at
+    Llama-embedding sizes. The 32-bit-hash-mod-n form reaches every
+    coordinate (round-4 review regression)."""
+    idx = bps_rng.np_index_parallel(0, 4096, 2 ** 25, mix=1)
+    assert (idx % 2 == 1).any(), "odd indices unreachable: 24-bit cap"
+    assert idx.min() >= 0 and idx.max() < 2 ** 25
+    # jnp twin stays bit-exact
+    import jax
+    jidx = np.asarray(bps_rng.jnp_index_parallel(0, 4096, 2 ** 25, mix=1))
+    np.testing.assert_array_equal(idx, jidx)

@@ -80,8 +80,8 @@ def test_randomk_indices_pallas_bit_parity(k, size):
             _base(7, step), jnp.int32(size), k, True))
         np.testing.assert_array_equal(got, want)
         # and against the numpy golden model directly
-        u = np_uniform_parallel(7, k, mix=step)
-        gold = np.minimum((u * size).astype(np.int32), size - 1)
+        from byteps_tpu.ops.compression.rng import np_index_parallel
+        gold = np_index_parallel(7, k, size, mix=step)
         np.testing.assert_array_equal(got, gold)
 
 
