@@ -73,20 +73,35 @@ def build(verbose: bool = False) -> str:
         # sum_into and the codec loops well past baseline SSE2 — the
         # reference gets the same effect from hand-written AVX paths
         # (cpu_reducer.cc:59-120). Fall back if the toolchain objects.
-        cmd = ["g++", *flags, "-march=native", _SRC, "-o", out + ".tmp"]
-        if verbose:
-            print("[byteps_tpu] building native PS:", " ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            cmd = ["g++", *flags, _SRC, "-o", out + ".tmp"]
+        # pid-suffixed tmp: the _LOCK only serializes threads of THIS
+        # process, but a launcher starts server + N workers at once on a
+        # fresh host and each builds — a shared tmp path would let one
+        # process publish (os.replace) a file another g++ is still
+        # writing. Per-pid tmps make each publish atomic and last-wins.
+        tmp = f"{out}.tmp.{os.getpid()}"
+        try:
+            cmd = ["g++", *flags, "-march=native", _SRC, "-o", tmp]
+            if verbose:
+                print("[byteps_tpu] building native PS:", " ".join(cmd))
             proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native build failed:\n{proc.stderr[-4000:]}")
-        os.replace(out + ".tmp", out)
+            if proc.returncode != 0:
+                cmd = ["g++", *flags, _SRC, "-o", tmp]
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed:\n{proc.stderr[-4000:]}")
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
         # clean stale builds
         for f in os.listdir(_DIR):
-            if (f.startswith("libbyteps_ps-") and f.endswith(".so")
+            # stale builds AND orphaned pid-tmps of crashed builds
+            if (f.startswith("libbyteps_ps-")
+                    and (f.endswith(".so") or ".so.tmp." in f)
                     and os.path.join(_DIR, f) != out):
                 try:
                     os.remove(os.path.join(_DIR, f))

@@ -1124,7 +1124,10 @@ struct KeyStore {
   // served the PREVIOUS round's aggregate as if it were the new one
   std::vector<uint8_t> pull_abort;
   std::vector<ParkedPull> parked_pulls;
-  uint64_t total_pushes = 0;     // for priority scheduling
+  // atomic: the conn-loop thread reads it for priority under stores_mu_
+  // while engine threads increment under ks.mu — different mutexes, so
+  // the field itself must carry the synchronization
+  std::atomic<uint64_t> total_pushes{0};  // for priority scheduling
   // compression mirror (server.cc:92-118): set by COMP_INIT
   CompressorCfg comp;
   std::vector<int32_t> round_idx;     // randomk: this round's indices
@@ -1228,6 +1231,12 @@ class Server {
     addr.sin_port = htons((uint16_t)port_);
     if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
       std::perror("[bps-server] bind");
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      // stop + join the engine threads: returning with them joinable
+      // would std::terminate in the destructor instead of surfacing
+      // rc=1 to the caller
+      Join();
       return 1;
     }
     ::listen(listen_fd_, 64);
@@ -1347,7 +1356,9 @@ class Server {
         std::lock_guard<std::mutex> lk(stores_mu_);
         auto it = stores_.find(h.key);
         // fewer completed pushes -> earlier (queue.h:31-105)
-        prio = it == stores_.end() ? 0 : it->second.total_pushes;
+        prio = it == stores_.end()
+                   ? 0
+                   : it->second.total_pushes.load(std::memory_order_relaxed);
       }
       queues_[ThreadForKey(h.key, h.len)]->push(std::move(m), prio);
     }
@@ -1472,7 +1483,12 @@ class Server {
     {
       std::lock_guard<std::mutex> lk(barrier_mu_);
       barrier_waiters_.push_back({m.conn, m.rid, m.sender});
-      if ((int)barrier_waiters_.size() == num_workers_) {
+      // release on DISTINCT workers, not message count: a worker whose
+      // threads barrier concurrently sends duplicates, and counting
+      // those would release before every worker arrived
+      std::unordered_set<uint16_t> uniq;
+      for (auto& w : barrier_waiters_) uniq.insert((uint16_t)w.sender);
+      if ((int)uniq.size() == num_workers_) {
         release.swap(barrier_waiters_);
       }
     }
@@ -1552,9 +1568,12 @@ class Server {
         m.conn->send_msg(r, nullptr);
         return;
       }
-      if (ks.len != (uint32_t)m.payload.size()) {
-        // fresh key, or re-init with a new length (tensor resize): reset
-        // the whole aggregation state. Anything parked against the old
+      if (ks.len != (uint32_t)m.payload.size() || ks.dtype != m.dtype) {
+        // fresh key, or re-init with a new length (tensor resize) OR a
+        // new dtype (two 4-byte types swap under one key): reset the
+        // whole aggregation state — a mere dtype retag would keep
+        // serving the old-typed aggregate and sum in-flight old-typed
+        // pushes with the new kernel. Anything parked against the old
         // length must be error-replied, NOT left parked — an old-length
         // pull answered later with new-length bytes is silently discarded
         // by the client (out_len mismatch) and reads as success with an
@@ -2200,12 +2219,23 @@ struct Waiter {
 
 class ServerConn {
  public:
+  ~ServerConn() {
+    // a partially-connected group destroyed on Connect failure must not
+    // abort the process: Close() joins the recv thread (std::thread's
+    // destructor terminates on a joinable thread) and releases the fd
+    Close();
+  }
+
   bool Connect(const std::string& host, int port, uint16_t sender) {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
-    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) return false;
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
     for (int attempt = 0; attempt < 200; ++attempt) {
       if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
         tune_socket(fd_);
@@ -2220,8 +2250,15 @@ class ServerConn {
         recv_thread_ = std::thread([this] { RecvLoop(); });
         return true;
       }
+      // POSIX leaves a socket unspecified after a failed connect():
+      // close and recreate before retrying (some kernels fail every
+      // subsequent attempt on the stale fd)
+      ::close(fd_);
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
       ::usleep(50 * 1000);  // server may not be up yet (rendezvous retry)
     }
+    ::close(fd_);
+    fd_ = -1;
     return false;
   }
 
@@ -2426,12 +2463,18 @@ class ServerConn {
         continue;
       }
       bool ok = true;
+      bool len_mismatch = false;
       if (h.len) {
         if (w->out && h.len <= w->out_len) {
           ok = rx(w->out, h.len);
         } else {
           std::vector<uint8_t> junk(h.len);
           ok = rx(junk.data(), h.len);
+          // a reply LARGER than the waiter's buffer was drained, not
+          // delivered (e.g. a tensor resize raced an in-flight pull):
+          // reporting success would hand the caller h.len > out_len
+          // with the output buffer unwritten
+          if (w->out) len_mismatch = true;
         }
       }
       bool server_err = (h.flags & 1) != 0;
@@ -2452,7 +2495,7 @@ class ServerConn {
       {
         std::lock_guard<std::mutex> lk(w->mu);
         w->got_len = h.len;
-        w->ok = ok && !server_err;
+        w->ok = ok && !server_err && !len_mismatch;
         w->done = true;
       }
       w->cv.notify_one();

@@ -71,6 +71,19 @@ def parse_ef_kwarg(kwargs) -> bool:
                      f"use 'vanilla' (or a boolean spelling)")
 
 
+def parse_momentum_kwarg(kwargs) -> bool:
+    """ONE rule for the ``momentum`` kwarg across tiers (same rationale
+    as parse_ef_kwarg): 'nesterov' enables it, falsy spellings disable,
+    anything else fails fast."""
+    mom = str(kwargs.get("momentum", "")).lower()
+    if mom in ("nesterov",):
+        return True
+    if mom in ("", "none", "0", "false", "no", "off"):
+        return False
+    raise ValueError(f"unknown momentum type "
+                     f"{kwargs.get('momentum')!r}; use 'nesterov'")
+
+
 def register_codec(name: str):
     def deco(fn):
         _REGISTRY[name] = fn
@@ -120,11 +133,7 @@ def make_compressor(kwargs: Dict[str, str], size: int) -> CompressorStack:
     codec = _REGISTRY[name](kwargs, size)
     use_ef = parse_ef_kwarg(kwargs)
     mu = None
-    mom = str(kwargs.get("momentum", "")).lower()
-    if mom and mom not in ("nesterov", "none", "0", "false", "no", "off"):
-        raise ValueError(f"unknown momentum type "
-                         f"{kwargs.get('momentum')!r}; use 'nesterov'")
-    if mom == "nesterov":
+    if parse_momentum_kwarg(kwargs):
         if not use_ef:
             # same contract as the host tier (make_host_codec) and the
             # reference stacking order (compressor.h:28-52: Momentum

@@ -469,11 +469,8 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
     from . import parse_ef_kwarg
     if parse_ef_kwarg(kwargs):
         stack = HostErrorFeedback(stack)
-    mom = str(kwargs.get("momentum", "")).lower()
-    if mom and mom not in ("nesterov", "none", "0", "false", "no", "off"):
-        raise ValueError(f"unknown momentum type "
-                         f"{kwargs.get('momentum')!r}; use 'nesterov'")
-    if mom == "nesterov":
+    from . import parse_momentum_kwarg
+    if parse_momentum_kwarg(kwargs):
         if not isinstance(stack, HostErrorFeedback):
             raise ValueError("momentum requires ef=vanilla (reference "
                              "stacking order, compressor.h:28-52)")

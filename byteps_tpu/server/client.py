@@ -181,7 +181,8 @@ class PSClient:
 
     def zpush(self, server: int, key: int, data: np.ndarray,
               cmd: int) -> None:
-        rc = self._lib.bps_client_push(
+        data = np.ascontiguousarray(data)  # .ctypes.data of a strided
+        rc = self._lib.bps_client_push(   # view points at the base buffer
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
         if rc != 0:
             raise RuntimeError(f"push failed key={key}")
@@ -195,6 +196,7 @@ class PSClient:
         zpull. Removes the ACK round-trip from the pipeline's critical
         path — the pull is the only synchronization, matching ps-lite's
         asynchronous ZPush."""
+        data = np.ascontiguousarray(data)
         rc = self._lib.bps_client_push_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
         if rc != 0:
@@ -205,6 +207,10 @@ class PSClient:
         """Pull into ``out``; returns the ACTUAL reply length (equal to
         out.nbytes for dense/fixed formats, possibly shorter for
         variable-length wires like varint-coded dithering)."""
+        if not out.flags["C_CONTIGUOUS"]:
+            # the native side writes through .ctypes.data — a strided
+            # view would silently receive bytes at the wrong offsets
+            raise ValueError("zpull requires a C-contiguous output array")
         rc = self._lib.bps_client_pull(
             self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
         if rc < 0:
@@ -252,6 +258,15 @@ class PSClient:
         missing partitions are pushed — every worker derives the same
         ``missing`` set from the shared registry partitioning, so the
         per-key init barrier still converges."""
+        total = sum(p.length for p in ctx.partitions)
+        if nbytes != total:
+            # the partitioning drives everything below; a caller whose
+            # byte count disagrees has a stale ctx (resize without
+            # re-declare) and would init the wrong store lengths
+            raise ValueError(
+                f"ensure_init: caller nbytes={nbytes} != partitioned "
+                f"total {total} for {ctx.name!r} — re-declare the tensor "
+                f"(registry.init_tensor) after a resize")
         with self._lock:
             missing = [p for p in ctx.partitions
                        if self._inited_keys.get(p.key) != p.length]
