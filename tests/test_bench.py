@@ -98,14 +98,18 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     assert out["pushpull_dense_gbps"] == 3.0
     assert out["phase_errors"]["probe"] == "timeout"
     # attempts spread across the run: start + after each CPU phase +
-    # final (after the budget wait)
-    assert calls.count("probe") == 5
+    # budget-derived final rounds (the loop keeps retrying while budget
+    # remains — ending with unused budget is strictly worse; the cap is
+    # int(budget/340)+2 so a mocked clock cannot spin forever)
+    n_final = int(2100 // 340) + 2
+    assert calls.count("probe") == 4 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull", "after_pushpull_2srv", "after_scaling",
-        "final"]
+        *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
-    assert any(d.get("at") == "final_wait" for d in out["tunnel_diag"])
+    assert any(str(d.get("at", "")).startswith("final_wait")
+               for d in out["tunnel_diag"])
 
 
 def test_late_recovery_lands_train(bench, monkeypatch, capsys):
