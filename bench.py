@@ -777,8 +777,8 @@ def main() -> None:
     # never truncates it; it exists so a mocked/frozen clock cannot
     # spin forever)
     max_final = int(budget_s // 340) + 2
-    while (not state["trained"] and remaining() > 700
-           and final_round < max_final):
+    while (not (state["trained"] and state["tpu_wire"])
+           and remaining() > 700 and final_round < max_final):
         final_round += 1
         # the sleep exists for WEDGE recovery: when the last probe
         # succeeded (tunnel healthy, train itself failed), skip it and

@@ -25,6 +25,8 @@ def bench(monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    # the schedule math (final-round cap) keys off the default budget
+    monkeypatch.delenv("BENCH_BUDGET_S", raising=False)
     return mod
 
 
