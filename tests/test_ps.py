@@ -253,11 +253,17 @@ def test_training_loop_shape_two_workers():
     c1 = PSClient(addrs, worker_id=1)
     w0 = np.zeros(10_000, np.float32)
     w1 = np.zeros(10_000, np.float32)
-    for c in (c0, c1):
-        t = threading.Thread(target=c.init_tensor,
-                             args=(ctx, np.zeros_like(w0)))
-        t.start()
-    time.sleep(0.1)
+    # JOIN the init barrier via futures (a fixed sleep raced it on
+    # loaded hosts, and a bare Thread swallowed exceptions — join()
+    # does not re-raise; future.result() does): both inits return only
+    # after every worker's init push arrived
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        futs = [pool.submit(c.init_tensor, ctx, np.zeros_like(w0))
+                for c in (c0, c1)]
+        for f in futs:
+            f.result(timeout=30)
 
     rng = np.random.RandomState(0)
     for step in range(3):

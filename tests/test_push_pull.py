@@ -74,12 +74,28 @@ def test_reduce_scatter_all_gather_roundtrip(bps):
 
 
 def test_telemetry_records(bps):
+    from byteps_tpu.core.state import get_state
+
+    tel = get_state().telemetry
+    before = tel._window_bytes
     x = np.ones((8, 1024), np.float32)
     for _ in range(3):
         bps.push_pull(x, name="telemetry_t")
-    # speed sampling needs a 10s window; just check the API shape
+    # the 10s speed window hasn't closed, but the byte counter must
+    # have advanced — a dead recording path returns the API shape
+    # forever while counting nothing
+    assert tel._window_bytes - before >= 3 * x[0].nbytes
     ts, mbps = bps.get_pushpull_speed()
     assert isinstance(ts, float) and isinstance(mbps, float)
+
+    # and the documented off-switch actually gates recording
+    tel.enabled = False
+    try:
+        mid = tel._window_bytes
+        bps.push_pull(x, name="telemetry_t")
+        assert tel._window_bytes == mid
+    finally:
+        tel.enabled = True
 
 
 def test_rank_size_defaults(bps):

@@ -159,19 +159,45 @@ def test_broadcast_optimizer_state_via_ps(bpt_ps):
     model, x, y = _toy_problem()
     opt = torch.optim.Adam(model.parameters(), lr=0.01)
     _train(model, x, y, opt, steps=3)
+    before = {k: {kk: (vv.clone() if torch.is_tensor(vv) else vv)
+                  for kk, vv in st.items()}
+              for k, st in opt.state_dict()["state"].items()}
     bpt_ps.broadcast_optimizer_state(opt, root_rank=0)
-    assert opt.state_dict()["param_groups"][0]["lr"] == 0.01
+    after = opt.state_dict()
+    assert after["param_groups"][0]["lr"] == 0.01
+    # at 1 worker the broadcast is identity: the warm Adam moments must
+    # SURVIVE the round trip intact (a no-op or state-corrupting
+    # broadcast both fail here)
+    assert set(after["state"]) == set(before)
+    for k, st in before.items():
+        for kk, vv in st.items():
+            got = after["state"][k][kk]
+            if torch.is_tensor(vv):
+                assert torch.allclose(got.float(), vv.float(),
+                                      rtol=1e-6), (k, kk)
+                assert not torch.equal(vv, torch.zeros_like(vv)) or \
+                    kk == "step"
+            else:
+                assert got == vv, (k, kk)
 
 
 def test_ddp_wrapper_via_ps(bpt_ps):
     model, x, y = _toy_problem()
+    # plain-backward reference on an identical copy: at 1 worker
+    # push_pull is identity, so synced grads must EQUAL the local ones
+    # (catches a sync_gradients that silently fails to write back)
+    import copy
+
+    ref = copy.deepcopy(model)
+    loss_ref = torch.nn.functional.mse_loss(ref(x), y)
+    loss_ref.backward()
     ddp = bpt_ps.DistributedDataParallel(model)
     loss = torch.nn.functional.mse_loss(ddp(x), y)
     loss.backward()
     ddp.sync_gradients()
-    for p in model.parameters():
+    for p, pr in zip(model.parameters(), ref.parameters()):
         assert p.grad is not None
-        assert torch.isfinite(p.grad).all()
+        assert torch.allclose(p.grad, pr.grad, rtol=1e-5, atol=1e-7)
 
 
 def test_two_worker_mean(monkeypatch):
