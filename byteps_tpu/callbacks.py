@@ -139,6 +139,12 @@ class MetricAverageCallback(Callback):
             try:
                 out = bps.synchronize(h, timeout=timeout)
             except TimeoutError as e:
+                # the raise is fatal for this epoch's metrics and nothing
+                # retries them: drop the timed-out handle and every
+                # sibling so their result buffers don't pin memory for
+                # the rest of the process
+                for h2 in hs.values():
+                    get_state().handles.discard(h2)
                 raise TimeoutError(
                     f"metric {name!r}: cross-worker average timed out "
                     f"after {timeout:.0f}s — every worker must log the "

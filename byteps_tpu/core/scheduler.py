@@ -262,10 +262,22 @@ class HandleManager:
     def poll(self, hid: int) -> bool:
         # a cleared id reports done (the reference PollHandle contract,
         # torch/handle_manager.cc): poll loops racing a synchronize()
-        # elsewhere must terminate, not crash
+        # elsewhere must terminate, not crash. Ids that were never
+        # allocated (>= the high-water mark) are caller bugs, not
+        # completions — raising keeps done-when-cleared for real ids only
         with self._mu:
+            if hid < 0 or hid >= self._next:
+                raise KeyError(f"handle {hid} was never allocated")
             h = self._handles.get(hid)
         return True if h is None else h.done()
+
+    def discard(self, hid: int) -> None:
+        """Abandon a handle without retrieving its result — for callers
+        that treat a wait timeout as fatal and will never retry. Without
+        this the Handle (and its gradient-sized result buffer) stays in
+        the table for the life of the process."""
+        with self._mu:
+            self._handles.pop(hid, None)
 
     def wait_and_clear(self, hid: int, timeout=None) -> np.ndarray:
         h = self.get(hid)

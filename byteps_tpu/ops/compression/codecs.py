@@ -71,9 +71,22 @@ class OnebitCodec(Codec):
     scaled: bool = True
     use_pallas: bool = True
 
+    def _pallas_active(self) -> bool:
+        """Layout choice LATCHED at the first compress/decompress/
+        wire_bytes call: the Pallas and portable payloads differ in size
+        (sublane-folded padding), so resolving pallas-vs-portable
+        independently per call under different device contexts would
+        size the pull buffer for the wrong layout — which the server's
+        oversized-reply check turns into a hard per-round error."""
+        got = self.__dict__.get("_pallas_latched")
+        if got is None:
+            got = bool(self.use_pallas and _on_tpu())
+            object.__setattr__(self, "_pallas_latched", got)
+        return got
+
     def compress(self, x: jnp.ndarray, step: int = 0) -> Dict[str, Any]:
         scale = jnp.mean(jnp.abs(x)) if self.scaled else jnp.float32(1.0)
-        if self.use_pallas and _on_tpu():
+        if self._pallas_active():
             from .pallas_kernels import onebit_pack
             bits = onebit_pack(x)
         else:
@@ -85,7 +98,7 @@ class OnebitCodec(Codec):
 
     def decompress(self, payload: Dict[str, Any]) -> jnp.ndarray:
         bits = payload["bits"]
-        if self.use_pallas and _on_tpu():
+        if self._pallas_active():
             from .pallas_kernels import onebit_unpack
             return onebit_unpack(bits, jnp.float32(1.0), self.size) \
                 * payload["scale"]
@@ -100,7 +113,7 @@ class OnebitCodec(Codec):
         # so the portable ceil(n/32) count would under-report telemetry
         # and scheduling credit by up to a block (badly for small
         # leaves, whose minimum payload is one block)
-        if self.use_pallas and _on_tpu():
+        if self._pallas_active():
             from .pallas_kernels import _LANES, _padded_rows
             return (_padded_rows(self.size) * _LANES // 32) * 4 + 4
         return ((self.size + 31) // 32) * 4 + 4

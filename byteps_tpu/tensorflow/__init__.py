@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import weakref
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -290,6 +291,15 @@ def broadcast_variables(variables: Iterable, root_rank: int = 0,
 # DistributedGradientTape / DistributedOptimizer
 # --------------------------------------------------------------------- #
 
+# last live wrapper per auto-derived scope: detects the GAN G/D hazard
+# (two concurrently-training models with identical gradient signatures
+# silently cross-summing on shared PS keys). Weak values so the normal
+# rebuild-the-tape-every-step pattern — where the previous wrapper is
+# dead before the new one resolves — does not false-positive.
+_AUTO_SCOPES = weakref.WeakValueDictionary()
+_AUTO_SCOPE_WARNED: set = set()
+
+
 class _TapeWrapper:
     """Wraps a tf.GradientTape: gradient() push_pulls every gradient
     before returning it (reference: _DistributedGradientTape,
@@ -320,7 +330,24 @@ class _TapeWrapper:
                          str(getattr(g, "dtype", "")))
                         for g in flat])
             digest = hashlib.md5(sig.encode()).hexdigest()[:10]
-            self._scope = f"tfgrad_{digest}"
+            scope = f"tfgrad_{digest}"
+            holder = _AUTO_SCOPES.get(scope)
+            if (holder is not None and holder is not self
+                    and scope not in _AUTO_SCOPE_WARNED):
+                _AUTO_SCOPE_WARNED.add(scope)
+                import warnings
+
+                warnings.warn(
+                    f"two live DistributedGradientTape instances resolved "
+                    f"the same auto-derived scope {scope!r} (identical "
+                    f"gradient shape/dtype signatures). If these wrap "
+                    f"DIFFERENT models (e.g. GAN G/D) they share PS keys "
+                    f"and concurrent rounds will cross-sum — pass an "
+                    f"explicit scope= to each tape. Sequential reuse on "
+                    f"one model (gradient accumulation) is benign.",
+                    RuntimeWarning, stacklevel=3)
+            _AUTO_SCOPES[scope] = self
+            self._scope = scope
         return self._scope
 
     def __enter__(self):
@@ -554,6 +581,10 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
             try:
                 out = _handles.wait_and_clear(h.id, timeout=timeout)
             except TimeoutError as e:
+                # fatal for this epoch's metrics; nothing retries — drop
+                # all sibling handles so their buffers don't leak
+                for h2 in hs.values():
+                    _handles.discard(h2.id)
                 raise TimeoutError(
                     f"metric {k!r}: cross-worker average timed out after "
                     f"{timeout:.0f}s — every worker must log "

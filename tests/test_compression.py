@@ -66,6 +66,23 @@ def test_onebit_roundtrip(n, scaled):
     assert payload["bits"].size == (n + 31) // 32
 
 
+def test_onebit_layout_latched(monkeypatch):
+    """pallas-vs-portable is resolved ONCE and reused: a later call under
+    a different device context must not re-derive the layout, or the
+    pull buffer gets sized for the wrong payload (round-5 advisor
+    finding — the server's oversized-reply check makes that a hard
+    error)."""
+    from byteps_tpu.ops.compression import codecs
+
+    codec = OnebitCodec(size=100)
+    before = codec.wire_bytes()  # latches the portable layout (CPU here)
+    monkeypatch.setattr(codecs, "_on_tpu", lambda: True)
+    assert codec.wire_bytes() == before
+    # a FRESH codec constructed under the faked context latches pallas
+    fresh = OnebitCodec(size=100)
+    assert fresh.wire_bytes() != before
+
+
 @pytest.mark.parametrize("k", [1, 5, 50])
 def test_topk_matches_golden(k):
     rng = np.random.RandomState(k)

@@ -229,6 +229,34 @@ def test_handle_manager_error_and_cleared_semantics():
     hm.wait_and_clear(h2.id)
 
 
+def test_poll_rejects_never_allocated_ids():
+    """poll's done-when-cleared contract covers ids actually handed out;
+    a stale/garbage id from a caller bug raises instead of masquerading
+    as completion (round-5 advisor finding)."""
+    hm = HandleManager()
+    h = hm.allocate("x")
+    with pytest.raises(KeyError, match="never allocated"):
+        hm.poll(h.id + 1)
+    with pytest.raises(KeyError, match="never allocated"):
+        hm.poll(-1)
+    h._finish(np.zeros(1), None)
+    hm.wait_and_clear(h.id)
+    assert hm.poll(h.id) is True  # cleared (real) id still reports done
+
+
+def test_discard_abandons_handle():
+    """Abandon-on-timeout callers (metric callbacks) drop sibling handles
+    via discard so result buffers don't pin memory for the process life;
+    discard on an already-cleared id is a no-op."""
+    hm = HandleManager()
+    h = hm.allocate("x")
+    hm.discard(h.id)
+    with pytest.raises(KeyError):
+        hm.get(h.id)
+    assert hm.poll(h.id) is True  # discarded == cleared for pollers
+    hm.discard(h.id)  # idempotent
+
+
 def test_per_key_priority_is_pinned(monkeypatch):
     """Two rounds of one tensor submitted with different explicit
     priorities must NOT reorder in the queue: the server counts pushes
