@@ -1062,6 +1062,59 @@ struct CompressorCfg {
 // server
 // ------------------------------------------------------------------ //
 
+// BYTEPS_SERVER_THROTTLE_MBPS: evidence/test knob — cap THIS server
+// process's payload bandwidth (push ingress + pull egress combined) with
+// a token bucket that SLEEPS the offending thread. Sleeping (not
+// spinning) is the point: on a small-core host a throttled server yields
+// its core to the worker / the other server, so the scaling rule the
+// reference documents (throughput ∝ min(server bw, worker bw),
+// docs/best-practice.md:41-44) becomes measurable independently of core
+// count — cap one server at T and the worker's rate tracks T; split the
+// keys over two throttled servers and it doubles. Off (no limit) unless
+// the env var is a positive number. Read per-Server (not a process-wide
+// static) so throttled and unthrottled servers coexist in one test
+// process.
+class Throttle {
+ public:
+  Throttle() {
+    if (const char* e = ::getenv("BYTEPS_SERVER_THROTTLE_MBPS")) {
+      double v = std::atof(e);
+      if (v > 0) {
+        rate_ = v * 1e6;           // bytes/s
+        burst_ = rate_ * 0.05;     // 50ms of credit: smooths scheduler
+                                   // jitter without distorting the rate
+        tokens_ = burst_;
+        last_ = std::chrono::steady_clock::now();
+      }
+    }
+  }
+  bool enabled() const { return rate_ > 0; }
+  void charge(size_t nbytes) {
+    if (rate_ <= 0 || nbytes == 0) return;
+    double wait = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto now = std::chrono::steady_clock::now();
+      tokens_ = std::min(
+          burst_, tokens_ + rate_ * std::chrono::duration<double>(
+                                        now - last_).count());
+      last_ = now;
+      tokens_ -= (double)nbytes;   // debt allowed: the NEXT charge (or
+                                   // this one, below) sleeps it off
+      if (tokens_ < 0) wait = -tokens_ / rate_;
+    }
+    if (wait > 0)
+      std::this_thread::sleep_for(std::chrono::duration<double>(wait));
+  }
+
+ private:
+  double rate_ = 0;
+  double burst_ = 0;
+  std::mutex mu_;
+  double tokens_ = 0;
+  std::chrono::steady_clock::time_point last_;
+};
+
 struct Conn {
   int fd;
   // worker id observed on this connection's first message; -1 until then
@@ -1084,7 +1137,11 @@ struct Conn {
   // IPC_CONFIRM (conn-loop thread only); abandoned — munmapped by the
   // IpcChan dtor — when any other message arrives first or the conn dies
   std::unique_ptr<IpcChan> ipc_pending;
+  Throttle* thr = nullptr;  // server's bucket; null on the client side
   bool send_msg(const MsgHeader& h, const void* payload) {
+    // charge OUTSIDE write_mu: a sleeping throttle must not also block
+    // the other engine threads replying on this connection
+    if (thr) thr->charge(h.len);
     std::lock_guard<std::mutex> lk(write_mu);
     if (ipc) return ipc->send_msg(h, payload);
     return send_msg_iov(fd, h, payload);
@@ -1246,6 +1303,7 @@ class Server {
       tune_socket(fd);
       auto conn = std::make_shared<Conn>();
       conn->fd = fd;
+      conn->thr = &throttle_;
       // Conn threads self-reap: detached, with a shared tracker Join()
       // waits on. A worker that suspends (elastic close without SHUTDOWN,
       // client.py close(shutdown_servers=False)) ends its conn thread while
@@ -1321,6 +1379,7 @@ class Server {
       if (h.len) {
         m.payload.resize(h.len);
         if (!conn->recv_bytes(m.payload.data(), h.len)) break;
+        throttle_.charge(h.len);  // ingress side of the bandwidth cap
       }
       if (h.op == IPC_HELLO) {
         HandleIpcHello(conn, h.rid, m.payload);
@@ -2163,6 +2222,7 @@ class Server {
   bool async_;
   bool schedule_;
   int64_t debug_key_ = -1;
+  Throttle throttle_;  // BYTEPS_SERVER_THROTTLE_MBPS, off by default
   int listen_fd_ = -1;
   std::atomic<bool> shutting_down_{false};
   std::atomic<int> shutdown_count_{0};

@@ -133,17 +133,34 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
             # async: raw local grads; the delta push happens in
             # apply_gradients against the server's authoritative weights
             return gradients
-        averaged = []
+        if tf.executing_eagerly():
+            # eager compat use: keep the per-tensor push_pull — it is
+            # what routes eager IndexedSlices onto the row-sparse wire
+            # (nonzero rows only) and honors sparse_as_dense
+            return [(g if g is None else push_pull(
+                        g, scope=self._name, average=True,
+                        name="tf1grad/" + v.name.replace(":", "_"),
+                        compression=self._compression,
+                        sparse_as_dense=self._sparse_as_dense), v)
+                    for g, v in gradients]
+        # graph mode: ONE py_function for the whole gradient list
+        # (submit-all-then-drain inside the op) instead of one per
+        # tensor — each hop re-enters Python under the GIL, measured
+        # +112% per-tensor vs +69% batched on a ResNet-50-shaped set
+        # (examples/benchmark_tf_hop.py). Symbolic IndexedSlices densify
+        # first (the row-sparse wire is eager-only, as in push_pull).
+        from . import _graph_batch_push_pull
+
+        batch = []
         for grad, var in gradients:
-            if grad is None:
-                averaged.append((None, var))
-                continue
-            name = "tf1grad/" + var.name.replace(":", "_")
-            averaged.append((push_pull(
-                grad, scope=self._name, average=True, name=name,
-                compression=self._compression,
-                sparse_as_dense=self._sparse_as_dense), var))
-        return averaged
+            if grad is not None:
+                if isinstance(grad, tf.IndexedSlices):
+                    grad = tf.convert_to_tensor(grad)
+                batch.append(("tf1grad/" + var.name.replace(":", "_"),
+                              grad))
+        reduced = iter(_graph_batch_push_pull(batch, self._compression))
+        return [(None if grad is None else next(reduced), var)
+                for grad, var in gradients]
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
         from ..core.state import get_state

@@ -63,12 +63,17 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "pushpull_randomk_gbps": 3.7}, None
         if name == "pushpull_2srv":
             return {"pushpull_dense_2srv_gbps": 2.7}, None
+        if name == "pushpull_throttled":
+            return {"pushpull_throttled_1srv_gbps": 0.1,
+                    "pushpull_throttled_2srv_gbps": 0.2,
+                    "throttle_mbps": 100.0}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
     assert out["value"] == 100000.0
+    assert out["pushpull_throttled_2srv_gbps"] == 0.2
     assert out["vs_baseline"] == round(100000.0 / 51810.0, 4)
     assert out["pushpull_onebit_tpu_gbps"] == 9.0
     assert "phase_errors" not in out
@@ -90,6 +95,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
                     "pushpull_randomk_gbps": 3.7}, None
         if name == "pushpull_2srv":
             return {"pushpull_dense_2srv_gbps": 2.7}, None
+        if name == "pushpull_throttled":
+            return {"pushpull_throttled_1srv_gbps": 0.1,
+                    "pushpull_throttled_2srv_gbps": 0.2,
+                    "throttle_mbps": 100.0}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
@@ -102,14 +111,16 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # attempts spread across the run: start + after each CPU phase +
     # budget-derived final rounds (the loop keeps retrying while budget
     # remains — ending with unused budget is strictly worse; the cap is
-    # int(budget/340)+2 so a mocked clock cannot spin forever)
+    # int(budget/150)+4 so a mocked clock cannot spin forever; cheap
+    # 40-60s probes mean a real wedged round fits ~12-16 attempts)
     # LITERAL, not the implementation's formula: if bench.py's cap
-    # derivation drifts (e.g. //34 spinning 60 probes), this catches it
-    n_final = 8
-    assert calls.count("probe") == 4 + n_final
+    # derivation drifts (e.g. //15 spinning 140 probes), this catches it
+    n_final = 18
+    assert calls.count("probe") == 5 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
-        "start", "after_pushpull", "after_pushpull_2srv", "after_scaling",
+        "start", "after_pushpull", "after_pushpull_2srv",
+        "after_pushpull_throttled", "after_scaling",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
     assert any(str(d.get("at", "")).startswith("final_wait")

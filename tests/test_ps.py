@@ -60,6 +60,40 @@ def test_single_worker_roundtrip():
         assert not t.is_alive()
 
 
+def test_server_throttle_caps_bandwidth(monkeypatch):
+    """BYTEPS_SERVER_THROTTLE_MBPS (the scaling-rule evidence knob,
+    docs/best-practice.md) pins the server's payload rate to the cap:
+    a 4MB round trip through a 20MB/s server must take ~0.4s/round
+    (2x4MB through one bucket), where the unthrottled loopback moves
+    GB/s. Asserts both sides: slower than half the wire would allow
+    unthrottled, and not pathologically slower than the cap predicts."""
+    # NOTE: the env must stay set until the server thread CONSTRUCTS the
+    # native Server (the Throttle ctor reads it); monkeypatch restores
+    # it at test end, after the server is long up
+    monkeypatch.setenv("BYTEPS_SERVER_THROTTLE_MBPS", "20")
+    addrs, threads = start_servers(1, num_workers=1)
+    c = PSClient(addrs, worker_id=0)
+    x = np.random.RandomState(0).randn(1 << 20).astype(np.float32)  # 4MB
+    c.init_key(0, 7, np.zeros_like(x), CMD_F32)
+    out = np.empty_like(x)
+    c.zpush(0, 7, x, CMD_F32)
+    c.zpull(0, 7, out, CMD_F32)  # warmup: drains the 50ms burst credit
+    t0 = time.perf_counter()
+    rounds = 2
+    for _ in range(rounds):
+        c.zpush(0, 7, x, CMD_F32)
+        c.zpull(0, 7, out, CMD_F32)
+    dt = time.perf_counter() - t0
+    np.testing.assert_allclose(out, x, rtol=1e-5)
+    expected = rounds * 2 * x.nbytes / 20e6  # ~0.84s
+    assert dt > expected * 0.5, f"throttle not binding: {dt:.3f}s"
+    assert dt < expected * 3.0, f"throttle overshooting: {dt:.3f}s"
+    c.close()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
 def test_two_workers_sum_and_parked_pull():
     addrs, threads = start_servers(1, num_workers=2)
     c0 = PSClient(addrs, worker_id=0)
