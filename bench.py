@@ -102,6 +102,7 @@ work, remat recompute and the optimizer pass.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import signal
@@ -272,59 +273,54 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     return out
 
 
+@contextlib.contextmanager
 def _loopback_ps(num_servers: int):
     """Shared scaffolding for the CPU-forced pushpull phases: N loopback
     C++ servers on INDEPENDENTLY verified free ports (free_port()+1 may
     be taken on shared hosts; BYTEPS_SERVER_HOSTS lifts the
     consecutive-port assumption), DMLC_*/BYTEPS_* env, a fresh
-    GlobalState, bps.init(). Context manager yielding the initialized
-    ``byteps_tpu`` module; teardown shuts the worker down and joins the
-    servers. One definition so a rendezvous/teardown fix lands in every
-    phase at once."""
-    import contextlib
+    GlobalState, bps.init(). Yields the initialized ``byteps_tpu``
+    module; teardown shuts the worker down and joins the servers. One
+    definition so a rendezvous/teardown fix lands in every phase at
+    once."""
+    _force_cpu()
+    import threading
 
-    @contextlib.contextmanager
-    def cm():
-        _force_cpu()
-        import threading
+    from byteps_tpu.config import Config
+    from byteps_tpu.core.state import GlobalState
+    from byteps_tpu.server import run_server
+    from byteps_tpu.utils.net import free_port
 
-        from byteps_tpu.config import Config
-        from byteps_tpu.core.state import GlobalState
-        from byteps_tpu.server import run_server
-        from byteps_tpu.utils.net import free_port
-
-        ports = []
-        while len(ports) < num_servers:
-            p = free_port()
-            if p not in ports:
-                ports.append(p)
-        cfg = Config(num_workers=1, num_servers=num_servers)
-        os.environ.update({
-            "DMLC_NUM_WORKER": "1",
-            "DMLC_NUM_SERVER": str(num_servers),
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(ports[0]),
-            "BYTEPS_SERVER_HOSTS": ",".join(f"127.0.0.1:{p}"
-                                            for p in ports),
-            "BYTEPS_FORCE_DISTRIBUTED": "1",
-        })
-        servers = []
-        for p in ports:
-            t = threading.Thread(target=run_server, args=(p, cfg),
-                                 daemon=True)
-            t.start()
-            servers.append(t)
-        GlobalState._instance = None
-        import byteps_tpu as bps
-        bps.init()
-        try:
-            yield bps
-        finally:
-            bps.shutdown()
-            for t in servers:
-                t.join(timeout=20)
-
-    return cm()
+    ports = []
+    while len(ports) < num_servers:
+        p = free_port()
+        if p not in ports:
+            ports.append(p)
+    cfg = Config(num_workers=1, num_servers=num_servers)
+    os.environ.update({
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": str(num_servers),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(ports[0]),
+        "BYTEPS_SERVER_HOSTS": ",".join(f"127.0.0.1:{p}"
+                                        for p in ports),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    servers = []
+    for p in ports:
+        t = threading.Thread(target=run_server, args=(p, cfg),
+                             daemon=True)
+        t.start()
+        servers.append(t)
+    GlobalState._instance = None
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        yield bps
+    finally:
+        bps.shutdown()
+        for t in servers:
+            t.join(timeout=20)
 
 
 def _make_grads(total_bytes: int, n_tensors: int):

@@ -22,20 +22,29 @@ examples/benchmark_scaling.py (reference: README.md:34-40).
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import jax
+
+# BYTEPS_BENCH_PLATFORM=cpu: pin the platform BEFORE the first backend
+# query. Env vars alone don't work on hosts where a sitecustomize
+# registers a device plugin at interpreter start (tests/conftest.py
+# gotcha) — and bps.init()'s jax.process_count() would otherwise touch
+# (and, wedged, hang on) the device tunnel even for a CPU smoke.
+if os.environ.get("BYTEPS_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms",
+                      os.environ["BYTEPS_BENCH_PLATFORM"])
+
 import jax.numpy as jnp
 import numpy as np
 import optax
 
-import os
-import sys
-
 # runnable as `python examples/<name>.py` from anywhere (same idiom as
 # benchmark_scaling.py)
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, REPO)  # noqa: E402 — before the byteps_tpu import
 
 import byteps_tpu as bps
 from byteps_tpu.models import bert, llama, mlp, moe, resnet, vgg

@@ -150,6 +150,102 @@ bench_loss(lambda q, t: llama.loss_fn(
                q, {"tokens": t}, cfg, attn_impl=make_flash_attn()),
            "pallas-flash B=2 S=8192", B=2, S=8192, tokens=tok8)
 
+# -- 5. optimizer pass: hand-fused adam vs the optax chain ---------------- #
+# optax.adam composes scale_by_adam + scale transforms — several tree
+# passes whose per-leaf kernels XLA may or may not fuse across the
+# donated update. This variant computes mu/nu/bias-correction/param-new
+# in ONE elementwise expression per leaf, the best case a fused
+# (pallas or XLA) optimizer could reach: if it doesn't move tokens/s,
+# the optimizer pass is off the MFU suspect list.
+def _fused_adam_step(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                     mu_dtype=jnp.bfloat16):
+    def init(params):
+        return {"mu": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, mu_dtype), params),
+                "nu": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def step(p, o, t):
+        loss, g = jax.value_and_grad(
+            lambda q: llama.loss_fn(q, {"tokens": t}, cfg))(p)
+        c = o["count"] + 1
+        cf = c.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+
+        def leaf(pl, m, v, gl):
+            gf = gl.astype(jnp.float32)
+            m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * gf
+            v2 = b2 * v + (1.0 - b2) * gf * gf
+            new = pl - lr * (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            return new, m2.astype(mu_dtype), v2
+
+        tup = jax.tree.map(leaf, p, o["mu"], o["nu"], g)
+        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
+        p2 = jax.tree.map(lambda x: x[0], tup, is_leaf=is_t)
+        o2 = {"mu": jax.tree.map(lambda x: x[1], tup, is_leaf=is_t),
+              "nu": jax.tree.map(lambda x: x[2], tup, is_leaf=is_t),
+              "count": c}
+        return p2, o2, loss
+
+    return init, step
+
+
+def bench_custom_step(make, label):
+    """A/B a fully custom (init, step) pair (optimizer experiments)."""
+    try:
+        init, step = make()
+        p = jax.tree.map(jnp.copy, params0)
+        o = init(p)
+        stepj = jax.jit(step, donate_argnums=(0, 1))
+        for _ in range(3):
+            p, o, loss = stepj(p, o, tok)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, o, loss = stepj(p, o, tok)
+        float(loss)
+        dt = time.perf_counter() - t0
+        print(f"{label}: {B*S*steps/dt:,.0f} tok/s  "
+              f"(loss {float(loss):.3f})", flush=True)
+    except Exception as e:
+        _failed.append(label)
+        print(f"{label}: FAILED {type(e).__name__}: {str(e)[:160]}",
+              flush=True)
+
+
+bench_custom_step(_fused_adam_step, "hand-fused adam (one kernel/leaf)")
+
+# -- 6. rmsnorm / rope headroom BOUNDS ------------------------------------ #
+# Not fixes — upper bounds: replace rmsnorm's mean/rsqrt with a bare
+# weight multiply, and rope with identity. The tokens/s delta is the
+# MOST any pallas rmsnorm/rope fusion could recover (numerics are wrong
+# here; only the time is meaningful). If the bound is ~0, skip writing
+# the kernel and strike the suspect from the ceiling analysis.
+_orig_rmsnorm, _orig_rope = llama._rmsnorm, llama.apply_rope
+try:
+    llama._rmsnorm = lambda x, w, eps: x * w.astype(x.dtype)
+    bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
+               "BOUND: rmsnorm -> x*w (no mean/rsqrt)")
+    llama.apply_rope = lambda x, cos, sin: x
+    bench_loss(lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg),
+               "BOUND: + rope -> identity")
+finally:
+    llama._rmsnorm, llama.apply_rope = _orig_rmsnorm, _orig_rope
+
+# -- 7. flash attention AT THE BENCH SHAPE (B=16, S=1024) ----------------- #
+# Re-tested every round before concluding XLA's fused dense attention
+# wins at short S: the flash kernel keeps improving, and the ceiling
+# analysis blames attention softmax HBM traffic for part of the MFU gap.
+bench_loss(lambda q, t: llama.loss_fn(
+               q, {"tokens": t}, cfg,
+               attn_impl=make_flash_attn(pallas=False)),
+           "blockwise B=16 S=1024")
+bench_loss(lambda q, t: llama.loss_fn(
+               q, {"tokens": t}, cfg, attn_impl=make_flash_attn()),
+           "pallas-flash B=16 S=1024")
+
 if _failed:
     print(f"{len(_failed)} variant(s) failed: {', '.join(_failed)}")
     sys.exit(1)
