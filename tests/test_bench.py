@@ -173,6 +173,41 @@ def test_tpu_wire_decoupled_from_train_failure(bench, monkeypatch, capsys):
     assert calls.count("train") >= 2
 
 
+def test_scaling_summary_estimator(bench):
+    """The scaling estimator's contract: headline = best WITHIN-rep
+    ratio (never a cross-rep pairing), spread/reps keys derived from
+    pairs only, and the list-maxima fallback when no rep completed both
+    configs."""
+    # three clean interleaved reps on a 1-core host (cap = 0.5)
+    out = bench._scaling_summary(
+        pairs=[(100.0, 90.0), (110.0, 88.0), (105.0, 94.0)],
+        t1s=[100.0, 110.0, 105.0], tns=[90.0, 88.0, 94.0],
+        workers=2, cores=1)
+    # per-rep ratios: 0.45, 0.4, 0.4476 -> best 0.45
+    assert out["scaling_efficiency_2w"] == 0.45
+    assert out["scaling_vs_core_cap"] == 0.9
+    assert out["scaling_vs_cap_reps"] == [0.9, 0.8, 0.8952]
+    assert out["scaling_spread"] == round((0.45 - 0.4) / 0.5, 4)
+    # asymmetric failures: rep2 lost its t1, rep3 lost its tn — the one
+    # complete pair decides the headline; the stray 120.0 t1 and 99.0 tn
+    # (which a zip over the flat lists would have married into a bogus
+    # 99/(2*120) or 120-based ratio) must NOT combine
+    out = bench._scaling_summary(
+        pairs=[(100.0, 90.0)],
+        t1s=[100.0, 120.0], tns=[90.0, 99.0],
+        workers=2, cores=1)
+    assert out["scaling_efficiency_2w"] == 0.45
+    assert "scaling_vs_cap_reps" not in out  # single pair: no band
+    # no complete pair at all: fall back to the ratio of list maxima
+    out = bench._scaling_summary(
+        pairs=[], t1s=[100.0], tns=[80.0], workers=2, cores=1)
+    assert out["scaling_efficiency_2w"] == 0.4
+    # degenerate: zero t1 measurements guard the division
+    out = bench._scaling_summary(
+        pairs=[(0.0, 50.0)], t1s=[0.0], tns=[50.0], workers=2, cores=1)
+    assert out["scaling_efficiency_2w"] == 0.0
+
+
 def test_cpu_fallback_platform_rejected(bench, monkeypatch, capsys):
     """A silent jax CPU fallback must not publish CPU tokens/s as the
     device headline (unless BENCH_ALLOW_CPU)."""
