@@ -126,8 +126,13 @@ def prefetch_to_device(it: Iterator[Any], depth: int = 2,
             for batch in it:
                 if not put(transfer(batch)):
                     return
-        except Exception as e:  # noqa: BLE001 - re-raised on the consumer
-            put(e)
+        except BaseException as e:  # noqa: BLE001 - re-raised on the
+            # consumer. BaseException, not Exception: a SystemExit/
+            # KeyboardInterrupt escaping `it` would otherwise end this
+            # thread without a sentinel and deadlock the consumer's
+            # unbounded q.get() forever
+            put(e if isinstance(e, Exception)
+                else RuntimeError(f"prefetch source raised {e!r}"))
             return
         put(_END)
 

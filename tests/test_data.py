@@ -109,3 +109,17 @@ def test_prefetch_propagates_source_errors():
     next(it)
     with pytest.raises(RuntimeError, match="source exploded"):
         next(it)
+
+
+def test_prefetch_propagates_base_exceptions():
+    """A SystemExit escaping the source must surface on the consumer
+    (as a RuntimeError) — not end the producer thread sentinel-less and
+    deadlock the consumer's blocking q.get()."""
+    def bad():
+        yield {"x": np.zeros(2)}
+        raise SystemExit(3)
+
+    it = prefetch_to_device(bad(), depth=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="SystemExit"):
+        next(it)
