@@ -45,6 +45,11 @@ Extra keys in the same line:
   the D2H hop moves wire-sized bytes), now gated only on its own probe,
   not on the train phase.
 
+The train phase A/Bs four variants per capture — remat, selective
+remat, chunked-vocab xent, and a hand-fused adam (one elementwise
+kernel per leaf; the driver-side experiment for the "optimizer pass"
+MFU suspect) — and reports each as ``tokens_per_sec_<variant>``.
+
 ``vs_baseline`` compares against a recorded naive-fp32 single-chip
 measurement of the same workload on the same v5e hardware (51,810
 tokens/s at B=16/S=1024 with fp32 activations + remat + log_softmax loss,
@@ -208,23 +213,40 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
 
     tokens = None
 
-    def measure_cfg(cfg) -> float:
+    def fused_adam_for(cfg):
+        """Hand-fused adam over this cfg's loss (shared implementation:
+        byteps_tpu.jax.optim.fused_adam_step, validated bit-close to
+        optax). A/B'd against the optax chain on the real chip by the
+        driver itself: if the optimizer pass is a real MFU cost, this
+        variant wins; if not, it retires the 'optimizer pass' suspect
+        from the ceiling analysis (docs/performance.md)."""
+        from byteps_tpu.jax.optim import fused_adam_step
+
+        init, step = fused_adam_step(
+            lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg))
+        return init, step
+
+    def measure_cfg(cfg, make_opt=None) -> float:
         nonlocal tokens
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
-        # bf16 first moment: halves adam's m-state HBM traffic; v stays
-        # f32 (variance needs the range); ~+1% step time on v5e
-        tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
-        opt = tx.init(params)
         if tokens is None:
             tokens = jnp.asarray(
                 np.random.RandomState(0).randint(0, cfg.vocab_size,
                                                  (B, S + 1)), jnp.int32)
+        if make_opt is not None:
+            opt_init, step = make_opt(cfg)
+            opt = opt_init(params)
+        else:
+            # bf16 first moment: halves adam's m-state HBM traffic; v
+            # stays f32 (variance needs the range); ~+1% step on v5e
+            tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
+            opt = tx.init(params)
 
-        def step(p, o, t):
-            loss, g = jax.value_and_grad(
-                lambda p_: llama.loss_fn(p_, {"tokens": t}, cfg))(p)
-            u, o = tx.update(g, o, p)
-            return optax.apply_updates(p, u), o, loss
+            def step(p, o, t):
+                loss, g = jax.value_and_grad(
+                    lambda p_: llama.loss_fn(p_, {"tokens": t}, cfg))(p)
+                u, o = tx.update(g, o, p)
+                return optax.apply_updates(p, u), o, loss
 
         stepj = jax.jit(step, donate_argnums=(0, 1))
         for _ in range(3):
@@ -237,12 +259,14 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
         return B * S * steps / (time.perf_counter() - t0)
 
     cfg = llama.LlamaConfig.small(vocab_size=32000)
-    variants = {"remat": cfg,
-                # selective remat: save matmul outputs, recompute only
-                # elementwise (measured +1.7% over full remat on v5e;
-                # compiles where noremat's HBM estimate does not)
-                "remat_dots_nb": dataclasses.replace(
-                    cfg, remat_policy="dots_with_no_batch_dims_saveable"),
+    # selective remat: save matmul outputs, recompute only elementwise
+    # (measured +1.7% over full remat on v5e; compiles where noremat's
+    # HBM estimate does not)
+    cfg_dots = dataclasses.replace(
+        cfg, remat_policy="dots_with_no_batch_dims_saveable")
+    # every variant is a uniform (config, make_opt_or_None) pair
+    variants = {"remat": (cfg, None),
+                "remat_dots_nb": (cfg_dots, None),
                 # chunked-vocab xent OVER remat: the [B,S,V] logits never
                 # resident at once (llama.chunked_next_token_xent) — the
                 # HBM-traffic candidate, A/B'd on real hardware every
@@ -253,11 +277,17 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
                 # alongside, noremat's saved activations now exceed v5e
                 # HBM (RESOURCE_EXHAUSTED at compile, ~30s of budget per
                 # attempt) — measured, not hypothetical
-                "chunked8": dataclasses.replace(cfg, xent_chunks=8)}
+                "chunked8": (dataclasses.replace(cfg, xent_chunks=8),
+                             None),
+                # hand-fused adam OVER THE WINNING remat policy (same
+                # cfg as remat_dots_nb, so the pairwise delta isolates
+                # the optimizer pass): the driver-side A/B for the
+                # 'optimizer pass' MFU suspect
+                "fused_adam": (cfg_dots, fused_adam_for)}
     results = {}
-    for name, c in variants.items():
+    for name, (c, make_opt) in variants.items():
         try:
-            results[name] = measure_cfg(c)
+            results[name] = measure_cfg(c, make_opt=make_opt)
         except Exception as e:  # noqa: BLE001 - e.g. OOM on other chips
             sys.stderr.write(f"[bench] train variant {name!r} failed: "
                              f"{e}\n")

@@ -1,0 +1,61 @@
+"""Hand-fused optimizer steps for MFU experiments and the bench.
+
+``fused_adam_step`` computes mu/nu/bias-correction/param-new in ONE
+elementwise expression per leaf — the best case a fused (XLA- or
+Pallas-lowered) optimizer pass can reach, vs optax.adam's chain of
+per-transform tree passes. Numerics validated bit-close to optax
+(max |Δparam| ≈ 1e-7 after 5 steps on the tiny llama config; the CPU
+validation lives alongside the A/B in examples/mfu_experiments.py).
+Shared by bench.py's ``fused_adam`` train variant and the MFU harness
+so the validated math exists exactly once.
+
+Reference context: the reference leaves optimizer fusion to the
+framework (torch fused adam etc.); here it is an A/B lever for the
+"optimizer pass" suspect in docs/performance.md's ceiling analysis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_adam_step(loss_fn, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                    mu_dtype=jnp.bfloat16):
+    """Build ``(init, step)`` for a fully hand-fused adam train step.
+
+    ``loss_fn(params, batch) -> scalar``; ``step(params, opt_state,
+    batch) -> (params, opt_state, loss)`` with every per-leaf update in
+    a single fused expression. ``mu_dtype=bfloat16`` halves the first
+    moment's HBM traffic (matching the bench's optax baseline); nu
+    stays f32 (variance needs the range).
+    """
+
+    def init(params):
+        return {"mu": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, mu_dtype), params),
+                "nu": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def step(p, o, batch):
+        loss, g = jax.value_and_grad(lambda q: loss_fn(q, batch))(p)
+        c = o["count"] + 1
+        cf = c.astype(jnp.float32)
+        bc1, bc2 = 1.0 - b1 ** cf, 1.0 - b2 ** cf
+
+        def leaf(pl, m, v, gl):
+            gf = gl.astype(jnp.float32)
+            m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * gf
+            v2 = b2 * v + (1.0 - b2) * gf * gf
+            new = pl - lr * (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            return new, m2.astype(mu_dtype), v2
+
+        tup = jax.tree.map(leaf, p, o["mu"], o["nu"], g)
+        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (jax.tree.map(lambda x: x[0], tup, is_leaf=is_t),
+                {"mu": jax.tree.map(lambda x: x[1], tup, is_leaf=is_t),
+                 "nu": jax.tree.map(lambda x: x[2], tup, is_leaf=is_t),
+                 "count": c}, loss)
+
+    return init, step

@@ -153,43 +153,17 @@ bench_loss(lambda q, t: llama.loss_fn(
 # -- 5. optimizer pass: hand-fused adam vs the optax chain ---------------- #
 # optax.adam composes scale_by_adam + scale transforms — several tree
 # passes whose per-leaf kernels XLA may or may not fuse across the
-# donated update. This variant computes mu/nu/bias-correction/param-new
-# in ONE elementwise expression per leaf, the best case a fused
-# (pallas or XLA) optimizer could reach: if it doesn't move tokens/s,
-# the optimizer pass is off the MFU suspect list.
-def _fused_adam_step(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
-                     mu_dtype=jnp.bfloat16):
-    def init(params):
-        return {"mu": jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, mu_dtype), params),
-                "nu": jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params),
-                "count": jnp.zeros((), jnp.int32)}
+# donated update. byteps_tpu.jax.optim.fused_adam_step computes
+# mu/nu/bias-correction/param-new in ONE elementwise expression per
+# leaf, the best case a fused (pallas or XLA) optimizer could reach:
+# if it doesn't move tokens/s, the optimizer pass is off the MFU
+# suspect list. (Same implementation bench.py's fused_adam variant
+# runs — one definition, validated bit-close to optax.)
+def _fused_adam_step():
+    from byteps_tpu.jax.optim import fused_adam_step
 
-    def step(p, o, t):
-        loss, g = jax.value_and_grad(
-            lambda q: llama.loss_fn(q, {"tokens": t}, cfg))(p)
-        c = o["count"] + 1
-        cf = c.astype(jnp.float32)
-        bc1 = 1.0 - b1 ** cf
-        bc2 = 1.0 - b2 ** cf
-
-        def leaf(pl, m, v, gl):
-            gf = gl.astype(jnp.float32)
-            m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * gf
-            v2 = b2 * v + (1.0 - b2) * gf * gf
-            new = pl - lr * (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
-            return new, m2.astype(mu_dtype), v2
-
-        tup = jax.tree.map(leaf, p, o["mu"], o["nu"], g)
-        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
-        p2 = jax.tree.map(lambda x: x[0], tup, is_leaf=is_t)
-        o2 = {"mu": jax.tree.map(lambda x: x[1], tup, is_leaf=is_t),
-              "nu": jax.tree.map(lambda x: x[2], tup, is_leaf=is_t),
-              "count": c}
-        return p2, o2, loss
-
-    return init, step
+    return fused_adam_step(
+        lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg))
 
 
 def bench_custom_step(make, label):
