@@ -94,6 +94,69 @@ def test_server_throttle_caps_bandwidth(monkeypatch):
         assert not t.is_alive()
 
 
+def test_throttled_servers_scale_bandwidth(monkeypatch):
+    """The scaling-rule evidence pair (docs/best-practice.md): with the
+    server made the bottleneck by construction (throttle sleeps its
+    threads), splitting the key space over TWO equally-throttled
+    servers must take materially LESS wall time than one — the
+    min(server bw, worker bw) doubling, core-independent. Generous
+    bounds: the 2srv wall must be under 0.75x the 1srv wall (ideal
+    0.5x), and the 1srv wall must be within its cap's predicted range."""
+    monkeypatch.setenv("BYTEPS_SERVER_THROTTLE_MBPS", "25")
+    x = [np.random.RandomState(i).randn(1 << 19).astype(np.float32)
+         for i in range(8)]  # 8 x 2MB keys, placed explicitly below
+
+    def wall(n_servers: int) -> float:
+        addrs, threads = start_servers(n_servers, num_workers=1)
+        c = PSClient(addrs, worker_id=0)
+        srv = [i % n_servers for i in range(len(x))]  # even key split
+        for i, g in enumerate(x):
+            c.init_key(srv[i], 7 + i, np.zeros_like(g), CMD_F32)
+
+        def one_round():
+            # two client threads, keys split between them (the pipeline
+            # scheduler's shape): with 2 servers each thread's keys live
+            # on its own server, so the two token buckets drain in
+            # parallel; with 1 server both threads share one bucket —
+            # which is exactly the rule under test. Futures, not bare
+            # threads: a zpush/zpull error must FAIL the test, not
+            # silently shorten the timed round (same hazard the
+            # two-client test below documents)
+            import concurrent.futures
+
+            def drain(tid):
+                out = np.empty_like(x[0])
+                for i, g in enumerate(x):
+                    if i % 2 != tid:
+                        continue
+                    c.zpush(srv[i], 7 + i, g, CMD_F32)
+                    c.zpull(srv[i], 7 + i, out, CMD_F32)
+
+            with concurrent.futures.ThreadPoolExecutor(2) as ex:
+                for f in [ex.submit(drain, t) for t in range(2)]:
+                    f.result(timeout=60)
+
+        one_round()  # warmup: drains burst credit, init barrier
+        t0 = time.perf_counter()
+        one_round()
+        dt = time.perf_counter() - t0
+        c.close()
+        for t in threads:
+            t.join(timeout=10)
+        return dt
+
+    one = wall(1)
+    two = wall(2)
+    # 16MB payload x 2 dirs / 25MB/s = ~1.28s expected for 1 server:
+    # bounded BOTH ways so an overshooting throttle (which would also
+    # inflate `one` and trivially satisfy the ratio) fails loudly
+    expected = sum(g.nbytes for g in x) * 2 / 25e6
+    assert one > expected * 0.4, f"throttle not binding: {one:.3f}s"
+    assert one < expected * 3.0, f"throttle overshooting: {one:.3f}s"
+    assert two < one * 0.75, (f"2 throttled servers did not scale: "
+                              f"1srv {one:.3f}s vs 2srv {two:.3f}s")
+
+
 def test_two_workers_sum_and_parked_pull():
     addrs, threads = start_servers(1, num_workers=2)
     c0 = PSClient(addrs, worker_id=0)
