@@ -40,10 +40,11 @@ Extra keys in the same line:
   construction (BYTEPS_SERVER_THROTTLE_MBPS sleeps its threads, so the
   cap binds even on 1 core) — 1 throttled server reads ~the throttle,
   2 throttled servers splitting the keys read ~2x it.
-- ``pushpull_dense_tpu_gbps`` / ``pushpull_onebit_tpu_gbps`` — the
-  device-tier pair (grads start on chip; onebit compresses ON chip so
-  the D2H hop moves wire-sized bytes), now gated only on its own probe,
-  not on the train phase.
+- ``pushpull_dense_tpu_gbps`` / ``pushpull_onebit_tpu_gbps`` /
+  ``pushpull_randomk_tpu_gbps`` — the device tier (grads start on
+  chip; the codec compresses ON chip so the D2H hop moves wire-sized
+  bytes — 1/32 for onebit, ~1/50 for randomk), gated only on its own
+  probe, not on the train phase.
 
 The train phase A/Bs four variants per capture — remat, selective
 remat, chunked-vocab xent, and a hand-fused adam (one elementwise
@@ -487,7 +488,7 @@ def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
     successful device probe; a wedge here costs its own subprocess, not
     the round.
 
-    Both rounds use FRESHLY COMPUTED device gradients (a jitted producer
+    All tiers use FRESHLY COMPUTED device gradients (a jitted producer
     re-executed per round). Host-ORIGIN arrays are served from the
     runtime's host-side copy without touching the accelerator link —
     measured 0ms vs 9.3s for a fresh 256MB readback on the axon tunnel
@@ -562,17 +563,40 @@ def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
 
         dense_gbps = best_of(dense_round)
 
-        dc = DeviceCompressor(state.ps_client, 1, {"compressor": "onebit"})
-        names = [f"tbench_{i}" for i in range(n_tensors)]
+        def comp_tier(kwargs, prefix):
+            dc = DeviceCompressor(state.ps_client, 1, kwargs)
+            names = [f"{prefix}_{i}" for i in range(n_tensors)]
 
-        def dev_round():
-            out = dc.push_pull_leaves(state, names, fresh_grads(),
-                                      average=False)
-            np.asarray(out[0][:1])  # host sync
+            def dev_round():
+                out = dc.push_pull_leaves(state, names, fresh_grads(),
+                                          average=False)
+                np.asarray(out[0][:1])  # host sync
 
-        onebit_gbps = best_of(dev_round)
-        return {"pushpull_dense_tpu_gbps": round(dense_gbps, 3),
-                "pushpull_onebit_tpu_gbps": round(onebit_gbps, 3)}
+            return best_of(dev_round)
+
+        out = {"pushpull_dense_tpu_gbps": round(dense_gbps, 3)}
+        # per-tier try/except: a failure in a LATER tier must not
+        # discard the tiers already measured (dense is the phase's most
+        # expensive tier on a thin link — re-paying it because randomk
+        # failed would be pure waste). A mid-tier HANG still costs the
+        # whole child (the watchdog kills the process) — unavoidable
+        # inside one subprocess.
+        for key, kwargs, prefix in (
+                ("pushpull_onebit_tpu_gbps",
+                 {"compressor": "onebit"}, "tbench"),
+                # randomk on chip: ~1/50 the D2H bytes (k=1% of elements
+                # at 8B each — 4B idx + 4B val — vs 4B/elem dense) + the
+                # server's O(k) homomorphic sum; on a thin host link
+                # (the axon tunnel reads ~29MB/s D2H) the sparsest wire
+                # should lead the device tier like it leads the host
+                ("pushpull_randomk_tpu_gbps",
+                 {"compressor": "randomk", "k": "0.01"}, "trk")):
+            try:
+                out[key] = round(comp_tier(kwargs, prefix), 3)
+            except Exception as e:  # noqa: BLE001 - publish what landed
+                sys.stderr.write(f"[bench] device tier {key} failed: "
+                                 f"{e}\n")
+        return out
     finally:
         bps.shutdown()
         server.join(timeout=20)
