@@ -392,6 +392,37 @@ def test_graph_mode_grads_batch_into_one_py_function(bptf_ps, monkeypatch):
         np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
 
 
+def test_graph_batch_single_tensor_unwraps(bptf_ps, monkeypatch):
+    """A one-gradient model under tf.function, with tf.py_function
+    FORCED to return a bare tensor for a single-element Tout (TF 2.21
+    happens to return a list, but the API has varied) —
+    _graph_batch_push_pull's normalization must hand the slot-fill
+    logic a list either way."""
+    import byteps_tpu.tensorflow as mod
+
+    monkeypatch.setattr(mod, "size", lambda: 2)
+    real_py_function = tf.py_function
+
+    def bare_py_function(func, inp, Tout):
+        out = real_py_function(func, inp, Tout)
+        if isinstance(out, (list, tuple)) and len(out) == 1:
+            out = out[0]  # the variant the unwrap guard defends against
+        return out
+
+    monkeypatch.setattr(mod.tf, "py_function", bare_py_function)
+    v = tf.Variable(np.ones((3,), np.float32))
+
+    @tf.function
+    def step():
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(v * 2.0)
+        dtape = mod.DistributedGradientTape(tape, scope="single")
+        return dtape.gradient(loss, [v])[0]
+
+    g = step()
+    np.testing.assert_allclose(g.numpy(), np.full((3,), 2.0), rtol=1e-6)
+
+
 def test_mirrored_strategy_cross_device_ops(bptf_ps):
     """MirroredStrategy over 2 logical CPU devices with the PS-backed
     cross-device ops: local (cross-replica) reduction is TF's own, the
