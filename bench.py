@@ -86,9 +86,11 @@ group with a hard deadline:
   per-phase errors) so a dead round is attributable from the JSON
   alone. Device attempts are budget-gated (a probe-passing-but-hanging
   phase can't stack timeouts past the window): absolute worst ≈ budget
-  + the CPU phases' residual timeouts (~45 min at the 2100s default),
-  ~budget on a wedged tunnel (the residual converts into attempts),
-  ~12 min healthy.
+  + the CPU phases' residual deadlines (420+240+180+900s → ~64 min at
+  the 2100s default; reality is far lower since healthy CPU phases run
+  in a fraction of their deadlines), ~budget on a wedged tunnel (the
+  residual converts into attempts — 13 probes measured on a 900s
+  budget), ~12 min healthy.
 
 Tuning applied vs the anchor: bf16 activations/logits, logsumexp-form
 cross entropy (llama.next_token_xent), B=16 batch (MXU utilization),
