@@ -45,6 +45,11 @@ Extra keys in the same line:
   chip; the codec compresses ON chip so the D2H hop moves wire-sized
   bytes — 1/32 for onebit, ~1/50 for randomk), gated only on its own
   probe, not on the train phase.
+- ``arena_on_step_ms`` / ``arena_off_step_ms`` — steady-state PS train
+  step wall with the persistent host staging arena
+  (BYTEPS_STAGING_ARENA, core/arena.py) on vs off, plus the arena
+  counters (allocs avoided / bytes pinned / conflicts) proving the
+  zero-allocation steady state.
 
 The train phase A/Bs four variants per capture — remat, selective
 remat, chunked-vocab xent, and a hand-fused adam (one elementwise
@@ -84,13 +89,16 @@ group with a hard deadline:
   lands as soon as any probe is healthy. Failures leave ``null`` keys
   plus a per-attempt ``tunnel_diag`` trail (probe wall, platform,
   per-phase errors) so a dead round is attributable from the JSON
-  alone. Device attempts are budget-gated (a probe-passing-but-hanging
-  phase can't stack timeouts past the window): absolute worst ≈ budget
-  + the CPU phases' residual deadlines (420+240+180+900s → ~64 min at
-  the 2100s default; reality is far lower since healthy CPU phases run
-  in a fraction of their deadlines), ~budget on a wedged tunnel (the
-  residual converts into attempts — 13 probes measured on a 900s
-  budget), ~12 min healthy.
+  alone. BOTH tiers are budget-gated — device attempts always were,
+  and since round 6 the CPU phase loop also checks ``remaining()``
+  before each launch and caps every deadline at the leftover window
+  (the round-5 envelope bug: un-gated CPU deadlines pushed the worst
+  case to ~64 min against a ~30 min driver window). Absolute worst is
+  now ≈ budget + one phase deadline; ~budget on a wedged tunnel, ~12
+  min healthy. The snapshot JSON is ALSO flushed after every phase
+  (tagged ``"partial": true``) and on SIGTERM — an external kill at any
+  point leaves the last snapshot as the final parseable line instead
+  of rc=124/parsed=null (how round 5 lost its numbers).
 
 Tuning applied vs the anchor: bf16 activations/logits, logsumexp-form
 cross entropy (llama.next_token_xent), B=16 batch (MXU utilization),
@@ -465,19 +473,99 @@ def phase_pushpull_throttled(total_bytes: int = 64 << 20,
     T, splitting the key space: ~2T. The pair of keys demonstrates the
     rule; the ratio (≈2x) is the evidence the raw-throughput phase
     cannot produce here."""
-    os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle_mbps)
-
     def measure(num_servers: int) -> float:
         with _loopback_ps(num_servers) as bps:
             grads = _make_grads(total_bytes, n_tensors)
             return _dense_round_gbps(bps, grads, f"thr{num_servers}_g",
                                      steps)
 
-    one = measure(1)
-    two = measure(2)
+    # scope the throttle to this phase's servers: under the orchestrator
+    # each phase is its own subprocess, but an in-process caller (tests
+    # importing bench, future phase reordering inside one child) must
+    # not inherit a lingering cap on every later loopback server
+    prior = os.environ.get("BYTEPS_SERVER_THROTTLE_MBPS")
+    os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle_mbps)
+    try:
+        one = measure(1)
+        two = measure(2)
+    finally:
+        if prior is None:
+            del os.environ["BYTEPS_SERVER_THROTTLE_MBPS"]
+        else:
+            os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = prior
     return {"pushpull_throttled_1srv_gbps": round(one, 3),
             "pushpull_throttled_2srv_gbps": round(two, 3),
             "throttle_mbps": throttle_mbps}
+
+
+def phase_arena_ab(steps: int = 6) -> dict:
+    """A/B the persistent host staging arena (core/arena.py,
+    BYTEPS_STAGING_ARENA) on the PS train step's steady state: the same
+    model/batch trained through the loopback PS with the arena on vs
+    off, reporting best-of step wall for each. The arena removes every
+    gradient-sized host allocation after warmup (scheduler out slots,
+    fused-bucket concat, reply staging) and the drain is
+    completion-ordered either way — so the delta isolates the allocator
+    traffic. Host-CPU only; also publishes the arena counters so the
+    zero-steady-state-allocation claim is auditable from the JSON."""
+    import gc
+
+    def run(enabled: bool):
+        os.environ["BYTEPS_STAGING_ARENA"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # mixed sizes on purpose: 4MB leaves ride their own keys,
+            # sub-fusion leaves exercise the fused-bucket slot
+            params = {f"w{i}": jnp.asarray(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": jnp.asarray(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = jnp.asarray(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            best = float("inf")
+            for _ in range(steps):
+                gc.collect()  # level the allocator field between rounds
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3, bps.get_arena_stats()
+
+    prior = os.environ.get("BYTEPS_STAGING_ARENA")
+    try:
+        on_ms, stats = run(True)
+        off_ms, _ = run(False)
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_STAGING_ARENA", None)
+        else:
+            os.environ["BYTEPS_STAGING_ARENA"] = prior
+    return {"arena_on_step_ms": round(on_ms, 2),
+            "arena_off_step_ms": round(off_ms, 2),
+            "arena_allocs_avoided": stats["allocs_avoided"],
+            "arena_bytes_pinned": stats["bytes_pinned"],
+            "arena_checkout_conflicts": stats["checkout_conflicts"]}
 
 
 def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
@@ -713,6 +801,7 @@ _PHASES = {
     "pushpull": phase_pushpull,
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
+    "arena_ab": phase_arena_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
 }
@@ -749,6 +838,12 @@ def _child_main(name: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+# pid of the phase child currently running, for the SIGTERM handler:
+# the driver's `timeout` signals only the parent, and an orphaned child
+# group would keep burning the host after the snapshot is flushed
+_CURRENT_CHILD = [None]
+
+
 def _run_phase(name: str, timeout_s: float):
     """Run a phase child in its own process group; on deadline kill the
     whole group (phase children may spawn worker/server grandchildren).
@@ -759,6 +854,7 @@ def _run_phase(name: str, timeout_s: float):
         stdout=subprocess.PIPE, text=True, start_new_session=True, cwd=REPO,
         env={**os.environ,
              "BENCH_CHILD_WATCHDOG_S": str(max(timeout_s - 20.0, 30.0))})
+    _CURRENT_CHILD[0] = proc.pid
     try:
         out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -770,6 +866,8 @@ def _run_phase(name: str, timeout_s: float):
         sys.stderr.write(f"[bench] phase {name!r} hit the {timeout_s:.0f}s "
                          f"deadline; killed\n")
         return None, "timeout"
+    finally:
+        _CURRENT_CHILD[0] = None
     dt = time.time() - t0
     if proc.returncode != 0:
         sys.stderr.write(f"[bench] phase {name!r} exited rc="
@@ -805,6 +903,8 @@ def main() -> None:
         "pushpull_dense_2srv_gbps": None,
         "pushpull_throttled_1srv_gbps": None,
         "pushpull_throttled_2srv_gbps": None,
+        "arena_on_step_ms": None,
+        "arena_off_step_ms": None,
         "scaling_efficiency_2w": None,
     }
     errors = {}
@@ -818,6 +918,41 @@ def main() -> None:
 
     def remaining() -> float:
         return budget_s - (time.time() - t_start)
+
+    # Envelope-proofing (the round-5 failure: the driver's kill landed
+    # before the single end-of-run print, so the whole round parsed as
+    # null). Two layers: (a) after every phase the CURRENT snapshot is
+    # printed as a JSON line tagged "partial" — an external SIGKILL
+    # still leaves the last snapshot as the final parseable line; (b) a
+    # SIGTERM handler flushes one last snapshot, kills the running
+    # phase child's process group, and exits.
+    def _snapshot(final: bool = False) -> dict:
+        snap = dict(result)
+        if errors:
+            snap["phase_errors"] = dict(errors)
+        snap["tunnel_diag"] = diag
+        if not final:
+            snap["partial"] = True
+        return snap
+
+    def _flush_partial() -> None:
+        print(json.dumps(_snapshot()), flush=True)
+
+    def _on_term(signum, frame):
+        sys.stderr.write("[bench] SIGTERM: flushing partial results\n")
+        print(json.dumps(_snapshot()), flush=True)
+        child = _CURRENT_CHILD[0]
+        if child is not None:
+            try:
+                os.killpg(child, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # not the main thread (in-process test harness)
+        pass
 
     def probe_once(tag: str) -> bool:
         # 60s deadline / 40s child watchdog (was 100/80 through round 4):
@@ -899,22 +1034,36 @@ def main() -> None:
     # fresh chance (round-3 lesson: 2 contiguous attempts inside one
     # wedge window capture nothing).
     try_device("start")
+    _flush_partial()
     for name, timeout_s in (("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # throttled pair: ~13s of timed work at the
                             # default 100MB/s cap + 3 server launches
                             ("pushpull_throttled", 180.0),
+                            # staging-arena A/B: two short loopback
+                            # train runs (arena on vs off)
+                            ("arena_ab", 240.0),
                             # scaling deadline sized for 6 server+worker
                             # launches (3 interleaved 1w/2w reps,
                             # 200-step windows, best-of-3 per config)
                             ("scaling", 900.0)):
-        r, err = _run_phase(name, timeout_s)
+        # budget-gate the CPU phases (the round-5 envelope bug: they ran
+        # to their full deadlines regardless of remaining(), pushing the
+        # worst case past the driver's window): skip when the budget is
+        # spent, and never grant a deadline past the window
+        if remaining() < 45.0:
+            errors[name] = "skipped-budget"
+            continue
+        r, err = _run_phase(name, min(timeout_s,
+                                      max(30.0, remaining() - 10.0)))
         if r:
             result.update(r)
         else:
             errors[name] = err
+        _flush_partial()
         if not (state["trained"] and state["tpu_wire"]):
             try_device(f"after_{name}")
+            _flush_partial()
 
     # Final attempts: if the tunnel was down all round and budget
     # remains, wait it out in slices and keep retrying — wedges have
@@ -946,16 +1095,14 @@ def main() -> None:
                      "sleep_s": round(wait, 0)})
         time.sleep(wait)
         try_device(f"final_{final_round}")
+        _flush_partial()
 
     if not state["probe_ok_ever"] and state["last_probe_err"]:
         errors["probe"] = state["last_probe_err"]
     if result["value"] is not None:
         result["vs_baseline"] = round(result["value"]
                                       / BASELINE_TOKENS_PER_SEC, 4)
-    if errors:
-        result["phase_errors"] = errors
-    result["tunnel_diag"] = diag
-    print(json.dumps(result), flush=True)
+    print(json.dumps(_snapshot(final=True)), flush=True)
 
 
 if __name__ == "__main__":

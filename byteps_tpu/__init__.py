@@ -22,8 +22,12 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .config import Config
-from .core.state import get_state
+from .utils import jax_compat as _jax_compat
+
+_jax_compat.ensure()
+
+from .config import Config  # noqa: E402
+from .core.state import get_state  # noqa: E402
 from .core.types import DataType, QueueType, Status
 from .ops.push_pull import push_pull, broadcast
 
@@ -34,7 +38,8 @@ __all__ = [
     "rank", "size", "local_rank", "local_size",
     "push_pull", "push_pull_async", "poll", "synchronize", "broadcast",
     "declare_tensor", "profiler_step",
-    "get_pushpull_speed", "Config", "DataType", "QueueType", "Status",
+    "get_pushpull_speed", "get_arena_stats",
+    "Config", "DataType", "QueueType", "Status",
 ]
 
 
@@ -86,6 +91,14 @@ def get_pushpull_speed() -> tuple:
     return get_state().telemetry.speed()
 
 
+def get_arena_stats() -> dict:
+    """Host staging arena counters (core/arena.py): slots live, bytes
+    pinned, allocations avoided, checkout conflicts, fresh fallbacks.
+    The steady-state PS train step should show ``allocs_avoided``
+    growing and ``slot_allocs`` flat after warmup."""
+    return get_state().telemetry.arena_stats()
+
+
 def profiler_step() -> None:
     """Advance the Chrome-trace step counter (train steps built via
     byteps_tpu.jax.train call this automatically)."""
@@ -95,10 +108,11 @@ def profiler_step() -> None:
 
 
 def _rowsparse_submit(state, name: str, host2d, average: bool,
-                      handle) -> None:
+                      handle, out=None) -> None:
     """THE single rowsparse submit sequence (row-aligned declare +
     scheduler enqueue), shared by push_pull_rowsparse, the torch adapter
-    and the jax PS train step so the semantics can't drift."""
+    and the jax PS train step so the semantics can't drift. ``out``:
+    optional arena-staged flat f32 result buffer."""
     import numpy as np
 
     from .core.types import DataType
@@ -108,7 +122,7 @@ def _rowsparse_submit(state, name: str, host2d, average: bool,
                                      align_bytes=host2d.shape[1] * 4)
     state.scheduler.submit_rowsparse(
         ctx, host2d, handle, average, state.config.num_workers,
-        version=state.next_version(name))
+        version=state.next_version(name), out=out)
 
 
 def push_pull_rowsparse(tensor, name: str, average: bool = True):
@@ -153,7 +167,7 @@ def push_pull_rowsparse(tensor, name: str, average: bool = True):
 
 
 def push_pull_async(tensor, name: str, average: bool = True,
-                    priority: Optional[int] = None) -> int:
+                    priority: Optional[int] = None, out=None) -> int:
     """Asynchronous PS push_pull: returns an int handle immediately; the
     partitions flow through the priority-scheduled pipeline. Horovod-style
     async surface (reference: byteps_torch_push_pull_async_*,
@@ -163,6 +177,8 @@ def push_pull_async(tensor, name: str, average: bool = True,
     value; the result (sum or mean across workers) is retrieved with
     ``synchronize(handle)``. ``priority=None`` schedules in layer order
     (earlier-declared first); an explicit value overrides (higher = sooner).
+    ``out``: optional preallocated flat result buffer (host staging
+    arena) — the caller must not recycle it before the handle resolves.
     """
     import numpy as np
 
@@ -179,7 +195,7 @@ def push_pull_async(tensor, name: str, average: bool = True,
     state.scheduler.submit(ctx, flat, handle, average,
                            state.config.num_workers,
                            version=state.next_version(name),
-                           priority=priority)
+                           priority=priority, out=out)
     return handle.id
 
 

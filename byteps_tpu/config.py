@@ -78,6 +78,15 @@ class Config:
     # --- compression ---
     min_compress_bytes: int = DEFAULT_MIN_COMPRESS_BYTES
 
+    # --- host staging arena (rebuild addition; the reference's cpubuff
+    # discipline, operations.cc:283-414: staging buffers allocated once
+    # at InitTensor and reused zero-copy). On: the PS train step's
+    # gradient-sized host buffers (scheduler out slots, fused-bucket
+    # concat slots, compressed reply scratch) persist across rounds in
+    # core/arena.py with versioned checkout; off: fresh allocation per
+    # round (the pre-arena behavior; numerics identical). ---
+    staging_arena: bool = True            # BYTEPS_STAGING_ARENA
+
     # --- gradient bucket fusion (rebuild addition; the reference only
     # SPLITS large tensors at partition_bytes — small-tensor fusion is
     # the inverse cure for the same disease: per-key round-trip overhead
@@ -139,6 +148,7 @@ class Config:
             mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES",
                                         DEFAULT_MIN_COMPRESS_BYTES),
+            staging_arena=_env_bool("BYTEPS_STAGING_ARENA", True),
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

@@ -84,6 +84,12 @@ class TensorRegistry:
         # (global.cc:628-677).
         self._server_load: List[int] = [0] * max(1, config.num_servers)
         self._declaration_order: List[str] = []
+        # host staging arena (core/arena.py): re-partitioning a tensor
+        # makes its staged slot sizes stale, so the registry drops them
+        self._arena = None
+
+    def attach_arena(self, arena) -> None:
+        self._arena = arena
 
     # ------------------------------------------------------------------ #
     # declaration
@@ -174,7 +180,15 @@ class TensorRegistry:
                       f"align_bytes {align_bytes}")
             part_bytes = max(align_bytes,
                              part_bytes // align_bytes * align_bytes)
-        # Re-init: retire the old partitions' load accounting first.
+        # Re-init: retire the old partitions' load accounting first, and
+        # drop the tensor's staged arena slots (their sizes are stale;
+        # the arena would also self-heal at the next checkout, but an
+        # eager drop releases the pinned bytes immediately). The ":"
+        # terminator scopes the match to THIS tensor's keys
+        # ("{name}:out", "{name}:reply:{i}") — bare startswith(name)
+        # would also hit siblings like "w10" when "w1" re-partitions.
+        if ctx.partitions and self._arena is not None:
+            self._arena.invalidate_prefix(ctx.name + ":")
         for p in ctx.partitions:
             if p.server < len(self._server_load):
                 self._server_load[p.server] -= p.length

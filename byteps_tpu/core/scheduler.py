@@ -147,7 +147,7 @@ class PartitionTask:
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
-                 "cmd_pull", "pull_len", "push_len")
+                 "cmd_pull", "pull_len", "push_len", "lease")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
                  group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
@@ -166,6 +166,7 @@ class PartitionTask:
         self.cmd_pull = cmd if cmd_pull is None else cmd_pull
         self.pull_len = pull_len   # reply bytes when not dense (telemetry)
         self.push_len = None       # actual pushed bytes (set by _do_push)
+        self.lease = None          # arena lease for reply scratch (if any)
 
     @property
     def key(self) -> int:
@@ -218,9 +219,23 @@ class Handle:
         self._ev = threading.Event()
         self._err: Optional[Exception] = None
         self.result: Optional[np.ndarray] = None
+        self._cb_mu = threading.Lock()
+        self._cbs: List[Callable[[], None]] = []
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+    def add_done_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn()`` when the handle completes (immediately if it
+        already has). Powers the completion-ordered IMPORT drain in
+        make_ps_train_step: the H2D of tensor k starts the moment its
+        pull lands, instead of behind every earlier waiter. Callbacks
+        run on the completing scheduler thread — keep them tiny."""
+        with self._cb_mu:
+            if not self._ev.is_set():
+                self._cbs.append(fn)
+                return
+        fn()
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._ev.wait(timeout):
@@ -232,7 +247,15 @@ class Handle:
     def _finish(self, result, err) -> None:
         self.result = result
         self._err = err
-        self._ev.set()
+        with self._cb_mu:
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for fn in cbs:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - must not poison completion
+                log.exception("handle done-callback for %r raised",
+                              self.name)
 
 
 class HandleManager:
@@ -320,7 +343,7 @@ class PipelineScheduler:
 
     def __init__(self, client, num_threads: int = 8,
                  credit_bytes: int = 0, tracer=None, telemetry=None,
-                 config=None):
+                 config=None, arena=None):
         import concurrent.futures
         import os
 
@@ -329,6 +352,10 @@ class PipelineScheduler:
         self._tracer = tracer
         self._telemetry = telemetry
         self._config = config
+        # persistent host staging arena (core/arena.py): reply scratch
+        # for compressed pulls checks out of it instead of np.empty per
+        # round; None = allocate fresh (the pre-arena behavior)
+        self._arena = arena
         n_codec = min(8, max(2, (os.cpu_count() or 4) // 2))
         self._push_pool = concurrent.futures.ThreadPoolExecutor(
             num_threads, thread_name_prefix="bps-push")
@@ -468,7 +495,17 @@ class PipelineScheduler:
             self._tracer.begin(name, span)
         try:
             if task.stack is not None:
-                reply = np.empty(task.stack.wire_bytes(), np.uint8)
+                wb = task.stack.wire_bytes()
+                if self._arena is not None:
+                    # per-key persistent reply scratch: same-key
+                    # serialization means the previous round's lease is
+                    # back by the time this one pulls (a conflict falls
+                    # back to a fresh buffer inside the arena)
+                    task.lease = self._arena.checkout(
+                        f"pull:{task.key}", wb)
+                    reply = task.lease.buf
+                else:
+                    reply = np.empty(wb, np.uint8)
                 got = self._client.zpull(task.partition.server, task.key,
                                          reply, task.cmd_pull)
                 task.wire = reply[:got]  # variable-length wires (varint)
@@ -515,6 +552,20 @@ class PipelineScheduler:
         self._finish(task, None)
 
     def _finish(self, task: PartitionTask, err: Optional[Exception]) -> None:
+        if task.lease is not None:
+            # reply scratch is fully consumed by now (DECOMPRESS wrote
+            # the result into out_view; telemetry below reads only
+            # lengths). Release BEFORE report_finish: the moment the
+            # key leaves the in-flight set, the next same-key task can
+            # be admitted and reach its own checkout — a still-held
+            # lease there would conflict into a fresh allocation. On
+            # error the wire may be half-written garbage — abandon so
+            # the slot is never recycled under a late writer.
+            if err is None:
+                task.lease.release()
+            else:
+                task.lease.abandon()
+            task.lease = None
         self._queue.report_finish(task)
         if self._telemetry:
             if task.stack is not None:
@@ -545,7 +596,7 @@ class PipelineScheduler:
     def submit(self, ctx: TensorContext, flat_in: np.ndarray,
                handle: Handle, average: bool, num_workers: int,
                version: int = 0, priority: Optional[int] = None,
-               comp=None) -> None:
+               comp=None, out: Optional[np.ndarray] = None) -> None:
         """Enqueue all partitions of one tensor; fills ``handle`` when the
         last partition completes. ``priority=None`` uses the layer-order
         default -declared_key (tensorflow/ops.cc:155-158); an explicit
@@ -555,6 +606,12 @@ class PipelineScheduler:
         then carry per-partition codec stacks through the COMPRESS/
         DECOMPRESS stages (sub-min-compress-bytes partitions stay dense),
         and the compression round counter seeds the stateful codecs.
+
+        ``out``: preallocated flat result buffer (host staging arena
+        integration, core/arena.py) — the pull lands in it and the
+        handle resolves to it; the caller must not recycle it until the
+        handle resolves AND it is done reading the result. A mismatched
+        buffer is ignored (correctness never depends on staging).
         """
         from .types import DataType, RequestType, get_command_type
 
@@ -569,7 +626,9 @@ class PipelineScheduler:
         cmd_comp = get_command_type(
             RequestType.COMPRESSED_PUSH_PULL,
             DataType.from_np(flat_in.dtype)) if comp is not None else cmd
-        out = np.empty_like(flat_in)
+        from .arena import usable_staging
+        if not usable_staging(out, flat_in.dtype, flat_in.nbytes):
+            out = np.empty_like(flat_in)
         in_view = flat_in.view(np.uint8)
         out_view = out.view(np.uint8)
 
@@ -604,16 +663,26 @@ class PipelineScheduler:
 
     def submit_wire(self, ctx: TensorContext, wires: List[np.ndarray],
                     reply_lens: List[int], cmds: List[int], handle: Handle,
-                    version: int = 0,
-                    priority: Optional[int] = None) -> None:
+                    version: int = 0, priority: Optional[int] = None,
+                    reply_bufs: Optional[List[np.ndarray]] = None) -> None:
         """Prebuilt-wire push_pull for device-compressed tensors
         (jax/device_compression.py): partition i pushes ``wires[i]`` with
         ``cmds[i]`` and pulls ``reply_lens[i]`` raw bytes; the handle
         resolves to the list of reply buffers. No host codec stages —
         compress and decompress run inside the worker's XLA programs, so
         the pipeline here is pure PUSH -> PULL with the usual priority,
-        credit and same-key serialization semantics."""
-        replies = [np.empty(rl, np.uint8) for rl in reply_lens]
+        credit and same-key serialization semantics.
+
+        ``reply_bufs``: caller-owned (arena-staged) per-partition reply
+        buffers, reused round over round instead of fresh np.empty; a
+        mismatched list is ignored."""
+        from .arena import usable_staging
+        if (reply_bufs is not None and len(reply_bufs) == len(reply_lens)
+                and all(usable_staging(b, np.dtype(np.uint8), rl)
+                        for b, rl in zip(reply_bufs, reply_lens))):
+            replies = list(reply_bufs)
+        else:
+            replies = [np.empty(rl, np.uint8) for rl in reply_lens]
 
         def on_complete(err: Optional[Exception]) -> None:
             handle._finish(replies if err is None else None, err)
@@ -632,12 +701,14 @@ class PipelineScheduler:
 
     def submit_rowsparse(self, ctx: TensorContext, host2d: np.ndarray,
                          handle: Handle, average: bool, num_workers: int,
-                         version: int = 0,
-                         priority: Optional[int] = None) -> None:
+                         version: int = 0, priority: Optional[int] = None,
+                         out: Optional[np.ndarray] = None) -> None:
         """Row-sparse push_pull through the priority pipeline: per
         row-aligned partition, the nonzero rows become a prebuilt sparse
         push payload ([nrows][width][ids][rows]) and the pull is dense —
-        same credit/priority semantics as dense and compressed traffic."""
+        same credit/priority semantics as dense and compressed traffic.
+        ``out``: optional arena-staged flat f32 result buffer (see
+        ``submit``)."""
         from ..server.client import build_rowsparse_payload
         from .types import DataType, RequestType, get_command_type
 
@@ -649,7 +720,9 @@ class PipelineScheduler:
         cmd_dense = get_command_type(RequestType.DEFAULT_PUSH_PULL,
                                      DataType.FLOAT32)
         nz = np.flatnonzero(np.any(host2d != 0, axis=1)).astype(np.int32)
-        out = np.empty(rows * width, np.float32)
+        from .arena import usable_staging
+        if not usable_staging(out, np.dtype(np.float32), rows * width * 4):
+            out = np.empty(rows * width, np.float32)
         out_view = out.view(np.uint8)
 
         def on_complete(err: Optional[Exception]) -> None:

@@ -58,6 +58,20 @@ class _Telemetry:
         with self._lock:
             return self._last_sample
 
+    # --- host staging arena surface (core/arena.py) ------------------- #
+
+    def attach_arena(self, arena) -> None:
+        self._arena = arena
+
+    def arena_stats(self) -> dict:
+        """Live staging-arena counters (slots live, bytes pinned,
+        allocations avoided, checkout conflicts); zeros before init."""
+        arena = getattr(self, "_arena", None)
+        if arena is None:
+            from .arena import StagingArena
+            return StagingArena(enabled=False).stats()
+        return arena.stats()
+
 
 class GlobalState:
     """Singleton holding all process-wide framework state."""
@@ -77,6 +91,12 @@ class GlobalState:
         self.ps_client = None        # set by server.client when PS configured
         self.scheduler = None        # PipelineScheduler over ps_client
         self.handles = None          # HandleManager for the async API
+        # persistent host staging arena (core/arena.py); replaced with an
+        # enabled instance at init() when BYTEPS_STAGING_ARENA is on —
+        # a disabled arena hands out fresh buffers with identical
+        # semantics, so callers never need to branch on it
+        from .arena import StagingArena
+        self.arena = StagingArena(enabled=False)
         self._version: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -102,6 +122,11 @@ class GlobalState:
             refresh_level()
             self.config = config or Config.from_env()
             self.telemetry.enabled = self.config.telemetry_on
+            # fresh arena per init: counters start clean, and a resumed
+            # worker with a new topology never reuses stale-sized slots
+            from .arena import StagingArena
+            self.arena = StagingArena(enabled=self.config.staging_arena)
+            self.telemetry.attach_arena(self.arena)
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.
@@ -118,10 +143,12 @@ class GlobalState:
                         self.config, num_workers=pcount, worker_id=pid)
             if self.registry is None:
                 self.registry = TensorRegistry(self.config)
+                self.registry.attach_arena(self.arena)
             else:
                 # re-init (elastic resume or shutdown->init with new env):
                 # keep declaration order so keys stay stable
                 # (global.cc:431-436), but rebind the new config.
+                self.registry.attach_arena(self.arena)
                 self.registry.redeclare_all(self.config)
             # PS mode with multiple processes: the mesh stays local to
             # this process (ICI collectives intra-process; the DCN PS sums
@@ -180,7 +207,7 @@ class GlobalState:
                     self.ps_client,
                     credit_bytes=self.config.scheduling_credit,
                     tracer=self.tracer, telemetry=self.telemetry,
-                    config=self.config)
+                    config=self.config, arena=self.arena)
                 self.handles = HandleManager()
             self.initialized = True
             self.suspended = False
@@ -205,6 +232,9 @@ class GlobalState:
                 except Exception as e:  # noqa: BLE001
                     log.warning("jax.profiler.stop_trace failed: %s", e)
                 self._jax_profiling = False
+            # free the pinned staging bytes (slots are rebuilt lazily
+            # by the next init's first submissions)
+            self.arena.reset()
             self.initialized = False
             self.suspended = False
 

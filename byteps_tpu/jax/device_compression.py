@@ -151,13 +151,17 @@ class _PackSpec:
 
     def pack_np(self, payloads) -> Dict[str, np.ndarray]:
         """Host: reply payload pytree (np views) -> {dtype: buffer} for
-        a couple of H2D uploads."""
+        a couple of H2D uploads. Always returns buffers INDEPENDENT of
+        the inputs (np.concatenate copies; the single-bucket case copies
+        explicitly): the views may alias arena reply slots that are
+        recycled the moment the caller releases them, which must not
+        race the async upload."""
         flat = self.treedef.flatten_up_to(payloads)
         buckets: Dict[str, list] = {}
         for (dt, _, _, _), leaf in zip(self.leaf_meta, flat):
             buckets.setdefault(dt, []).append(
                 np.ravel(np.asarray(leaf, dtype=dt)))
-        return {dt: np.concatenate(v) if len(v) > 1 else v[0]
+        return {dt: np.concatenate(v) if len(v) > 1 else v[0].copy()
                 for dt, v in buckets.items()}
 
     def unpack_jnp(self, packed: Dict[str, jnp.ndarray]):
@@ -419,33 +423,55 @@ class DeviceCompressor:
         packed_np = {k: np.asarray(v) for k, v in packed.items()}
         payloads = spec.unpack_np(packed_np)
 
+        # reply buffers check out of the persistent staging arena
+        # (core/arena.py) instead of np.empty per round; leases are
+        # released once pack_np below has copied the payloads out, or
+        # abandoned if the round errors with pulls possibly mid-flight
+        arena = getattr(state, "arena", None)
+        leases: List = []
         handles = []
-        for plan, pl in zip(plans, payloads):
-            wires = []
-            for i, (payload, codec) in enumerate(zip(pl, plan.codecs)):
-                wires.append(payload_to_wire(codec, payload))
-            handle = state.handles.allocate(plan.name)
-            state.scheduler.submit_wire(
-                plan.ctx, wires,
-                [plan.reply_len(i) for i in range(len(wires))],
-                [CMD_F32 if c is None else CMD_COMP_F32
-                 for c in plan.codecs],
-                handle, version=state.next_version(plan.name),
-                priority=plan.priority)
-            handles.append(handle)
+        try:
+            for plan, pl in zip(plans, payloads):
+                wires = []
+                for i, (payload, codec) in enumerate(zip(pl, plan.codecs)):
+                    wires.append(payload_to_wire(codec, payload))
+                reply_lens = [plan.reply_len(i) for i in range(len(wires))]
+                reply_bufs = None
+                if arena is not None:
+                    ls = [arena.checkout(f"{plan.name}:reply:{i}", rl)
+                          for i, rl in enumerate(reply_lens)]
+                    leases.extend(ls)
+                    reply_bufs = [lease.buf for lease in ls]
+                handle = state.handles.allocate(plan.name)
+                state.scheduler.submit_wire(
+                    plan.ctx, wires, reply_lens,
+                    [CMD_F32 if c is None else CMD_COMP_F32
+                     for c in plan.codecs],
+                    handle, version=state.next_version(plan.name),
+                    priority=plan.priority, reply_bufs=reply_bufs)
+                handles.append(handle)
 
-        replies_np = [state.handles.wait_and_clear(h.id) for h in handles]
-        replies = []
-        for plan, reps in zip(plans, replies_np):
-            parsed = []
-            for i, (rep, codec) in enumerate(zip(reps, plan.codecs)):
-                pn = plan.ctx.partitions[i].length // 4
-                parsed.append(wire_to_payload(codec, pn, rep))
-            replies.append(parsed)
-        # mirror of the push side: host-concatenate the reply payloads
-        # into one buffer per dtype (cheap memcpy) so the host->device
-        # hop is 1-2 uploads, then slice them back apart inside the
-        # jitted decompress
-        flats = decompress_fn(spec.pack_np(replies))
+            replies_np = [state.handles.wait_and_clear(h.id)
+                          for h in handles]
+            replies = []
+            for plan, reps in zip(plans, replies_np):
+                parsed = []
+                for i, (rep, codec) in enumerate(zip(reps, plan.codecs)):
+                    pn = plan.ctx.partitions[i].length // 4
+                    parsed.append(wire_to_payload(codec, pn, rep))
+                replies.append(parsed)
+            # mirror of the push side: host-concatenate the reply payloads
+            # into one buffer per dtype (cheap memcpy) so the host->device
+            # hop is 1-2 uploads, then slice them back apart inside the
+            # jitted decompress. pack_np COPIES, so the arena reply slots
+            # are idle from here on.
+            packed_replies = spec.pack_np(replies)
+        except BaseException:
+            for lease in leases:
+                lease.abandon()
+            raise
+        for lease in leases:
+            lease.release()
+        flats = decompress_fn(packed_replies)
         return [f.reshape(lf.shape).astype(lf.dtype)
                 for f, lf in zip(flats, leaves)]

@@ -361,30 +361,58 @@ def make_ps_train_step(
             # on a pool / run blocking.
             import byteps_tpu as bps
 
+            # Persistent host staging (core/arena.py, the reference's
+            # cpubuff discipline): result slots and fused-bucket concat
+            # slots check out of the arena instead of np.empty per step;
+            # every lease is released only after the imports below
+            # complete (or abandoned on error — correctness never
+            # depends on a slot surviving).
+            arena = state.arena
+            leases: list = []
+
+            def checkout(key, nbytes, dtype):
+                lease = arena.checkout(key, nbytes)
+                leases.append(lease)
+                return lease.array(dtype)
+
             def submit_sparse(name, h2d, out_dtype):
                 from .. import _rowsparse_submit
                 handle = state.handles.allocate(name)
+                obuf = checkout(f"{name}:out", h2d.size * 4, np.float32)
                 _rowsparse_submit(state, name,
                                   h2d.astype(np.float32, copy=False),
-                                  True, handle)
-                return lambda: state.handles.wait_and_clear(
-                    handle.id).astype(out_dtype, copy=False)
+                                  True, handle, out=obuf)
+                return (lambda: state.handles.wait_and_clear(
+                    handle.id).astype(out_dtype, copy=False)), handle
 
             def submit(name, flat):
+                """Returns (finish, notifier): ``finish()`` yields the
+                reduced array (non-blocking once ``notifier`` — a Handle
+                or Future with add_done_callback, or None for an already
+                complete result — has fired)."""
                 if reg is not None:
                     flat = flat.astype(np.float32, copy=False)
                     if state.scheduler is not None:
-                        hd = reg.push_pull_async(state, name, flat, True)
-                        return lambda: bps.synchronize(hd)
+                        obuf = checkout(f"{name}:out", flat.nbytes,
+                                        np.float32)
+                        hd = reg.push_pull_async(state, name, flat, True,
+                                                 out=obuf)
+                        return (lambda: bps.synchronize(hd),
+                                state.handles.get(hd))
                     fut = _comp_pool().submit(
                         reg.push_pull, state, name, flat, True)
-                    return fut.result
+                    return fut.result, fut
                 if state.scheduler is not None:
-                    hd = bps.push_pull_async(flat, name, average=True)
-                    return lambda: bps.synchronize(hd)
+                    obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype)
+                    hd = bps.push_pull_async(flat, name, average=True,
+                                             out=obuf)
+                    return (lambda: bps.synchronize(hd),
+                            state.handles.get(hd))
                 from ..server.client import ps_round_trip
-                out = ps_round_trip(state, name, flat, average=True)
-                return lambda: out
+                obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype)
+                res = ps_round_trip(state, name, flat, average=True,
+                                    out=obuf)
+                return (lambda: res), None
 
             # Bucket fusion (BYTEPS_FUSION_BYTES; the group-push cure):
             # per-key cost (scheduler admission, handle, two syscall
@@ -415,8 +443,7 @@ def make_ps_train_step(
             if reg is not None and mcb > 0:
                 fusion = min(fusion, mcb)
                 bucket_cap = min(bucket_cap, mcb - 1)
-            results: list = [None] * len(names)
-            waiters = []   # (slot_or_slots, finisher)
+            waiters = []   # (slot_or_slots, finisher, notifier)
             bucket: list = []  # [(slot, name, flat_f-contig host array)]
             bucket_bytes = 0
 
@@ -426,50 +453,107 @@ def make_ps_train_step(
                     return
                 if len(bucket) == 1:
                     slot, name, h = bucket[0]
-                    waiters.append((slot, submit(name, h.reshape(-1))))
+                    waiters.append((slot, *submit(name, h.reshape(-1))))
                 else:
                     import hashlib
-                    parts = [h.reshape(-1) for _, _, h in bucket]
                     digest = hashlib.sha1(";".join(
                         f"{n}:{h.size}" for _, n, h in bucket)
                         .encode()).hexdigest()[:12]
-                    fused = np.concatenate(parts)
+                    # concatenate into the bucket's PERSISTENT arena
+                    # slot (np.concatenate would allocate the fused
+                    # buffer fresh every step). With compression on the
+                    # wire is f32, so fill as f32 and skip the astype
+                    # copy submit() would otherwise make.
+                    bdt = np.dtype(np.float32) if reg is not None \
+                        else bucket[0][2].dtype
+                    total = sum(h.size for _, _, h in bucket)
+                    fused = checkout(f"fused/{digest}:in",
+                                     total * bdt.itemsize, bdt)
+                    off = 0
+                    for _, _, h in bucket:
+                        fused[off:off + h.size] = h.reshape(-1)
+                        off += h.size
                     slots = [s for s, _, _ in bucket]
                     sizes = [h.size for _, _, h in bucket]
-                    w = submit(f"fused/{digest}", fused)
+                    w, notifier = submit(f"fused/{digest}", fused)
 
                     def finish(w=w, sizes=sizes):
                         out = w()
                         outs = np.split(out, np.cumsum(sizes)[:-1])
                         return outs
 
-                    waiters.append((slots, finish))
+                    waiters.append((slots, finish, notifier))
                 bucket, bucket_bytes = [], 0
 
-            for i, (name, leaf) in enumerate(zip(names, leaves)):
-                h = np.asarray(leaf)  # ready-or-wait for THIS leaf only
-                if _route_rowsparse(name, h, state, rowsparse_params):
-                    flush_bucket()
-                    # non-f32 grads upcast for the wire, cast back below
-                    waiters.append((i, submit_sparse(name, h, h.dtype)))
-                elif h.nbytes < fusion:
-                    if bucket and (bucket[0][2].dtype != h.dtype
-                                   or bucket_bytes + h.nbytes > bucket_cap):
+            imported: list = [None] * len(names)
+            try:
+                for i, (name, leaf) in enumerate(zip(names, leaves)):
+                    h = np.asarray(leaf)  # ready-or-wait for THIS leaf
+                    if _route_rowsparse(name, h, state, rowsparse_params):
                         flush_bucket()
-                    bucket.append((i, name, h))
-                    bucket_bytes += h.nbytes
-                else:
-                    flush_bucket()
-                    waiters.append((i, submit(name, h.reshape(-1))))
-            flush_bucket()
-            shapes = [np.shape(leaf) for leaf in leaves]
-            for slot, finish in waiters:
-                if isinstance(slot, list):
-                    for s, piece in zip(slot, finish()):
-                        results[s] = piece.reshape(shapes[s])
-                else:
-                    results[slot] = finish().reshape(shapes[slot])
-            grads = treedef.unflatten(results)
+                        # non-f32 grads upcast for the wire, cast back
+                        waiters.append((i, *submit_sparse(name, h,
+                                                          h.dtype)))
+                    elif h.nbytes < fusion:
+                        if bucket and (bucket[0][2].dtype != h.dtype
+                                       or bucket_bytes + h.nbytes
+                                       > bucket_cap):
+                            flush_bucket()
+                        bucket.append((i, name, h))
+                        bucket_bytes += h.nbytes
+                    else:
+                        flush_bucket()
+                        waiters.append((i, *submit(name, h.reshape(-1))))
+                flush_bucket()
+                shapes = [np.shape(leaf) for leaf in leaves]
+                # Completion-ordered IMPORT drain: instead of draining
+                # every waiter in submission order and only then letting
+                # apply_fn upload the whole tree, issue the async H2D
+                # device_put for each leaf THE MOMENT its pull lands —
+                # XLA overlaps the import of tensor k with the DCN PULL
+                # of tensor k+1, the mirror of the copy_to_host_async
+                # EXPORT overlap above (reference: COPYH2D as its own
+                # pipeline stage, core_loops.cc:620-648).
+                import queue as _queue
+
+                ready: "_queue.Queue" = _queue.Queue()
+                for wi, (_, _, notifier) in enumerate(waiters):
+                    if notifier is None:
+                        ready.put(wi)
+                    else:
+                        notifier.add_done_callback(
+                            lambda *_a, wi=wi: ready.put(wi))
+                for _ in range(len(waiters)):
+                    slot, finish, _ = waiters[ready.get()]
+                    if isinstance(slot, list):
+                        for s, piece in zip(slot, finish()):
+                            imported[s] = jax.device_put(
+                                piece.reshape(shapes[s]))
+                    else:
+                        imported[slot] = jax.device_put(
+                            finish().reshape(shapes[slot]))
+                # wait for the H2D transfers only (apply_fn needs them
+                # anyway) so the arena slots are provably idle before
+                # they are released for the next round
+                jax.block_until_ready([x for x in imported
+                                       if x is not None])
+            except BaseException:
+                # a failed round (submission OR drain) may leave pulls
+                # mid-flight into these slots: abandon (drop from the
+                # table) instead of recycling them under a late writer.
+                # The not-yet-drained sibling handles must not pin their
+                # gradient-sized result buffers in the handle table for
+                # the life of the process either (the same leak class
+                # the TF graph tier discards against).
+                for lease in leases:
+                    lease.abandon()
+                for _, _, notifier in waiters:
+                    if hasattr(notifier, "id"):
+                        state.handles.discard(notifier.id)
+                raise
+            for lease in leases:
+                lease.release()
+            grads = treedef.unflatten(imported)
         params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, loss
 

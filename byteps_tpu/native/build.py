@@ -18,6 +18,11 @@ _SRC = os.path.join(_DIR, "ps.cc")
 _LOCK = threading.Lock()
 
 CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+# shm_open/sem_* live in librt on glibc < 2.34 (a no-op stub after): a
+# binary linked on a new-glibc host dlopens with "undefined symbol:
+# shm_open" on an older one, so always link it (dropped as a last
+# resort for toolchains without librt).
+LDFLAGS = ["-lrt"]
 
 
 def _sanitizer_flags() -> list:
@@ -51,7 +56,8 @@ def _cpu_tag() -> str:
 def lib_path() -> str:
     with open(_SRC, "rb") as f:
         h = hashlib.sha256(f.read())
-    h.update(" ".join(_sanitizer_flags()).encode())
+    h.update(" ".join(CXXFLAGS + LDFLAGS
+                      + _sanitizer_flags()).encode())
     h.update(_cpu_tag().encode())
     digest = h.hexdigest()[:16]
     return os.path.join(_DIR, f"libbyteps_ps-{digest}.so")
@@ -80,14 +86,21 @@ def build(verbose: bool = False) -> str:
         # writing. Per-pid tmps make each publish atomic and last-wins.
         tmp = f"{out}.tmp.{os.getpid()}"
         try:
-            cmd = ["g++", *flags, "-march=native", _SRC, "-o", tmp]
-            if verbose:
-                print("[byteps_tpu] building native PS:", " ".join(cmd))
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                cmd = ["g++", *flags, _SRC, "-o", tmp]
+            attempts = (
+                [*flags, "-march=native", _SRC, "-o", tmp, *LDFLAGS],
+                [*flags, _SRC, "-o", tmp, *LDFLAGS],
+                [*flags, _SRC, "-o", tmp],
+            )
+            proc = None
+            for args in attempts:
+                cmd = ["g++", *args]
+                if verbose:
+                    print("[byteps_tpu] building native PS:",
+                          " ".join(cmd))
                 proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
+                if proc.returncode == 0:
+                    break
+            if proc is None or proc.returncode != 0:
                 raise RuntimeError(
                     f"native build failed:\n{proc.stderr[-4000:]}")
             os.replace(tmp, out)

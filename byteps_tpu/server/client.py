@@ -108,15 +108,16 @@ def build_rowsparse_payload(p: Partition, nz: np.ndarray,
 
 
 def ps_round_trip(state, name: str, host: np.ndarray,
-                  average: bool,
-                  priority: Optional[int] = None) -> np.ndarray:
+                  average: bool, priority: Optional[int] = None,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
     """Shared get-or-declare + server round-trip for one flat host tensor:
     used by both the eager push_pull PS tier and make_ps_train_step.
 
     Fans the partitions out through the priority-scheduled pipeline when
     one is running (so eager callers get the same credit/priority semantics
     and PUSH/PULL stage overlap as the async API), falling back to the
-    client's blocking fan-out otherwise."""
+    client's blocking fan-out otherwise. ``out``: optional arena-staged
+    flat result buffer (the caller owns its reuse window)."""
     ctx = get_or_init_ctx(state, name, host)
     host = np.ascontiguousarray(host)
     if state.scheduler is not None and state.handles is not None:
@@ -124,13 +125,14 @@ def ps_round_trip(state, name: str, host: np.ndarray,
         state.scheduler.submit(ctx, host, handle, average,
                                state.config.num_workers,
                                version=state.next_version(name),
-                               priority=priority)
+                               priority=priority, out=out)
         # scheduler records telemetry per-partition on completion
         return state.handles.wait_and_clear(handle.id)
-    out = state.ps_client.push_pull(
-        ctx, host, average=average, num_workers=state.config.num_workers)
+    res = state.ps_client.push_pull(
+        ctx, host, average=average, num_workers=state.config.num_workers,
+        out=out)
     state.telemetry.record(host.nbytes * 2)
-    return out
+    return res
 
 
 class PSClient:
@@ -340,14 +342,18 @@ class PSClient:
 
     def push_pull(self, ctx: TensorContext, flat: np.ndarray,
                   average: bool = True,
-                  num_workers: Optional[int] = None) -> np.ndarray:
+                  num_workers: Optional[int] = None,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
         """Partitioned push+pull of one tensor; returns the summed
-        (averaged) flat array."""
+        (averaged) flat array. ``out``: optional preallocated result
+        buffer (host staging arena); ignored on any mismatch."""
         if self._closed:
             raise RuntimeError("push_pull on a closed PSClient")
         dtype = flat.dtype
         self.ensure_init(ctx, flat.nbytes)
-        out = np.empty_like(flat)
+        from ..core.arena import usable_staging
+        if not usable_staging(out, dtype, flat.nbytes):
+            out = np.empty_like(flat)
         self._round_trip(ctx, flat, out)
         if average and num_workers and num_workers > 1:
             if np.issubdtype(dtype, np.integer):

@@ -200,13 +200,15 @@ class CompressedRegistry:
 
     def push_pull_async(self, state, name: str, flat: np.ndarray,
                         average: bool = True,
-                        priority: Optional[int] = None) -> int:
+                        priority: Optional[int] = None,
+                        out: Optional[np.ndarray] = None) -> int:
         """Submit a compressed push_pull through the priority-scheduled
         pipeline (COMPRESS -> PUSH -> PULL -> DECOMPRESS stages with credit
         admission — the reference's scheduled-queue splice,
         operations.cc:199-204); returns an async handle id for
         ``bps.synchronize``. Telemetry is recorded per-partition by the
-        scheduler."""
+        scheduler. ``out``: optional arena-staged flat f32 result buffer
+        (see PipelineScheduler.submit)."""
         flat = np.ascontiguousarray(flat, np.float32)
         ct = self.get(state, name, flat)
         if ct.priority is None:
@@ -216,5 +218,6 @@ class CompressedRegistry:
         handle._shape = flat.shape
         state.scheduler.submit(
             ct.ctx, flat, handle, average, self.num_workers,
-            version=state.next_version(name), priority=ct.priority, comp=ct)
+            version=state.next_version(name), priority=ct.priority,
+            comp=ct, out=out)
         return handle.id

@@ -489,12 +489,23 @@ def _graph_batch_push_pull(named: List, compression) -> List:
 
     def _op(*tensors):
         subs = []
-        for nm, t in zip(names, tensors):
-            wire, cctx = compression.compress(t.numpy())
-            subs.append((_submit(wire, nm, True, None), wire.shape, cctx))
-        return [tf.constant(compression.decompress(
-                    _handles.wait_and_clear(h.id).reshape(shape), cctx))
-                for h, shape, cctx in subs]
+        try:
+            for nm, t in zip(names, tensors):
+                wire, cctx = compression.compress(t.numpy())
+                subs.append((_submit(wire, nm, True, None), wire.shape,
+                             cctx))
+            return [tf.constant(compression.decompress(
+                        _handles.wait_and_clear(h.id).reshape(shape),
+                        cctx))
+                    for h, shape, cctx in subs]
+        except Exception:
+            # a mid-batch failure (submit, wait, or decompress) must not
+            # strand the sibling handles: each holds a gradient-sized
+            # result buffer in _handles for the life of the process
+            # (the MetricAverageCallback leak class, fixed the same way)
+            for h, _, _ in subs:
+                _handles.discard(h.id)
+            raise
 
     results = tf.py_function(_op, [t for _, t in named],
                              Tout=[t.dtype for _, t in named])

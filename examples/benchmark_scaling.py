@@ -38,9 +38,9 @@ if os.environ.get("BM_CPU"):  # distinct-core pinning (multi-core hosts)
         os.sched_setaffinity(0, {int(os.environ["BM_CPU"])})
     except OSError:
         pass
+from byteps_tpu.utils.jax_compat import force_cpu
+force_cpu(int(os.environ["BM_DEVICES"]))
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ["BM_DEVICES"]))
 import numpy as np
 import jax.numpy as jnp
 import optax

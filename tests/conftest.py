@@ -23,7 +23,14 @@ import pytest  # noqa: E402
 # platform (env vars are latched at jax import time, so config.update is the
 # only reliable override).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.5 jax: XLA_FLAGS above already forced 8
+    pass
+
+from byteps_tpu.utils import jax_compat  # noqa: E402
+
+jax_compat.ensure()
 
 
 @pytest.fixture(scope="session")

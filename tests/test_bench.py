@@ -67,6 +67,9 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
             return {"pushpull_throttled_1srv_gbps": 0.1,
                     "pushpull_throttled_2srv_gbps": 0.2,
                     "throttle_mbps": 100.0}, None
+        if name == "arena_ab":
+            return {"arena_on_step_ms": 5.0,
+                    "arena_off_step_ms": 6.5}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
@@ -74,6 +77,7 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     out, calls = run_main(bench, monkeypatch, capsys, script)
     assert out["value"] == 100000.0
     assert out["pushpull_throttled_2srv_gbps"] == 0.2
+    assert out["arena_on_step_ms"] == 5.0
     assert out["vs_baseline"] == round(100000.0 / 51810.0, 4)
     assert out["pushpull_onebit_tpu_gbps"] == 9.0
     assert "phase_errors" not in out
@@ -99,6 +103,9 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"pushpull_throttled_1srv_gbps": 0.1,
                     "pushpull_throttled_2srv_gbps": 0.2,
                     "throttle_mbps": 100.0}, None
+        if name == "arena_ab":
+            return {"arena_on_step_ms": 5.0,
+                    "arena_off_step_ms": 6.5}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
@@ -116,11 +123,11 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    assert calls.count("probe") == 5 + n_final
+    assert calls.count("probe") == 6 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull", "after_pushpull_2srv",
-        "after_pushpull_throttled", "after_scaling",
+        "after_pushpull_throttled", "after_arena_ab", "after_scaling",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
     assert any(str(d.get("at", "")).startswith("final_wait")
@@ -221,3 +228,56 @@ def test_cpu_fallback_platform_rejected(bench, monkeypatch, capsys):
     out, _ = run_main(bench, monkeypatch, capsys, script)
     assert out["value"] is None
     assert "cpu" in out["phase_errors"]["probe"]
+
+
+def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
+                                                 capsys):
+    """Round-5 envelope bug regression: with no budget left, NO phase
+    may launch (previously the CPU phases ran to their full deadlines
+    regardless), and the final JSON line still parses with the skips
+    recorded."""
+    monkeypatch.setenv("BENCH_BUDGET_S", "1")
+
+    def script(name, calls):
+        raise AssertionError(f"phase {name!r} launched on a spent budget")
+
+    out, calls = run_main(bench, monkeypatch, capsys, script)
+    assert calls == []
+    assert out["value"] is None
+    skipped = {k: v for k, v in out["phase_errors"].items()
+               if v == "skipped-budget"}
+    assert set(skipped) == {"pushpull", "pushpull_2srv",
+                            "pushpull_throttled", "arena_ab", "scaling"}
+
+
+def test_partial_snapshots_survive_a_kill(bench, monkeypatch, capsys):
+    """Every phase flushes the current snapshot as a 'partial'-tagged
+    JSON line: an external SIGKILL at ANY point between phases leaves
+    the last snapshot as the final parseable line (round 5 lost all its
+    numbers to the single end-of-run print)."""
+    def script(name, calls):
+        if name == "probe":
+            return {"ok": True, "platform": "tpu"}, None
+        if name == "train":
+            return {"value": 90000.0, "mfu": 0.38,
+                    "train_variant": "remat"}, None
+        if name == "pushpull_tpu":
+            return {"pushpull_dense_tpu_gbps": 4.0}, None
+        if name == "pushpull":
+            return {"pushpull_dense_gbps": 3.0}, None
+        return {}, None
+
+    calls2 = []
+    monkeypatch.setattr(bench, "_run_phase",
+                        lambda n, t: (script(n, calls2),
+                                      calls2.append(n))[0])
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) > 2
+    assert lines[-1].get("partial") is None          # final: untagged
+    assert all(ln.get("partial") for ln in lines[:-1])
+    # snapshots accumulate: the headline already rides a mid-run line
+    assert any(ln.get("value") == 90000.0 for ln in lines[:-1])

@@ -99,8 +99,8 @@ def test_bert_trains_over_ps_compressed(ps_env):
 def test_benchmark_bert_smoke():
     """examples/benchmark.py --model bert runs end-to-end (the
     reference-format synthetic throughput vehicle)."""
-    pin = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-           "jax.config.update('jax_num_cpu_devices', 8); "
+    pin = ("from byteps_tpu.utils.jax_compat import force_cpu; "
+           "force_cpu(8); "
            "import runpy, sys; sys.argv = sys.argv[1:]; "
            "runpy.run_path(sys.argv[0], run_name='__main__')")
     r = subprocess.run(

@@ -13,8 +13,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the axon plugin initializes (and can hang) regardless of JAX_PLATFORMS;
 # config.update is the reliable pin, run before the script. The script
 # path + its args arrive as real argv (no string templating).
-_PIN = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-        "jax.config.update('jax_num_cpu_devices', 8); "
+_PIN = ("from byteps_tpu.utils.jax_compat import force_cpu; force_cpu(8); "
         "import runpy, sys; sys.argv = sys.argv[1:]; "
         "runpy.run_path(sys.argv[0], run_name='__main__')")
 

@@ -73,9 +73,9 @@ def _reap(*procs):
 
 _GLOBAL_MESH = r"""
 import os
+from byteps_tpu.utils.jax_compat import force_cpu
+force_cpu(4)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
 import numpy as np
 import byteps_tpu as bps
 
@@ -118,9 +118,9 @@ def test_global_mesh_two_processes():
 
 _PS_WORKER = r"""
 import os
+from byteps_tpu.utils.jax_compat import force_cpu
+force_cpu(4)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
 import numpy as np
 import byteps_tpu as bps
 

@@ -101,7 +101,13 @@ def test_throttled_servers_scale_bandwidth(monkeypatch):
     servers must take materially LESS wall time than one — the
     min(server bw, worker bw) doubling, core-independent. Generous
     bounds: the 2srv wall must be under 0.75x the 1srv wall (ideal
-    0.5x), and the 1srv wall must be within its cap's predicted range."""
+    0.5x), and the 1srv wall must be within its cap's predicted range.
+
+    Each configuration times BEST-OF-2 rounds (bench.py's _best_of
+    rationale): on a loaded shared host, scheduler jitter hitting the
+    two wall() calls asymmetrically can push a single draw past the
+    0.75x bound — the per-rep spread here has measured >50%; the best
+    round is the capability number the rule speaks about."""
     monkeypatch.setenv("BYTEPS_SERVER_THROTTLE_MBPS", "25")
     x = [np.random.RandomState(i).randn(1 << 19).astype(np.float32)
          for i in range(8)]  # 8 x 2MB keys, placed explicitly below
@@ -137,9 +143,11 @@ def test_throttled_servers_scale_bandwidth(monkeypatch):
                     f.result(timeout=60)
 
         one_round()  # warmup: drains burst credit, init barrier
-        t0 = time.perf_counter()
-        one_round()
-        dt = time.perf_counter() - t0
+        dt = float("inf")
+        for _ in range(2):  # best-of-2: see docstring
+            t0 = time.perf_counter()
+            one_round()
+            dt = min(dt, time.perf_counter() - t0)
         c.close()
         for t in threads:
             t.join(timeout=10)

@@ -11,8 +11,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_PIN = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-        "jax.config.update('jax_num_cpu_devices', 8); ")
+_PIN = ("from byteps_tpu.utils.jax_compat import force_cpu; "
+        "force_cpu(8); ")
 
 
 def _run(body: str, env_extra=None, timeout=420):
