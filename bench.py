@@ -50,6 +50,14 @@ Extra keys in the same line:
   (BYTEPS_STAGING_ARENA, core/arena.py) on vs off, plus the arena
   counters (allocs avoided / bytes pinned / conflicts) proving the
   zero-allocation steady state.
+- ``stream_on_step_ms`` / ``stream_off_step_ms`` and
+  ``stream_ttfp_on_ms`` / ``stream_ttfp_off_ms`` — the
+  COMPUTE/PUSH/UPDATE pipeline A/B (BYTEPS_STREAM_EXPORT +
+  BYTEPS_SHARDED_APPLY, jax/train.py): steady-state PS train step wall
+  and time-to-first-push with streamed gradient export + per-leaf
+  sharded optimizer apply on vs off; streaming must show a strictly
+  earlier first push (the tap fires mid-backward), with the export
+  counters proving the overlap engaged.
 
 The train phase A/Bs four variants per capture — remat, selective
 remat, chunked-vocab xent, and a hand-fused adam (one elementwise
@@ -178,6 +186,19 @@ def _force_cpu():
 
     jax.config.update("jax_platforms", "cpu")
     return jax
+
+
+def _cpu_put(x):
+    """Commit a phase input explicitly to cpu:0. A bare jnp.ones/asarray
+    inherits whatever backend jax last defaulted to — and a
+    half-initialized tunnel backend leaking into a CPU-forced phase then
+    crashes pjit lowering in _get_and_check_device_assignment with
+    arrays committed to different backends (BENCH_r05's tail). Explicit
+    placement makes a CPU phase immune to the tunnel's state by
+    construction."""
+    import jax
+
+    return jax.device_put(x, jax.devices("cpu")[0])
 
 
 def phase_probe() -> dict:
@@ -523,13 +544,14 @@ def phase_arena_ab(steps: int = 6) -> dict:
 
             rng = np.random.RandomState(0)
             # mixed sizes on purpose: 4MB leaves ride their own keys,
-            # sub-fusion leaves exercise the fused-bucket slot
-            params = {f"w{i}": jnp.asarray(
+            # sub-fusion leaves exercise the fused-bucket slot.
+            # _cpu_put: explicit cpu:0 placement (see its docstring)
+            params = {f"w{i}": _cpu_put(
                 rng.randn(1024, 1024).astype(np.float32))
                 for i in range(4)}
-            params.update({f"b{i}": jnp.asarray(
+            params.update({f"b{i}": _cpu_put(
                 rng.randn(1024).astype(np.float32)) for i in range(4)})
-            batch = jnp.asarray(rng.randn(32, 1024).astype(np.float32))
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
 
             def loss_fn(p, b):
                 h = b
@@ -566,6 +588,119 @@ def phase_arena_ab(steps: int = 6) -> dict:
             "arena_allocs_avoided": stats["allocs_avoided"],
             "arena_bytes_pinned": stats["bytes_pinned"],
             "arena_checkout_conflicts": stats["checkout_conflicts"]}
+
+
+def phase_stream_ab(steps: int = 6, reps: int = 4,
+                    throttle_mbps: float = 400.0) -> dict:
+    """A/B the COMPUTE/PUSH/UPDATE pipeline (BYTEPS_STREAM_EXPORT +
+    BYTEPS_SHARDED_APPLY, jax/train.py) on the PS train step: the same
+    model/batch trained through the loopback PS with both knobs on vs
+    both off, reporting best-of step wall AND time-to-first-push for
+    each arm. Streaming submits each large gradient leaf to the
+    scheduler the moment XLA produces it (the tap fires mid-backward),
+    so ``ttfp_on_ms`` must be strictly earlier than ``ttfp_off_ms``
+    (where the first submit waits for the whole backward + D2H); the
+    sharded apply then issues per-leaf updates from the
+    completion-ordered drain, removing the end-of-step barrier. The
+    export counters prove the overlap engaged rather than silently
+    falling back. Host-CPU only.
+
+    The server runs under BYTEPS_SERVER_THROTTLE_MBPS — the same
+    CORE-INDEPENDENT trick as phase_pushpull_throttled: on a loopback
+    host the "wire" is CPU work, so un-throttled COMPUTE/PUSH overlap
+    merely time-slices the same cores and the step wall cannot improve
+    (measured: concurrent comm stretched the backward 140→343ms).
+    The throttle's token bucket SLEEPS the serving thread, making wire
+    time a genuinely non-CPU resource like a bandwidth-bound DCN —
+    which is the deployment the pipeline exists for — so the A/B
+    measures overlap capacity, not core contention."""
+    import gc
+
+    def run(enabled: bool, shared: dict):
+        val = "1" if enabled else "0"
+        os.environ["BYTEPS_STREAM_EXPORT"] = val
+        os.environ["BYTEPS_SHARDED_APPLY"] = val
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # large leaves on purpose: every w rides its own key above
+            # the fusion threshold, so streaming is eligible; biases
+            # keep the bucket path honest in the same round
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1280, 1280).astype(np.float32))
+                for i in range(6)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1280).astype(np.float32)) for i in range(6)})
+            # batch sized so XLA SPREADS the weight-gradient matmuls
+            # across the backward schedule (measured: at this size the
+            # six dw matmuls produce at ~1/6 intervals, so the taps
+            # fire mid-backward; at much larger batches XLA parks all
+            # dw matmuls at the end of the thunk sequence and there is
+            # nothing to overlap — production order is the compiler's
+            # choice, which is exactly why the scheduler measures it)
+            batch = _cpu_put(rng.randn(32, 1280).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(6):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.adam(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                shared["walls"].append(time.perf_counter() - t0)
+                s = bps.get_arena_stats()
+                if s.get("export_ttfp_ms") is not None:
+                    shared["ttfps"].append(s["export_ttfp_ms"])
+            shared["stats"] = bps.get_arena_stats()
+
+    saved = {k: os.environ.get(k) for k in ("BYTEPS_STREAM_EXPORT",
+                                            "BYTEPS_SHARDED_APPLY",
+                                            "BYTEPS_SERVER_THROTTLE_MBPS")}
+    os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle_mbps)
+    # INTERLEAVED reps (the phase_scaling lesson): host-load drift on a
+    # shared box otherwise lands on one arm only and decides the A/B;
+    # best-of over all reps per arm is the capability number
+    on = {"walls": [], "ttfps": [], "stats": None}
+    off = {"walls": [], "ttfps": [], "stats": None}
+    try:
+        for _ in range(reps):
+            run(True, on)
+            run(False, off)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    on_ms = min(on["walls"]) * 1e3
+    off_ms = min(off["walls"]) * 1e3
+    ttfp_on = min(on["ttfps"]) if on["ttfps"] else None
+    ttfp_off = min(off["ttfps"]) if off["ttfps"] else None
+    stats = on["stats"]
+    return {"stream_on_step_ms": round(on_ms, 2),
+            "stream_off_step_ms": round(off_ms, 2),
+            "stream_ttfp_on_ms": round(ttfp_on, 2)
+            if ttfp_on is not None else None,
+            "stream_ttfp_off_ms": round(ttfp_off, 2)
+            if ttfp_off is not None else None,
+            "stream_streamed_leaves": stats["export_streamed_leaves"],
+            "stream_fallback_leaves": stats["export_fallback_leaves"]}
 
 
 def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
@@ -802,6 +937,7 @@ _PHASES = {
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
     "arena_ab": phase_arena_ab,
+    "stream_ab": phase_stream_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
 }
@@ -905,6 +1041,10 @@ def main() -> None:
         "pushpull_throttled_2srv_gbps": None,
         "arena_on_step_ms": None,
         "arena_off_step_ms": None,
+        "stream_on_step_ms": None,
+        "stream_off_step_ms": None,
+        "stream_ttfp_on_ms": None,
+        "stream_ttfp_off_ms": None,
         "scaling_efficiency_2w": None,
     }
     errors = {}
@@ -1043,6 +1183,10 @@ def main() -> None:
                             # staging-arena A/B: two short loopback
                             # train runs (arena on vs off)
                             ("arena_ab", 240.0),
+                            # COMPUTE/PUSH/UPDATE pipeline A/B: stream
+                            # export + sharded apply on vs off, step
+                            # wall + time-to-first-push
+                            ("stream_ab", 240.0),
                             # scaling deadline sized for 6 server+worker
                             # launches (3 interleaved 1w/2w reps,
                             # 200-step windows, best-of-3 per config)

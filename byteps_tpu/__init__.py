@@ -93,9 +93,17 @@ def get_pushpull_speed() -> tuple:
 
 def get_arena_stats() -> dict:
     """Host staging arena counters (core/arena.py): slots live, bytes
-    pinned, allocations avoided, checkout conflicts, fresh fallbacks.
-    The steady-state PS train step should show ``allocs_avoided``
-    growing and ``slot_allocs`` flat after warmup."""
+    pinned, allocations avoided, checkout conflicts, fresh fallbacks —
+    plus the streamed-export stage counters (jax/train.py):
+    ``export_streamed_leaves`` / ``export_fallback_leaves`` (gradient
+    leaves that left the backward via io_callback taps vs the post-jit
+    loop), ``export_checkouts`` (arena leases serving the export
+    stage), and ``export_ttfp_ms`` (the last round's time-to-first-
+    push). The steady-state PS train step should show
+    ``allocs_avoided`` growing and ``slot_allocs`` flat after warmup;
+    with BYTEPS_STREAM_EXPORT on and leaves above the fusion
+    threshold, ``export_streamed_leaves`` growing proves the
+    COMPUTE/PUSH overlap engaged rather than silently falling back."""
     return get_state().telemetry.arena_stats()
 
 
@@ -175,8 +183,12 @@ def push_pull_async(tensor, name: str, average: bool = True,
 
     Requires the DCN PS (num_servers > 0). The input is the local (host)
     value; the result (sum or mean across workers) is retrieved with
-    ``synchronize(handle)``. ``priority=None`` schedules in layer order
-    (earlier-declared first); an explicit value overrides (higher = sooner).
+    ``synchronize(handle)``. ``priority=None`` follows the key's pinned
+    priority — the layer-order default -declared_key, unless the key was
+    first exported by the streamed train step, which pins its measured
+    production-order priority. An explicit value overrides on FIRST
+    submission only (higher = sooner); later differing values warn once
+    and are ignored (the cross-round reorder guard).
     ``out``: optional preallocated flat result buffer (host staging
     arena) — the caller must not recycle it before the handle resolves.
     """

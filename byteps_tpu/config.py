@@ -87,6 +87,30 @@ class Config:
     # round (the pre-arena behavior; numerics identical). ---
     staging_arena: bool = True            # BYTEPS_STAGING_ARENA
 
+    # --- streamed gradient export (rebuild addition; the reference's
+    # COMPUTE/PUSH overlap: gradients of the last layers enter PUSH while
+    # earlier layers are still in backprop, core_loops.cc + the priority
+    # scheduler's "last layer first"). On: the PS train step taps each
+    # eligible gradient leaf inside the compiled backward with
+    # jax.experimental.io_callback, so its PUSH is submitted the moment
+    # XLA produces it instead of after the whole backward; each key's
+    # priority is pinned from measured production order. Off (or when
+    # callbacks are unavailable / the leaf is device-compressed,
+    # rowsparse or bucket-fused): the post-jit copy_to_host_async loop
+    # (the pre-stream behavior; numerics identical). ---
+    stream_export: bool = True            # BYTEPS_STREAM_EXPORT
+
+    # --- sharded optimizer apply (rebuild addition; PAPERS.md "Automatic
+    # Cross-Replica Sharding of Weight Update": the weight update
+    # decomposes per-shard). On: the PS train step's monolithic apply jit
+    # is split into per-leaf jitted partial updates (jax/optim.py
+    # make_sharded_apply) issued from the completion-ordered drain, so
+    # UPDATE(k) overlaps PULL(k+1); transforms that are not per-leaf
+    # separable (global-norm clipping etc.) are detected and fall back
+    # to the fused apply. Off: one fused apply jit after the last pull
+    # (the pre-split behavior; numerics identical). ---
+    sharded_apply: bool = True            # BYTEPS_SHARDED_APPLY
+
     # --- gradient bucket fusion (rebuild addition; the reference only
     # SPLITS large tensors at partition_bytes — small-tensor fusion is
     # the inverse cure for the same disease: per-key round-trip overhead
@@ -149,6 +173,8 @@ class Config:
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES",
                                         DEFAULT_MIN_COMPRESS_BYTES),
             staging_arena=_env_bool("BYTEPS_STAGING_ARENA", True),
+            stream_export=_env_bool("BYTEPS_STREAM_EXPORT", True),
+            sharded_apply=_env_bool("BYTEPS_SHARDED_APPLY", True),
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

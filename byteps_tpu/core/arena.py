@@ -122,19 +122,32 @@ class StagingArena:
         self._conflicts = 0         # slot busy -> fresh fallback
         self._fresh = 0             # untracked allocations handed out
         self._resizes = 0           # slot dropped for a size change
+        # per-stage checkout counters (tag="export": the streamed-export
+        # round's result-slot leases, jax/train.py) — proves which pipeline
+        # stage the staged bytes serve
+        self._tag_checkouts: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ #
 
-    def checkout(self, key: str, nbytes: int) -> ArenaLease:
+    def checkout(self, key: str, nbytes: int,
+                 tag: Optional[str] = None) -> ArenaLease:
         """Lease the persistent slot for ``key`` (allocating it on first
         use), or a fresh untracked buffer when the arena is disabled or
-        the slot is still leased (conflict)."""
+        the slot is still leased (conflict). ``tag`` attributes the
+        checkout to a pipeline stage in ``stats()`` (e.g. "export" for
+        the streamed-export round's result slots)."""
         nbytes = int(nbytes)
         if not self.enabled:
             with self._mu:
                 self._fresh += 1
+                if tag is not None:
+                    self._tag_checkouts[tag] = \
+                        self._tag_checkouts.get(tag, 0) + 1
             return ArenaLease(self, key, _aligned_empty(nbytes), fresh=True)
         with self._mu:
+            if tag is not None:
+                self._tag_checkouts[tag] = \
+                    self._tag_checkouts.get(tag, 0) + 1
             slot = self._slots.get(key)
             if slot is not None and slot.busy:
                 self._conflicts += 1
@@ -202,4 +215,5 @@ class StagingArena:
                 "checkout_conflicts": self._conflicts,
                 "fresh_allocs": self._fresh,
                 "resizes": self._resizes,
+                "export_checkouts": self._tag_checkouts.get("export", 0),
             }

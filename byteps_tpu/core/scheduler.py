@@ -370,9 +370,39 @@ class PipelineScheduler:
         self._prio_mu = threading.Lock()
         self._key_priority: Dict[int, int] = {}
         self._prio_warned: set = set()
+        # measured production order (see production_priority): the n-th
+        # key to first cross the export boundary gets ordinal n
+        self._export_ordinal = 0
+        self._export_order: Dict[int, int] = {}
         self._dispatcher = threading.Thread(
             target=self._dispatch, name="bps-sched-dispatch", daemon=True)
         self._dispatcher.start()
+
+    def production_priority(self, ctx: TensorContext) -> int:
+        """Priority from MEASURED production order: the n-th distinct key
+        to first cross the export boundary gets ordinal n and priority
+        ``-n``, so the first gradient XLA actually produces is served
+        first. The reference ASSUMES "last layer first" via the static
+        -declared_key convention (tensorflow/ops.cc:155-158); the
+        streamed-export tap calls this instead, so last-produced ≠
+        last-served whenever XLA's schedule disagrees with flatten
+        order. The assignment pins the key's priority (see
+        _pin_priority) — later submissions of the same key, streamed or
+        not, reuse it, keeping cross-round admission order stable."""
+        with self._prio_mu:
+            pr = self._key_priority.get(ctx.declared_key)
+            if pr is None:
+                o = self._export_ordinal
+                self._export_ordinal += 1
+                self._export_order[ctx.declared_key] = o
+                pr = self._key_priority[ctx.declared_key] = -o
+            return pr
+
+    def export_order(self) -> Dict[int, int]:
+        """declared_key -> first-export ordinal snapshot (telemetry /
+        tests: proves priorities came from production order)."""
+        with self._prio_mu:
+            return dict(self._export_order)
 
     def _pin_priority(self, ctx: TensorContext,
                       priority: Optional[int]) -> int:
@@ -383,15 +413,22 @@ class PipelineScheduler:
         positionally per worker per key, so the swap would silently sum
         round N+1's payload into round N across workers. The reference's
         priority is static per key by construction (-declared_key,
-        tensorflow/ops.cc:155-158); an explicit per-call value sticks on
-        first use, and later differing values warn and are ignored
-        (same guard server/compressed.py applies to compressed rounds)."""
-        if priority is None:
-            priority = -ctx.declared_key
+        tensorflow/ops.cc:155-158) and the streamed-export path's is
+        static by the production_priority pin above; an explicit
+        per-call value sticks on first use, and later differing values
+        warn ONCE then are silently ignored (same guard
+        server/compressed.py applies to compressed rounds).
+        ``priority=None`` means "no opinion": it seeds the layer-order
+        default -declared_key only when nothing is pinned yet, and
+        otherwise follows the pin silently — a fallback-path submission
+        of a production-pinned key must not trip the mismatch warning."""
         with self._prio_mu:
-            pinned = self._key_priority.setdefault(ctx.declared_key,
-                                                   priority)
-            warn = (pinned != priority
+            pinned = self._key_priority.get(ctx.declared_key)
+            if pinned is None:
+                pinned = -ctx.declared_key if priority is None else priority
+                self._key_priority[ctx.declared_key] = pinned
+                return pinned
+            warn = (priority is not None and pinned != priority
                     and ctx.declared_key not in self._prio_warned)
             if warn:
                 self._prio_warned.add(ctx.declared_key)

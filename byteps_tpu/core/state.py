@@ -65,12 +65,48 @@ class _Telemetry:
 
     def arena_stats(self) -> dict:
         """Live staging-arena counters (slots live, bytes pinned,
-        allocations avoided, checkout conflicts); zeros before init."""
+        allocations avoided, checkout conflicts) merged with the
+        export-stage counters below; zeros before init."""
         arena = getattr(self, "_arena", None)
         if arena is None:
             from .arena import StagingArena
-            return StagingArena(enabled=False).stats()
-        return arena.stats()
+            stats = StagingArena(enabled=False).stats()
+        else:
+            stats = arena.stats()
+        stats.update(self.export_stats())
+        return stats
+
+    # --- streamed-export stage counters (jax/train.py) ---------------- #
+
+    def record_export(self, streamed: int, fallback: int,
+                      ttfp_s: Optional[float]) -> None:
+        """One PS train round's export accounting: how many gradient
+        leaves were streamed out of the backward by io_callback taps vs
+        served by the post-jit fallback loop, and the round's
+        time-to-first-push (first submit entering the scheduler,
+        measured from the backward's dispatch). Cumulative counters +
+        the last round's TTFP let tests and the bench assert the
+        COMPUTE/PUSH overlap actually engaged instead of silently
+        falling back."""
+        with self._lock:
+            self._export_streamed = \
+                getattr(self, "_export_streamed", 0) + int(streamed)
+            self._export_fallback = \
+                getattr(self, "_export_fallback", 0) + int(fallback)
+            self._export_rounds = getattr(self, "_export_rounds", 0) + 1
+            if ttfp_s is not None:
+                self._export_ttfp_ms = ttfp_s * 1e3
+
+    def export_stats(self) -> dict:
+        with self._lock:
+            return {
+                "export_streamed_leaves": getattr(
+                    self, "_export_streamed", 0),
+                "export_fallback_leaves": getattr(
+                    self, "_export_fallback", 0),
+                "export_rounds": getattr(self, "_export_rounds", 0),
+                "export_ttfp_ms": getattr(self, "_export_ttfp_ms", None),
+            }
 
 
 class GlobalState:

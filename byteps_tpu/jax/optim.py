@@ -1,4 +1,4 @@
-"""Hand-fused optimizer steps for MFU experiments and the bench.
+"""Optimizer-pass machinery: hand-fused steps and the sharded apply.
 
 ``fused_adam_step`` computes mu/nu/bias-correction/param-new in ONE
 elementwise expression per leaf — the best case a fused (XLA- or
@@ -9,12 +9,25 @@ validation lives alongside the A/B in examples/mfu_experiments.py).
 Shared by bench.py's ``fused_adam`` train variant and the MFU harness
 so the validated math exists exactly once.
 
+``make_sharded_apply`` splits an optax transformation into per-leaf
+jitted partial updates for the PS train step's tail overlap
+(BYTEPS_SHARDED_APPLY): UPDATE(k) is issued from the
+completion-ordered drain the moment leaf k's pull lands, overlapping
+PULL(k+1) — the worker-side form of "Automatic Cross-Replica Sharding
+of Weight Update in Data-Parallel Training" (PAPERS.md), where the
+weight update decomposes cleanly per shard. Transforms that are NOT
+per-leaf separable (global-norm clipping, masked/multi-transform
+label trees) are detected by a numeric probe at build time and the
+caller falls back to the fused apply.
+
 Reference context: the reference leaves optimizer fusion to the
 framework (torch fused adam etc.); here it is an A/B lever for the
 "optimizer pass" suspect in docs/performance.md's ceiling analysis.
 """
 
 from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,3 +72,227 @@ def fused_adam_step(loss_fn, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                  "count": c}, loss)
 
     return init, step
+
+
+# --------------------------------------------------------------------- #
+# sharded optimizer apply (BYTEPS_SHARDED_APPLY)
+# --------------------------------------------------------------------- #
+
+
+class ShardedApply:
+    """Per-leaf partial updates over one optax transformation.
+
+    Built by :func:`make_sharded_apply` (which verifies per-leaf
+    separability first — use it, not this constructor). The optimizer
+    state is analysed once into nodes that mirror the params tree
+    ("param nodes": adam's mu/nu, momentum traces — sliced per leaf)
+    and nodes that don't (shared scalars like adam's count — passed to
+    every leaf update, never donated, identical across leaves by
+    separability). ``apply_leaf`` runs the whole transform chain on a
+    single leaf with the param and its param-node state slices donated;
+    ``merge`` reassembles the full optimizer state from the per-leaf
+    results.
+    """
+
+    def __init__(self, tx, params_treedef, state_top_treedef,
+                 node_kinds: List[bool], donate: bool = True):
+        self._ptd = params_treedef
+        self._std = state_top_treedef
+        self._kinds = node_kinds
+
+        def leaf_update(param, param_parts, shared_parts, grad):
+            nodes, pi, si = [], 0, 0
+            for is_param in self._kinds:
+                if is_param:
+                    nodes.append(param_parts[pi])
+                    pi += 1
+                else:
+                    nodes.append(shared_parts[si])
+                    si += 1
+            state_i = jax.tree.unflatten(self._std, nodes)
+            import optax
+            updates, new_state = tx.update(grad, state_i, param)
+            new_param = optax.apply_updates(param, updates)
+            out_nodes = self._std.flatten_up_to(new_state)
+            n_pparts = [n for n, k in zip(out_nodes, self._kinds) if k]
+            n_shared = [n for n, k in zip(out_nodes, self._kinds) if not k]
+            return new_param, n_pparts, n_shared
+
+        # donate the param and its param-node state slices (per-leaf
+        # buffers); shared scalars are read by EVERY leaf update, so
+        # donating them would hand leaf 0 the buffer leaf 1 still needs
+        self._jit = jax.jit(leaf_update,
+                            donate_argnums=(0, 1) if donate else ())
+
+    # -- state plumbing ------------------------------------------------ #
+
+    def begin(self, opt_state) -> "_ShardedRound":
+        """Pre-flatten the state ONCE for a whole round of per-leaf
+        applies. ``apply_leaf`` below re-flattens per call — O(leaves²)
+        per step for the drain's hot loop — so the train step's
+        completion-ordered drain goes through a round instead."""
+        return _ShardedRound(self, opt_state)
+
+    def slice_leaf(self, opt_state, i: int) -> Tuple[list, list]:
+        """(param_parts, shared_parts) views of ``opt_state`` for params
+        leaf ``i`` — no copies, just tree surgery."""
+        return _ShardedRound(self, opt_state).slice(i)
+
+    def apply_leaf(self, param_leaf, opt_state, i: int, grad_leaf):
+        """One leaf's full update chain: returns
+        ``(new_param_leaf, (param_parts_i, shared_parts_i))``. Issue it
+        the moment leaf ``i``'s gradient lands; jax dispatch is async,
+        so the update computes while later pulls are still in flight.
+        Convenience form (re-flattens the state per call) — hot loops
+        use ``begin(opt_state)`` + ``round.apply``."""
+        return _ShardedRound(self, opt_state).apply(param_leaf, i,
+                                                    grad_leaf)
+
+    def merge(self, opt_state_template, results: List[Tuple[list, list]]):
+        """Reassemble the full optimizer state from every leaf's
+        ``(param_parts, shared_parts)``. ``opt_state_template`` supplies
+        only the tree STRUCTURE (its buffers may already be donated).
+        Shared nodes are taken from leaf 0 — separability (verified at
+        build) means every leaf computed the same value."""
+        nodes, pi, si = [], 0, 0
+        for is_param in self._kinds:
+            if is_param:
+                nodes.append(jax.tree.unflatten(
+                    self._ptd, [r[0][pi] for r in results]))
+                pi += 1
+            else:
+                nodes.append(results[0][1][si])
+                si += 1
+        return jax.tree.unflatten(self._std, nodes)
+
+
+class _ShardedRound:
+    """One round's pre-flattened view of the optimizer state: the
+    param-shaped nodes' leaf lists and the shared scalars, computed
+    once, indexed per leaf — the drain's per-leaf work drops from
+    O(leaves) tree traversal to O(param nodes) list indexing."""
+
+    __slots__ = ("_sa", "_pnode_leaves", "_shared")
+
+    def __init__(self, sa: ShardedApply, opt_state):
+        nodes = sa._std.flatten_up_to(opt_state)
+        self._sa = sa
+        self._pnode_leaves = [jax.tree.leaves(nd)
+                              for nd, k in zip(nodes, sa._kinds) if k]
+        self._shared = [nd for nd, k in zip(nodes, sa._kinds) if not k]
+
+    def slice(self, i: int) -> Tuple[list, list]:
+        return [pl[i] for pl in self._pnode_leaves], list(self._shared)
+
+    def apply(self, param_leaf, i: int, grad_leaf):
+        pparts, shared = self.slice(i)
+        new_p, n_pparts, n_shared = self._sa._jit(param_leaf, pparts,
+                                                  shared, grad_leaf)
+        return new_p, (n_pparts, n_shared)
+
+
+def _probe_separable(tx, params_treedef) -> bool:
+    """Numeric separability probe on tiny surrogate params sharing the
+    real tree structure: the fused ``tx.update`` restricted to each leaf
+    must equal the per-leaf update built from sliced state. Global-norm
+    clipping, masked label trees and friends either mismatch or raise —
+    both mean "not separable"."""
+    import numpy as np
+    import optax
+
+    n = params_treedef.num_leaves
+    rng = np.random.RandomState(0)
+    pp = jax.tree.unflatten(params_treedef, [
+        jnp.asarray(rng.randn(2, 3).astype(np.float32)) for _ in range(n)])
+    gg = jax.tree.unflatten(params_treedef, [
+        jnp.asarray(rng.randn(2, 3).astype(np.float32)) for _ in range(n)])
+    state0 = tx.init(pp)
+    fused_u, fused_s = tx.update(gg, state0, pp)
+    std, kinds = _analyze_state(state0, params_treedef)
+    if std is None:
+        return False
+    sa = ShardedApply(tx, params_treedef, std, kinds, donate=False)
+    p_leaves = jax.tree.leaves(pp)
+    g_leaves = jax.tree.leaves(gg)
+    fu_leaves = jax.tree.leaves(
+        jax.tree.map(optax.apply_updates, pp, fused_u))
+    results = []
+    for i in range(n):
+        new_p, parts = sa.apply_leaf(p_leaves[i], state0, i, g_leaves[i])
+        if not np.allclose(np.asarray(new_p), np.asarray(fu_leaves[i]),
+                           rtol=1e-6, atol=1e-7):
+            return False
+        results.append(parts)
+    merged = sa.merge(state0, results)
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(fused_s)):
+        if np.asarray(a).shape != np.asarray(b).shape or \
+                not np.allclose(np.asarray(a), np.asarray(b),
+                                rtol=1e-6, atol=1e-7):
+            return False
+    return True
+
+
+def _analyze_state(opt_state, params_treedef):
+    """Split the state's top-level nodes into params-shaped trees vs
+    shared leaves. Returns (top_treedef, kinds) or (None, None) when the
+    layout can't be decomposed (a node partially overlaps the params
+    structure)."""
+    def is_param_node(x):
+        try:
+            return jax.tree.structure(x) == params_treedef
+        except Exception:  # noqa: BLE001 - unflattenable exotic node
+            return False
+
+    try:
+        top = jax.tree.structure(opt_state, is_leaf=is_param_node)
+        nodes = top.flatten_up_to(opt_state)
+    except Exception:  # noqa: BLE001
+        return None, None
+    kinds = [is_param_node(nd) for nd in nodes]
+    # a non-param node containing arrays the size of params would be
+    # silently shared (wrong); require non-param nodes to be single
+    # leaves (scalar counts, hyperparams), not containers
+    for nd, k in zip(nodes, kinds):
+        if not k and jax.tree.structure(nd).num_leaves not in (0, 1):
+            return None, None
+    return top, kinds
+
+
+def make_sharded_apply(tx, params, opt_state,
+                       donate: bool = True) -> Optional[ShardedApply]:
+    """Build per-leaf partial updates for ``tx``, or return None when
+    the transform chain is not per-leaf separable (the caller then keeps
+    the fused apply).
+
+    ``params`` / ``opt_state`` fix the REAL tree structures (the probe
+    itself runs on tiny surrogates, so a large model costs nothing to
+    verify). Separability is verified numerically, not assumed from the
+    transform names: anything whose update mixes leaves — global-norm
+    clipping, cross-leaf masking — fails the probe and falls back.
+    """
+    params_treedef = jax.tree.structure(params)
+    std, kinds = _analyze_state(opt_state, params_treedef)
+    if std is None:
+        return None
+    try:
+        if not _probe_separable(tx, params_treedef):
+            return None
+    except Exception:  # noqa: BLE001 - probe failures mean "fused"
+        return None
+    # structural round-trip on the REAL state: slice + merge must
+    # reproduce it exactly (guards probe/real structure divergence,
+    # e.g. shape-dependent factored states)
+    try:
+        sa = ShardedApply(tx, params_treedef, std, kinds, donate=donate)
+        n = params_treedef.num_leaves
+        results = [sa.slice_leaf(opt_state, i) for i in range(n)]
+        merged = sa.merge(opt_state, results)
+        if jax.tree.structure(merged) != jax.tree.structure(opt_state):
+            return None
+        for a, b in zip(jax.tree.leaves(merged),
+                        jax.tree.leaves(opt_state)):
+            if a is not b:
+                return None
+    except Exception:  # noqa: BLE001
+        return None
+    return sa

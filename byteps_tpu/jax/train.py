@@ -20,10 +20,12 @@ Two flavors:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -161,7 +163,177 @@ def make_zero_train_step(
 
 
 _COMP_POOL = None
+_EXPORT_POOL = None
 _rowsparse_warned: set = set()  # names warned about dense fallback
+_stream_build_warned: list = []  # once-only streamed-export build warning
+
+
+def _export_pool():
+    """The ONE stream-export worker. The io_callback tap itself only
+    enqueues here: a callback arg is a lazy jax.Array whose
+    materialization needs the very executor running the tapped program
+    — touching it on the callback (= device) thread self-deadlocks the
+    step at the next collective. This thread materializes and submits
+    OFF the device threads; a single worker also means ingests run in
+    fire order, so production-order priority assignment is measured
+    from the real schedule."""
+    global _EXPORT_POOL
+    if _EXPORT_POOL is None:
+        import concurrent.futures
+        _EXPORT_POOL = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="bps-export")
+    return _EXPORT_POOL
+
+
+_RELEASE_POOL = None
+
+
+def _release_pool():
+    """Deferred arena-release worker, deliberately SEPARATE from the
+    export worker: its tasks block on import readiness, and queueing
+    them on the export FIFO would stall the next round's streamed
+    ingests (and the error path's quiesce sentinel) behind the previous
+    round's import tail."""
+    global _RELEASE_POOL
+    if _RELEASE_POOL is None:
+        import concurrent.futures
+        _RELEASE_POOL = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="bps-release")
+    return _RELEASE_POOL
+
+
+def _disable_stream(stream_state: dict, msg: str, *args) -> None:
+    """Latch the streamed-export fallback for this step closure and warn
+    once per process — shared by the build-failure, dispatch-failure and
+    taps-never-fired paths so the latch semantics cannot drift."""
+    stream_state["disabled"] = True
+    stream_state["fn"] = None
+    if not _stream_build_warned:
+        from ..utils.logging import log
+        _stream_build_warned.append(True)
+        log.warning(msg, *args)
+
+
+class _StreamRound:
+    """One PS train step's streamed-export state (BYTEPS_STREAM_EXPORT).
+
+    The io_callback taps planted on each eligible gradient leaf inside
+    the compiled backward fire while XLA is still producing later
+    gradients; each fire is enqueued (never executed — see
+    ``_export_pool``) to the export worker, whose ingest:
+
+    - drops stale fires from an earlier round via the step tag threaded
+      through the program, and dedups (shard_map fires the tap once per
+      mesh device — the post-psum value is identical on every device,
+      so the first fire wins);
+    - materializes the payload as a host view whose base keeps the
+      buffer alive through the asynchronous PUSH stage (no staging
+      copy), with the round's result slot leased from the arena under
+      the export tag;
+    - submits it straight into the PipelineScheduler at
+      production-order priority (scheduler.production_priority), so
+      "last layer first" is measured, not assumed;
+    - publishes the waiter for the step's completion-ordered drain.
+
+    The main thread ``claim``s each eligible leaf: normally that just
+    collects the ingest's waiter; if it hasn't fired within the
+    timeout (callbacks broken at runtime), the leaf is claimed for the
+    post-jit fallback loop and a late ingest is ignored — double
+    submit is impossible by construction.
+    """
+
+    def __init__(self, tag: int, names, submit_streamed, mark_first_push):
+        self.tag = tag
+        self._names = names
+        self._submit = submit_streamed  # (name, flat) -> (finish, notifier)
+        self._mark = mark_first_push
+        self._mu = threading.Lock()
+        self._events: Dict[int, threading.Event] = {}
+        self._waiters: Dict[int, tuple] = {}
+        self._errors: Dict[int, BaseException] = {}
+        self._claimed: set = set()
+        self._done: set = set()
+        self.streamed = 0
+        self.broken = False  # a final claim timed out: callbacks dead
+        self.dead = False    # cancelled: late ingests must no-op
+
+    def expect(self, i: int) -> None:
+        self._events[i] = threading.Event()
+
+    def on_leaf(self, i: int, step_no: int, arr) -> None:
+        """Ingest — runs on the export worker; free to block (the
+        materialization below waits until XLA has the leaf's buffer),
+        but must never raise."""
+        if self.dead or step_no != self.tag:
+            return  # cancelled round / stale fire from an earlier round
+        ev = self._events.get(i)
+        if ev is None:
+            return
+        with self._mu:
+            if i in self._done or i in self._claimed:
+                return
+            self._done.add(i)
+        try:
+            host = np.asarray(arr)  # materialize off the device threads
+            if self.dead:  # cancelled while materializing: no submit
+                return
+            self._mark()
+            w = self._submit(self._names[i], host.reshape(-1))
+            with self._mu:
+                self._waiters[i] = w
+            self.streamed += 1
+        except BaseException as e:  # noqa: BLE001 - surfaced via claim()
+            self._errors[i] = e
+        finally:
+            ev.set()
+
+    def cancel(self) -> None:
+        """Error-path quiesce: mark the round dead (any ingest that
+        starts from now no-ops) and drain the single-FIFO export worker
+        so an ingest already in flight — which may be checking out an
+        arena lease and allocating a handle — finishes BEFORE the
+        caller's abandon/discard cleanup runs. Without this, a late
+        submit after cleanup leaks a permanently-busy slot and a
+        gradient-sized handle entry (and, on the dispatch-fallback
+        path, hands a stale-pull-targeted lease to the live round)."""
+        self.dead = True
+        try:
+            _export_pool().submit(lambda: None).result(timeout=120)
+        except Exception:  # noqa: BLE001 - quiesce is best-effort
+            from ..utils.logging import log
+            log.warning("stream-export worker did not quiesce in time; "
+                        "a late ingest may leak one staging slot")
+
+    def claim(self, i: int, timeout: float, final: bool):
+        """Collect leaf ``i``'s waiter, or None when the ingest hasn't
+        fired within ``timeout``. ``final=False`` just peeks (the loop
+        then blocks on the leaf itself, surfacing a compute error
+        promptly instead of stalling here); ``final=True`` claims the
+        leaf for the synchronous fallback on timeout — a late ingest is
+        then ignored — and latches ``broken`` so the round's remaining
+        leaves skip straight to the fallback."""
+        if self.broken:
+            timeout = 0.0
+        ev = self._events[i]
+        if not ev.wait(timeout):
+            if not final:
+                return None
+            with self._mu:
+                if i not in self._done:
+                    self._claimed.add(i)
+                    self.broken = True
+                    return None
+            ev.wait()  # fire won the race; submission completes shortly
+        err = self._errors.get(i)
+        if err is not None:
+            raise err
+        return self._waiters[i]
+
+    def handles(self):
+        """Handles of every streamed submission (error-path discard)."""
+        with self._mu:
+            return [n for _, n in self._waiters.values()
+                    if hasattr(n, "id")]
 
 
 def _comp_pool():
@@ -252,14 +424,46 @@ def make_ps_train_step(
     min_compress_bytes: Optional[int] = None,
     rowsparse_params: Optional[Tuple[str, ...]] = None,
     device_compress: Optional[bool] = None,
+    stream_export: Optional[bool] = None,
+    sharded_apply: Optional[bool] = None,
 ):
-    """Two-phase train step for the DCN PS path — the reference's actual
-    architecture (docs/architecture.md "General Workflow"): the compiled
+    """Three-stage COMPUTE → PUSH → UPDATE train step for the DCN PS
+    path — the reference's actual architecture (docs/architecture.md
+    "General Workflow") with BOTH of its pipeline overlaps: the compiled
     program reduces gradients over the local slice (ICI psum == the NCCL
-    ReduceScatter tier), gradients exit to host, the PS client push_pulls
-    each declared tensor across workers in priority order (the PUSH/PULL
-    stages over DCN), and a second compiled program applies the optimizer
-    update on the worker (servers only sum).
+    ReduceScatter tier); gradients exit to host AS XLA PRODUCES THEM
+    (streamed export: the last layers enter PUSH while earlier layers
+    are still in backprop); the PS client push_pulls each declared
+    tensor across workers in priority order (the PUSH/PULL stages over
+    DCN); and the optimizer update is applied per leaf from the
+    completion-ordered drain, so UPDATE(k) overlaps PULL(k+1) (servers
+    only sum — the update stays on the worker).
+
+    ``stream_export`` (BYTEPS_STREAM_EXPORT, default on when a scheduler
+    is running): tap each eligible gradient leaf inside the compiled
+    backward with jax.experimental.io_callback and hand it straight to
+    the scheduler — time-to-first-push drops from "after the whole
+    backward" to "after the first gradient". Each key's priority is
+    pinned from its measured first-export ordinal
+    (scheduler.production_priority): production order, not flatten
+    order, decides service order. Leaves that are bucket-fused
+    (sub-BYTEPS_FUSION_BYTES), rowsparse-routed or device-compressed,
+    and builds where callbacks are unavailable, fall back cleanly to
+    the post-jit copy_to_host_async loop — numerics identical.
+
+    ``sharded_apply`` (BYTEPS_SHARDED_APPLY, default on): split the
+    monolithic apply jit into per-leaf donated partial updates
+    (jax.optim.make_sharded_apply) issued the moment each pull lands.
+    Transforms that are not per-leaf separable (global-norm clipping)
+    are detected at build time and keep the fused apply; the fused path
+    is also the arena-release barrier owner, so with sharding on the
+    lease release defers to the next step's start instead of a
+    block_until_ready at the end of this one. Failure contract: per-leaf
+    updates donate INCREMENTALLY during the drain, so a PS error
+    mid-round leaves params/opt_state partially invalidated on backends
+    that honor donation — treat a raised step like the donated fused
+    apply's mid-apply failure and restart from a checkpoint rather than
+    retrying with the same trees.
 
     ``compression``: string-kwargs dict for the codec registry (e.g.
     ``{"compressor": "onebit", "ef": "vanilla"}``) — gradients then ride
@@ -291,10 +495,23 @@ def make_ps_train_step(
 
     from ..core.state import get_state
 
+    import time as _time
+
     # registry is keyed to the client that created it: suspend/resume
     # replaces state.ps_client, and a cached registry would then push on a
     # destroyed native handle with a stale worker count
     comp_state = {"registry": None, "client": None, "device": None}
+    # streamed-export machinery (one compiled tapped backward, rebuilt
+    # when the gradient tree or eligibility changes; "disabled" latches
+    # a build/dispatch failure so a broken callback path costs one
+    # warning, not one attempt per step)
+    stream_state: dict = {"fn": None, "key": None, "disabled": False,
+                          "tag": 0, "holder": {"round": None}}
+    # sharded-apply build cache (keyed by params+opt_state structure;
+    # sa None = transform not separable -> fused apply)
+    sa_state: dict = {"sa": None, "key": None}
+    # deferred arena releases from sharded rounds: (leases, imported)
+    pending: list = []
 
     def local_grads(params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -306,6 +523,43 @@ def make_ps_train_step(
         local_grads, mesh=mesh, in_specs=(P(), P(axis)),
         out_specs=(P(), P()), check_vma=False))
 
+    def _build_streamed_fn(eligible):
+        """The tapped backward: identical math to ``grad_fn`` plus an
+        io_callback on each eligible gradient leaf INSIDE the
+        shard_mapped body — XLA schedules each tap right after its
+        leaf's psum, so the callback fires while later gradients are
+        still being produced (measured: first fire at ~1/3 of the
+        backward wall). The step tag rides through the program so a
+        late duplicate fire can never be mistaken for the next round's
+        export."""
+        from jax.experimental import io_callback
+
+        holder = stream_state["holder"]
+
+        def _ingest(i, step_arr, arr):
+            # round resolved at INGEST time: a stale fire then fails
+            # the tag check instead of resurrecting a finished round
+            rnd = holder["round"]
+            if rnd is not None:
+                rnd.on_leaf(i, int(step_arr), arr)
+
+        def _tap(i, step_arr, arr):
+            # device thread: enqueue ONLY (see _export_pool — touching
+            # the lazy callback arg here would self-deadlock)
+            _export_pool().submit(_ingest, i, step_arr, arr)
+
+        def streamed_local(step_tag, params, batch):
+            loss, grads = local_grads(params, batch)
+            leaves = jax.tree.leaves(grads)
+            for i in eligible:
+                io_callback(functools.partial(_tap, i), None, step_tag,
+                            leaves[i], ordered=False)
+            return loss, grads
+
+        return jax.jit(jax.shard_map(
+            streamed_local, mesh=mesh, in_specs=(P(), P(), P(axis)),
+            out_specs=(P(), P()), check_vma=False))
+
     def apply_updates_fn(params, opt_state, grads):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
@@ -315,246 +569,488 @@ def make_ps_train_step(
     def step(params, opt_state, batch):
         state = get_state()
         client = state.ps_client
-        loss, grads = grad_fn(params, batch)
-        if client is not None:
-            paths, treedef = jax.tree_util.tree_flatten_with_path(grads)
-            names, leaves = [], []
-            for path, leaf in paths:
-                names.append("grad/" + "/".join(
-                    str(getattr(k, "key", getattr(k, "idx", k)))
-                    for k in path))
-                leaves.append(leaf)
-            use_device = (compression is not None
-                          and device_compress is not False
-                          and state.scheduler is not None)
-            if use_device:
-                grads = _device_compressed_round(
-                    state, client, comp_state, compression,
-                    min_compress_bytes, rowsparse_params, names, leaves,
-                    treedef)
-                params, opt_state = apply_fn(params, opt_state, grads)
-                return params, opt_state, loss
-            # host tier below: dense D2H, codecs in numpy.
-            # start ALL D2H copies now; each np.asarray below then only
-            # waits for ITS leaf, so the transfer of leaf k+1 rides the
-            # bus while leaf k is already in PUSH — the reference's
-            # per-partition COPYD2H/PUSH overlap (core_loops.cc:378-443)
-            # done with device_get futures instead of a D2H stage thread.
-            for leaf in leaves:
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            reg = None
-            mcb = min_compress_bytes
-            if mcb is None:
-                mcb = getattr(state.config, "min_compress_bytes", 0)
-            if compression is not None:
-                if comp_state["client"] is not client:
-                    from ..server.compressed import CompressedRegistry
-                    comp_state["registry"] = CompressedRegistry(
-                        client, state.config.num_workers, compression, mcb)
-                    comp_state["client"] = client
-                reg = comp_state["registry"]
-            # one submit-as-ready loop for all three transports: dense or
-            # compressed partitions enter the priority-scheduled pipeline
-            # (compressed ones through COMPRESS/DECOMPRESS stages,
-            # operations.cc:199-204); the no-scheduler fallbacks overlap
-            # on a pool / run blocking.
-            import byteps_tpu as bps
+        # drain the previous sharded round's deferred arena releases
+        # FIRST: the imported arrays' readiness proves the host staging
+        # was consumed (their H2D completed), and releasing before this
+        # round's checkouts keeps the steady state conflict-free — the
+        # old end-of-step block_until_ready barrier, moved off the
+        # critical path (by now the wait is ~zero)
+        if pending:
+            try:
+                for pl, arrs in pending:
+                    try:
+                        jax.block_until_ready([a for a in arrs
+                                               if a is not None])
+                    except Exception:  # noqa: BLE001 - failed imports
+                        # surfaced step N's async failure here at step
+                        # N+1's start: never recycle the slots, and
+                        # never re-raise the SAME failure on every
+                        # later call of this closure
+                        for lease in pl:
+                            lease.abandon()
+                        continue
+                    for lease in pl:
+                        lease.release()
+            finally:
+                del pending[:]
+        if client is None:
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            return params, opt_state, loss
+        # names/shapes come from the params tree (value_and_grad gives
+        # gradients the identical structure), so the whole export plan
+        # exists BEFORE the backward is dispatched — the streamed taps
+        # need somewhere to land
+        paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+        names, p_leaves = [], []
+        for path, leaf in paths:
+            names.append("grad/" + "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k)))
+                for k in path))
+            p_leaves.append(leaf)
+        use_device = (compression is not None
+                      and device_compress is not False
+                      and state.scheduler is not None)
+        if use_device:
+            loss, grads = grad_fn(params, batch)
+            grads = _device_compressed_round(
+                state, client, comp_state, compression,
+                min_compress_bytes, rowsparse_params, names,
+                jax.tree.leaves(grads), treedef)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            return params, opt_state, loss
+        # ---- host tier: dense D2H (streamed where possible), codecs
+        # in numpy ----
+        reg = None
+        mcb = min_compress_bytes
+        if mcb is None:
+            mcb = getattr(state.config, "min_compress_bytes", 0)
+        if compression is not None:
+            if comp_state["client"] is not client:
+                from ..server.compressed import CompressedRegistry
+                comp_state["registry"] = CompressedRegistry(
+                    client, state.config.num_workers, compression, mcb)
+                comp_state["client"] = client
+            reg = comp_state["registry"]
+        # one submit-as-ready loop for all three transports: dense or
+        # compressed partitions enter the priority-scheduled pipeline
+        # (compressed ones through COMPRESS/DECOMPRESS stages,
+        # operations.cc:199-204); the no-scheduler fallbacks overlap
+        # on a pool / run blocking.
+        import byteps_tpu as bps
 
-            # Persistent host staging (core/arena.py, the reference's
-            # cpubuff discipline): result slots and fused-bucket concat
-            # slots check out of the arena instead of np.empty per step;
-            # every lease is released only after the imports below
-            # complete (or abandoned on error — correctness never
-            # depends on a slot surviving).
-            arena = state.arena
-            leases: list = []
+        # Persistent host staging (core/arena.py, the reference's
+        # cpubuff discipline): result slots, fused-bucket concat slots
+        # and streamed-export result slots check out of the arena instead
+        # of np.empty per step; every lease is released only after the
+        # imports below complete (or abandoned on error — correctness
+        # never depends on a slot surviving).
+        arena = state.arena
+        leases: list = []
 
-            def checkout(key, nbytes, dtype):
-                lease = arena.checkout(key, nbytes)
-                leases.append(lease)
-                return lease.array(dtype)
+        def checkout(key, nbytes, dtype, tag=None):
+            lease = arena.checkout(key, nbytes, tag=tag)
+            leases.append(lease)
+            return lease.array(dtype)
 
-            def submit_sparse(name, h2d, out_dtype):
-                from .. import _rowsparse_submit
-                handle = state.handles.allocate(name)
-                obuf = checkout(f"{name}:out", h2d.size * 4, np.float32)
-                _rowsparse_submit(state, name,
-                                  h2d.astype(np.float32, copy=False),
-                                  True, handle, out=obuf)
-                return (lambda: state.handles.wait_and_clear(
-                    handle.id).astype(out_dtype, copy=False)), handle
+        # time-to-first-push: wall from the backward's dispatch to the
+        # first submission entering the scheduler, whichever thread
+        # gets there first (telemetry: export_ttfp_ms)
+        round_t0 = _time.perf_counter()
+        first_push = [None]
+        fp_mu = threading.Lock()
 
-            def submit(name, flat):
-                """Returns (finish, notifier): ``finish()`` yields the
-                reduced array (non-blocking once ``notifier`` — a Handle
-                or Future with add_done_callback, or None for an already
-                complete result — has fired)."""
-                if reg is not None:
-                    flat = flat.astype(np.float32, copy=False)
-                    if state.scheduler is not None:
-                        obuf = checkout(f"{name}:out", flat.nbytes,
-                                        np.float32)
-                        hd = reg.push_pull_async(state, name, flat, True,
-                                                 out=obuf)
-                        return (lambda: bps.synchronize(hd),
-                                state.handles.get(hd))
-                    fut = _comp_pool().submit(
-                        reg.push_pull, state, name, flat, True)
-                    return fut.result, fut
+        def mark_first_push():
+            with fp_mu:
+                if first_push[0] is None:
+                    first_push[0] = _time.perf_counter() - round_t0
+
+        def submit_sparse(name, h2d, out_dtype):
+            from .. import _rowsparse_submit
+            mark_first_push()
+            handle = state.handles.allocate(name)
+            obuf = checkout(f"{name}:out", h2d.size * 4, np.float32)
+            _rowsparse_submit(state, name,
+                              h2d.astype(np.float32, copy=False),
+                              True, handle, out=obuf)
+            return (lambda: state.handles.wait_and_clear(
+                handle.id).astype(out_dtype, copy=False)), handle
+
+        def submit(name, flat, priority=None, tag=None):
+            """Returns (finish, notifier): ``finish()`` yields the
+            reduced array (non-blocking once ``notifier`` — a Handle
+            or Future with add_done_callback, or None for an already
+            complete result — has fired)."""
+            mark_first_push()
+            if reg is not None:
+                flat = flat.astype(np.float32, copy=False)
                 if state.scheduler is not None:
-                    obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype)
-                    hd = bps.push_pull_async(flat, name, average=True,
-                                             out=obuf)
+                    obuf = checkout(f"{name}:out", flat.nbytes,
+                                    np.float32, tag=tag)
+                    hd = reg.push_pull_async(state, name, flat, True,
+                                             priority=priority, out=obuf)
                     return (lambda: bps.synchronize(hd),
                             state.handles.get(hd))
-                from ..server.client import ps_round_trip
-                obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype)
-                res = ps_round_trip(state, name, flat, average=True,
-                                    out=obuf)
-                return (lambda: res), None
+                fut = _comp_pool().submit(
+                    reg.push_pull, state, name, flat, True)
+                return fut.result, fut
+            if state.scheduler is not None:
+                obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype,
+                                tag=tag)
+                hd = bps.push_pull_async(flat, name, average=True,
+                                         priority=priority, out=obuf)
+                return (lambda: bps.synchronize(hd),
+                        state.handles.get(hd))
+            from ..server.client import ps_round_trip
+            obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype)
+            res = ps_round_trip(state, name, flat, average=True,
+                                out=obuf)
+            return (lambda: res), None
 
-            # Bucket fusion (BYTEPS_FUSION_BYTES; the group-push cure):
-            # per-key cost (scheduler admission, handle, two syscall
-            # round-trips, server queue hop) is flat, so sub-threshold
-            # leaves — biases, norms, small projections — fuse into one
-            # concatenated key per dtype run and are sliced back after
-            # the round. The bucket name is a content-stable digest of
-            # (member names, sizes): every worker flattens the same tree
-            # in the same order, so all workers aggregate the same
-            # bucket; a changed model topology changes the digest and
-            # cleanly declares a new key. Codec granularity for a fused
-            # bucket is the bucket (matching the reference, where the
-            # codec unit is the partition, not the layer).
-            #
-            # Interaction rules:
-            # - bucket cap <= partition_bytes: a bucket must stay ONE
-            #   key, or the partitioner re-splits it and re-adds the
-            #   round trip fusion exists to remove;
-            # - with compression on and min_compress_bytes > 0, only
-            #   sub-mcb leaves fuse and the bucket stays < mcb, so
-            #   tensors the gate kept full-precision (biases, norms)
-            #   are NOT quantized via the fused key (mcb == 0 means the
-            #   user asked for everything compressed — buckets too).
-            fusion = getattr(state.config, "fusion_bytes", 0)
-            bucket_cap = min(4 << 20,
-                             getattr(state.config, "partition_bytes",
-                                     4 << 20))
-            if reg is not None and mcb > 0:
-                fusion = min(fusion, mcb)
-                bucket_cap = min(bucket_cap, mcb - 1)
-            waiters = []   # (slot_or_slots, finisher, notifier)
-            bucket: list = []  # [(slot, name, flat_f-contig host array)]
-            bucket_bytes = 0
+        def submit_streamed(name, flat):
+            """Tap-side submit (runs on the export worker) at
+            production-order priority. ``flat`` is the materialized
+            host view of the callback's array — its base keeps the
+            buffer alive through the PUSH stage, so no staging copy is
+            needed; the arena lease here is the EXPORT round's result
+            slot (tag="export" in the arena counters)."""
+            from ..server.client import get_or_init_ctx
+            if reg is not None:
+                # upcast BEFORE declaring: the compressed wire is f32,
+                # and initializing the ctx from a non-f32 view would
+                # re-partition it every round against the registry's
+                # f32 sizing — recreating the CompressedTensor and
+                # silently resetting its EF/momentum codec state
+                flat = flat.astype(np.float32, copy=False)
+            ctx = get_or_init_ctx(state, name, flat)
+            pr = state.scheduler.production_priority(ctx)
+            return submit(name, flat, priority=pr, tag="export")
 
-            def flush_bucket():
-                nonlocal bucket, bucket_bytes
-                if not bucket:
-                    return
-                if len(bucket) == 1:
-                    slot, name, h = bucket[0]
-                    waiters.append((slot, *submit(name, h.reshape(-1))))
-                else:
-                    import hashlib
-                    digest = hashlib.sha1(";".join(
-                        f"{n}:{h.size}" for _, n, h in bucket)
-                        .encode()).hexdigest()[:12]
-                    # concatenate into the bucket's PERSISTENT arena
-                    # slot (np.concatenate would allocate the fused
-                    # buffer fresh every step). With compression on the
-                    # wire is f32, so fill as f32 and skip the astype
-                    # copy submit() would otherwise make.
-                    bdt = np.dtype(np.float32) if reg is not None \
-                        else bucket[0][2].dtype
-                    total = sum(h.size for _, _, h in bucket)
-                    fused = checkout(f"fused/{digest}:in",
-                                     total * bdt.itemsize, bdt)
-                    off = 0
-                    for _, _, h in bucket:
-                        fused[off:off + h.size] = h.reshape(-1)
-                        off += h.size
-                    slots = [s for s, _, _ in bucket]
-                    sizes = [h.size for _, _, h in bucket]
-                    w, notifier = submit(f"fused/{digest}", fused)
+        # Bucket fusion (BYTEPS_FUSION_BYTES; the group-push cure):
+        # per-key cost (scheduler admission, handle, two syscall
+        # round-trips, server queue hop) is flat, so sub-threshold
+        # leaves — biases, norms, small projections — fuse into one
+        # concatenated key per dtype run and are sliced back after
+        # the round. The bucket name is a content-stable digest of
+        # (member names, sizes): every worker flattens the same tree
+        # in the same order, so all workers aggregate the same
+        # bucket; a changed model topology changes the digest and
+        # cleanly declares a new key. Codec granularity for a fused
+        # bucket is the bucket (matching the reference, where the
+        # codec unit is the partition, not the layer).
+        #
+        # Interaction rules:
+        # - bucket cap <= partition_bytes: a bucket must stay ONE
+        #   key, or the partitioner re-splits it and re-adds the
+        #   round trip fusion exists to remove;
+        # - with compression on and min_compress_bytes > 0, only
+        #   sub-mcb leaves fuse and the bucket stays < mcb, so
+        #   tensors the gate kept full-precision (biases, norms)
+        #   are NOT quantized via the fused key (mcb == 0 means the
+        #   user asked for everything compressed — buckets too);
+        # - sub-fusion leaves never stream: a bucket is a cross-leaf
+        #   artifact, and its members must all be on host before the
+        #   concat — exactly what the post-jit loop provides.
+        fusion = getattr(state.config, "fusion_bytes", 0)
+        bucket_cap = min(4 << 20,
+                         getattr(state.config, "partition_bytes",
+                                 4 << 20))
+        if reg is not None and mcb > 0:
+            fusion = min(fusion, mcb)
+            bucket_cap = min(bucket_cap, mcb - 1)
+        waiters = []   # (slot_or_slots, finisher, notifier)
+        bucket: list = []  # [(slot, name, flat_f-contig host array)]
+        bucket_bytes = 0
 
-                    def finish(w=w, sizes=sizes):
-                        out = w()
-                        outs = np.split(out, np.cumsum(sizes)[:-1])
-                        return outs
+        def flush_bucket():
+            nonlocal bucket, bucket_bytes
+            if not bucket:
+                return
+            if len(bucket) == 1:
+                slot, name, h = bucket[0]
+                waiters.append((slot, *submit(name, h.reshape(-1))))
+            else:
+                import hashlib
+                digest = hashlib.sha1(";".join(
+                    f"{n}:{h.size}" for _, n, h in bucket)
+                    .encode()).hexdigest()[:12]
+                # concatenate into the bucket's PERSISTENT arena
+                # slot (np.concatenate would allocate the fused
+                # buffer fresh every step). With compression on the
+                # wire is f32, so fill as f32 and skip the astype
+                # copy submit() would otherwise make.
+                bdt = np.dtype(np.float32) if reg is not None \
+                    else bucket[0][2].dtype
+                total = sum(h.size for _, _, h in bucket)
+                fused = checkout(f"fused/{digest}:in",
+                                 total * bdt.itemsize, bdt)
+                off = 0
+                for _, _, h in bucket:
+                    fused[off:off + h.size] = h.reshape(-1)
+                    off += h.size
+                slots = [s for s, _, _ in bucket]
+                sizes = [h.size for _, _, h in bucket]
+                w, notifier = submit(f"fused/{digest}", fused)
 
-                    waiters.append((slots, finish, notifier))
-                bucket, bucket_bytes = [], 0
+                def finish(w=w, sizes=sizes):
+                    out = w()
+                    outs = np.split(out, np.cumsum(sizes)[:-1])
+                    return outs
 
-            imported: list = [None] * len(names)
+                waiters.append((slots, finish, notifier))
+            bucket, bucket_bytes = [], 0
+
+        # ---- streamed-export eligibility + tapped-backward build ----
+        # A leaf streams when it rides its own dense/host-compressed
+        # key: rowsparse routing needs the host 2D view, and
+        # sub-fusion leaves belong to a bucket (see above). The tapped
+        # jit is rebuilt only when the tree/eligibility changes.
+        stream_cfg = stream_export if stream_export is not None \
+            else getattr(state.config, "stream_export", True)
+        stream_on = (stream_cfg and state.scheduler is not None
+                     and not stream_state["disabled"])
+        eligible: tuple = ()
+        if stream_on:
+            el = []
+            for i, (name, leaf) in enumerate(zip(names, p_leaves)):
+                if rowsparse_params and any(s in name
+                                            for s in rowsparse_params):
+                    continue
+                nb = getattr(leaf, "nbytes", 0)
+                if nb == 0 or nb < fusion:
+                    continue
+                el.append(i)
+            eligible = tuple(el)
+            stream_on = bool(eligible)
+        if stream_on and stream_state["key"] != (treedef, eligible):
             try:
-                for i, (name, leaf) in enumerate(zip(names, leaves)):
-                    h = np.asarray(leaf)  # ready-or-wait for THIS leaf
-                    if _route_rowsparse(name, h, state, rowsparse_params):
-                        flush_bucket()
-                        # non-f32 grads upcast for the wire, cast back
-                        waiters.append((i, *submit_sparse(name, h,
-                                                          h.dtype)))
-                    elif h.nbytes < fusion:
-                        if bucket and (bucket[0][2].dtype != h.dtype
-                                       or bucket_bytes + h.nbytes
-                                       > bucket_cap):
-                            flush_bucket()
-                        bucket.append((i, name, h))
-                        bucket_bytes += h.nbytes
-                    else:
-                        flush_bucket()
-                        waiters.append((i, *submit(name, h.reshape(-1))))
-                flush_bucket()
-                shapes = [np.shape(leaf) for leaf in leaves]
-                # Completion-ordered IMPORT drain: instead of draining
-                # every waiter in submission order and only then letting
-                # apply_fn upload the whole tree, issue the async H2D
-                # device_put for each leaf THE MOMENT its pull lands —
-                # XLA overlaps the import of tensor k with the DCN PULL
-                # of tensor k+1, the mirror of the copy_to_host_async
-                # EXPORT overlap above (reference: COPYH2D as its own
-                # pipeline stage, core_loops.cc:620-648).
-                import queue as _queue
+                stream_state["fn"] = _build_streamed_fn(eligible)
+                stream_state["key"] = (treedef, eligible)
+            except Exception as e:  # noqa: BLE001 - clean fallback
+                stream_on = False
+                _disable_stream(
+                    stream_state,
+                    "streamed gradient export unavailable (%s); "
+                    "falling back to post-jit export", e)
 
-                ready: "_queue.Queue" = _queue.Queue()
-                for wi, (_, _, notifier) in enumerate(waiters):
-                    if notifier is None:
-                        ready.put(wi)
-                    else:
-                        notifier.add_done_callback(
-                            lambda *_a, wi=wi: ready.put(wi))
-                for _ in range(len(waiters)):
-                    slot, finish, _ = waiters[ready.get()]
-                    if isinstance(slot, list):
-                        for s, piece in zip(slot, finish()):
-                            imported[s] = jax.device_put(
-                                piece.reshape(shapes[s]))
-                    else:
-                        imported[slot] = jax.device_put(
-                            finish().reshape(shapes[slot]))
-                # wait for the H2D transfers only (apply_fn needs them
-                # anyway) so the arena slots are provably idle before
-                # they are released for the next round
-                jax.block_until_ready([x for x in imported
-                                       if x is not None])
-            except BaseException:
-                # a failed round (submission OR drain) may leave pulls
-                # mid-flight into these slots: abandon (drop from the
-                # table) instead of recycling them under a late writer.
-                # The not-yet-drained sibling handles must not pin their
-                # gradient-sized result buffers in the handle table for
-                # the life of the process either (the same leak class
-                # the TF graph tier discards against).
+        # ---- sharded-apply build (cached per tree structure) ----
+        sharded_cfg = sharded_apply if sharded_apply is not None \
+            else getattr(state.config, "sharded_apply", True)
+        sa = None
+        if sharded_cfg:
+            skey = (treedef, jax.tree.structure(opt_state))
+            if sa_state["key"] != skey:
+                from .optim import make_sharded_apply
+                sa_state["sa"] = make_sharded_apply(tx, params, opt_state)
+                sa_state["key"] = skey
+            sa = sa_state["sa"]  # None -> not separable -> fused apply
+
+        # ---- dispatch the backward (tapped when streaming) ----
+        round_obj = None
+        loss = grads = None
+        if stream_on:
+            stream_state["tag"] += 1
+            round_obj = _StreamRound(stream_state["tag"], names,
+                                     submit_streamed, mark_first_push)
+            for i in eligible:
+                round_obj.expect(i)
+            stream_state["holder"]["round"] = round_obj
+            try:
+                loss, grads = stream_state["fn"](
+                    jnp.int32(stream_state["tag"]), params, batch)
+            except Exception as e:  # noqa: BLE001 - compile/dispatch
+                # failure of the TAPPED build only: quiesce the export
+                # worker, clean up whatever the partial round
+                # submitted, and latch the fallback
+                stream_state["holder"]["round"] = None
+                round_obj.cancel()
+                streamed_any = round_obj.streamed > 0
+                for h in round_obj.handles():
+                    state.handles.discard(h.id)
                 for lease in leases:
                     lease.abandon()
-                for _, _, notifier in waiters:
-                    if hasattr(notifier, "id"):
-                        state.handles.discard(notifier.id)
-                raise
+                del leases[:]
+                round_obj = None
+                _disable_stream(
+                    stream_state,
+                    "streamed gradient export failed at dispatch "
+                    "(%s); falling back to post-jit export", e)
+                if streamed_any:
+                    # pushes for this round are already on the wire:
+                    # resubmitting the same keys in the fallback would
+                    # double-push them — the server counts pushes
+                    # positionally per worker per key, so that would
+                    # silently shift every later round's aggregation
+                    # (the corruption class _pin_priority guards).
+                    # Fail THIS round instead; the next step runs
+                    # cleanly on the plain jit.
+                    raise
+                # nothing left the worker (e.g. pure compile failure):
+                # retry this step on the plain jit — a genuine compute
+                # error will surface there on its own terms
+        if grads is None:
+            loss, grads = grad_fn(params, batch)
+        g_leaves = jax.tree.leaves(grads)
+        streamed_set = set(eligible) if round_obj is not None else set()
+        # start the D2H copies for the non-streamed leaves now; each
+        # np.asarray below then only waits for ITS leaf, so the
+        # transfer of leaf k+1 rides the bus while leaf k is already
+        # in PUSH — the reference's per-partition COPYD2H/PUSH overlap
+        # (core_loops.cc:378-443). Streamed leaves already crossed in
+        # their tap.
+        for i, leaf in enumerate(g_leaves):
+            if i not in streamed_set and hasattr(leaf,
+                                                 "copy_to_host_async"):
+                leaf.copy_to_host_async()
+
+        imported: list = [None] * len(names)
+        new_params: list = [None] * len(names)
+        apply_parts: list = [None] * len(names)
+        try:
+            for i, (name, leaf) in enumerate(zip(names, g_leaves)):
+                if i in streamed_set:
+                    # peek first; on a miss, block on the leaf ITSELF —
+                    # a compute error then surfaces immediately instead
+                    # of stalling a long claim — and give the ingest
+                    # one more beat (it fires by program end unless the
+                    # callback path is truly dead, which the final
+                    # claim latches via round.broken)
+                    w = round_obj.claim(i, timeout=5.0, final=False)
+                    if w is None:
+                        np.asarray(leaf)  # ready-or-raise
+                        w = round_obj.claim(i, timeout=30.0, final=True)
+                    if w is not None:
+                        waiters.append((i, *w))
+                        continue
+                    # claimed for fallback: export synchronously below
+                h = np.asarray(leaf)  # ready-or-wait for THIS leaf
+                if _route_rowsparse(name, h, state, rowsparse_params):
+                    flush_bucket()
+                    # non-f32 grads upcast for the wire, cast back
+                    waiters.append((i, *submit_sparse(name, h,
+                                                      h.dtype)))
+                elif h.nbytes < fusion:
+                    if bucket and (bucket[0][2].dtype != h.dtype
+                                   or bucket_bytes + h.nbytes
+                                   > bucket_cap):
+                        flush_bucket()
+                    bucket.append((i, name, h))
+                    bucket_bytes += h.nbytes
+                else:
+                    flush_bucket()
+                    waiters.append((i, *submit(name, h.reshape(-1))))
+            flush_bucket()
+            shapes = [np.shape(leaf) for leaf in g_leaves]
+            # Completion-ordered drain — IMPORT + UPDATE: issue the
+            # async H2D device_put for each leaf THE MOMENT its pull
+            # lands (XLA overlaps the import of tensor k with the DCN
+            # PULL of tensor k+1 — the mirror of the streamed EXPORT
+            # above; reference: COPYH2D as its own pipeline stage,
+            # core_loops.cc:620-648), and with the sharded apply, its
+            # per-leaf optimizer update right behind it — UPDATE(k)
+            # overlaps PULL(k+1), the tail of the COMPUTE/PUSH/UPDATE
+            # pipeline.
+            import queue as _queue
+
+            ready: "_queue.Queue" = _queue.Queue()
+            for wi, (_, _, notifier) in enumerate(waiters):
+                if notifier is None:
+                    ready.put(wi)
+                else:
+                    notifier.add_done_callback(
+                        lambda *_a, wi=wi: ready.put(wi))
+
+            sa_round = sa.begin(opt_state) if sa is not None else None
+
+            def land(s, piece):
+                arr = jax.device_put(piece.reshape(shapes[s]))
+                imported[s] = arr
+                if sa_round is not None:
+                    new_params[s], apply_parts[s] = sa_round.apply(
+                        p_leaves[s], s, arr)
+
+            for _ in range(len(waiters)):
+                slot, finish, _ = waiters[ready.get()]
+                if isinstance(slot, list):
+                    for s, piece in zip(slot, finish()):
+                        land(s, piece)
+                else:
+                    land(slot, finish())
+            if sa is None:
+                # fused apply: wait for the H2D transfers (apply_fn
+                # needs them anyway) so the arena slots are provably
+                # idle before release
+                jax.block_until_ready([x for x in imported
+                                       if x is not None])
+        except BaseException:
+            # a failed round (submission OR drain) may leave pulls
+            # mid-flight into these slots: abandon (drop from the
+            # table) instead of recycling them under a late writer.
+            # The not-yet-drained sibling handles must not pin their
+            # gradient-sized result buffers in the handle table for
+            # the life of the process either (the same leak class
+            # the TF graph tier discards against).
+            stream_state["holder"]["round"] = None
+            if round_obj is not None:
+                # quiesce BEFORE the abandon/discard loops: an ingest
+                # mid-flight on the export worker may still be checking
+                # out a lease / allocating a handle
+                round_obj.cancel()
+            for lease in leases:
+                lease.abandon()
+            for _, _, notifier in waiters:
+                if hasattr(notifier, "id"):
+                    state.handles.discard(notifier.id)
+            if round_obj is not None:
+                for h in round_obj.handles():
+                    state.handles.discard(h.id)
+            raise
+        stream_state["holder"]["round"] = None
+        if round_obj is not None and round_obj.broken:
+            # taps compiled but never fired at runtime: without this
+            # latch every FUTURE step would re-pay the full claim
+            # timeouts before falling back — the once-only cost the
+            # build/dispatch handlers already guarantee
+            _disable_stream(
+                stream_state,
+                "streamed gradient export taps never fired at "
+                "runtime; falling back to post-jit export")
+        state.telemetry.record_export(
+            round_obj.streamed if round_obj is not None else 0,
+            len(names) - (round_obj.streamed
+                          if round_obj is not None else 0),
+            first_push[0])
+        if sa is not None:
+            # UPDATEs are already in flight; the end-of-step barrier is
+            # gone. The leases release on whichever fires first: the
+            # export worker (as soon as the imports are ready — covers
+            # the LAST step of a run and a rebuilt step closure, which
+            # would otherwise pin the slots forever and conflict a new
+            # closure's checkouts into fresh allocations) or the next
+            # step's deterministic drain (release() is idempotent, so
+            # double-firing is harmless).
+            entry = (list(leases), imported)
+            pending.append(entry)
+
+            def _release_when_ready(entry=entry):
+                try:
+                    jax.block_until_ready([a for a in entry[1]
+                                           if a is not None])
+                except Exception:  # noqa: BLE001 - failed imports:
+                    for lease in entry[0]:    # never recycle the slots
+                        lease.abandon()
+                    return
+                for lease in entry[0]:
+                    lease.release()
+
+            _release_pool().submit(_release_when_ready)
+            params = treedef.unflatten(new_params)
+            opt_state = sa.merge(opt_state, apply_parts)
+        else:
             for lease in leases:
                 lease.release()
             grads = treedef.unflatten(imported)
-        params, opt_state = apply_fn(params, opt_state, grads)
+            params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, loss
 
     # tick the Chrome-trace step counter: the PUSH/PULL/COMPRESS spans the

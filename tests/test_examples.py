@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the axon plugin initializes (and can hang) regardless of JAX_PLATFORMS;
@@ -59,6 +61,7 @@ def test_tf_train_runs():
     assert "final loss" in r.stdout
 
 
+@pytest.mark.slow  # >30s: tier-1 headroom (runs in the full suite)
 def test_torch_train_all_frontends():
     """The torch-adapter example family (reference train_mnist_byteps +
     benchmark_byteps_ddp + benchmark_cross_barrier_byteps in one script):
@@ -103,6 +106,7 @@ def _run_example_over_ps(name: str, argv: list, extra_env: dict = None):
             srv.kill()
 
 
+@pytest.mark.slow  # >30s: tier-1 headroom (runs in the full suite)
 def test_torch_train_distributed_ps():
     """The torch example through the loopback PS: this is where
     CrossBarrier's poller/drain path and the DistributedOptimizer's PS
@@ -116,6 +120,7 @@ def test_torch_train_distributed_ps():
         assert "final loss" in r.stdout, (fe, r.stdout[-500:])
 
 
+@pytest.mark.slow  # >30s: tier-1 headroom (runs in the full suite)
 def test_benchmark_model_zoo_tiny():
     """examples/benchmark.py --tiny across the model zoo (the reference's
     benchmark vehicle covers its zoo the same way); bert has a dedicated
@@ -131,6 +136,7 @@ def test_benchmark_model_zoo_tiny():
         assert "img/sec" in r.stdout, (model, r.stdout[-500:])
 
 
+@pytest.mark.slow  # >30s: tier-1 headroom (runs in the full suite)
 def test_tf1_train_runs():
     """The v1 Session example (MonitoredTrainingSession + broadcast hook
     + v1 DistributedOptimizer) trains."""

@@ -295,3 +295,94 @@ def test_per_key_priority_is_pinned(monkeypatch):
         bps.shutdown()
         server.join(timeout=10)
         GlobalState._instance = None
+
+
+def _mk_ctx(key: int, name: str = None) -> TensorContext:
+    return TensorContext(name=name or f"t{key}", declared_key=key,
+                         dtype=DataType.FLOAT32)
+
+
+def test_pin_priority_first_submission_pins(monkeypatch):
+    """_pin_priority unit contract (guards the production-order
+    priority source against the cross-round reorder bug the pin exists
+    for): the first submission's explicit priority pins; a differing
+    per-call value warns EXACTLY once then is silently ignored; None
+    follows the pin without warning."""
+    from byteps_tpu.core import scheduler as sched_mod
+    from byteps_tpu.core.scheduler import PipelineScheduler
+
+    warned = []
+    monkeypatch.setattr(
+        sched_mod.log, "warning",
+        lambda msg, *a, **k: warned.append(msg % tuple(a) if a else msg))
+    sched = PipelineScheduler(None)
+    try:
+        ctx = _mk_ctx(7)
+        assert sched._pin_priority(ctx, 5) == 5          # pins
+        assert sched._pin_priority(ctx, 9) == 5          # ignored + warns
+        assert len(warned) == 1 and "pinned" in warned[0]
+        assert sched._pin_priority(ctx, 3) == 5          # silent now
+        assert sched._pin_priority(ctx, 9) == 5          # still silent
+        assert len(warned) == 1, warned
+        # None = "no opinion": follows the pin silently (a fallback-path
+        # submission of a production-pinned key must not trip the
+        # mismatch warning)
+        assert sched._pin_priority(ctx, None) == 5
+        assert len(warned) == 1, warned
+        # an untouched key seeds the layer-order default from None
+        assert sched._pin_priority(_mk_ctx(11), None) == -11
+    finally:
+        sched.stop()
+
+
+def test_pinned_priority_preserves_round_order():
+    """Two queued rounds of one tensor carrying DIFFERENT requested
+    priorities are admitted in round order once both resolve through
+    the pin — the exact cross-round reorder the pin guards against
+    (the server counts pushes positionally per worker per key)."""
+    from byteps_tpu.core.scheduler import PipelineScheduler
+
+    sched = PipelineScheduler(None)
+    try:
+        ctx = _mk_ctx(4)
+        p1 = sched._pin_priority(ctx, 5)
+        p2 = sched._pin_priority(ctx, 9)  # would overtake if honored
+        assert (p1, p2) == (5, 5)
+        q = ScheduledQueue()
+        t1, t2 = mk_task(key=4, priority=p1), mk_task(key=4, priority=p2)
+        q.add_task(t1)
+        q.add_task(t2)
+        got = q.get_task()
+        assert got is t1, "round N+1 admitted before round N"
+        q.report_finish(got)
+        assert q.get_task() is t2
+    finally:
+        sched.stop()
+
+
+def test_production_priority_orders_by_first_export():
+    """production_priority (the streamed-export priority source):
+    ordinals follow FIRST-EXPORT order, not declared-key order; repeat
+    calls are stable; the assignment pins, so later default submissions
+    agree; admission order follows production order."""
+    from byteps_tpu.core.scheduler import PipelineScheduler
+
+    sched = PipelineScheduler(None)
+    try:
+        c9, c3, c5 = _mk_ctx(9), _mk_ctx(3), _mk_ctx(5)
+        assert sched.production_priority(c9) == 0   # produced first
+        assert sched.production_priority(c3) == -1
+        assert sched.production_priority(c5) == -2
+        assert sched.production_priority(c9) == 0   # stable
+        assert sched.export_order() == {9: 0, 3: 1, 5: 2}
+        # the assignment pinned: a later None submission follows it
+        assert sched._pin_priority(c9, None) == 0
+        # admission order = production order (not key order): key 9,
+        # first exported, wins although its declared key is largest
+        q = ScheduledQueue()
+        q.add_task(mk_task(key=3, priority=sched.production_priority(c3)))
+        q.add_task(mk_task(key=5, priority=sched.production_priority(c5)))
+        q.add_task(mk_task(key=9, priority=sched.production_priority(c9)))
+        assert [q.get_task().key for _ in range(3)] == [9, 3, 5]
+    finally:
+        sched.stop()

@@ -256,6 +256,7 @@ print("TF_WORKER_OK", r, flush=True)
 """
 
 
+@pytest.mark.slow  # >30s: tier-1 headroom (runs in the full suite)
 def test_two_worker_tf_push_pull(monkeypatch):
     """Two real OS worker processes with the TF adapter through one
     loopback server: push_pull averages, broadcast wins from root."""
