@@ -590,6 +590,84 @@ def phase_arena_ab(steps: int = 6) -> dict:
             "arena_checkout_conflicts": stats["checkout_conflicts"]}
 
 
+def phase_metrics_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the unified metrics registry (core/metrics.py,
+    BYTEPS_METRICS) on the PS train step's steady state: the same
+    model/batch trained through the loopback PS with the registry
+    recording vs frozen (``BYTEPS_METRICS=0`` turns every instrument op
+    into a flag check), reporting best-of step wall for each arm plus
+    the overhead as a percentage. The acceptance bar is overhead <= 2%
+    of step wall with metrics on in the default config. INTERLEAVED
+    reps (the phase_scaling lesson): host-load drift lands on both arms;
+    best-of over all reps per arm is the capability number. Host-CPU
+    only. Also publishes the last StepReport's stage walls so the
+    profiler's own output is auditable from the phase JSON."""
+    import gc
+
+    def run(enabled: bool, walls: list):
+        os.environ["BYTEPS_METRICS"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # mixed sizes (the arena_ab layout): 4MB leaves ride their
+            # own keys through every instrumented stage, biases keep
+            # the fused-bucket path in the measurement
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            return bps.get_metrics()
+
+    prior = os.environ.get("BYTEPS_METRICS")
+    on_walls, off_walls, snap = [], [], None
+    try:
+        for _ in range(reps):
+            snap = run(True, on_walls)
+            run(False, off_walls)
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_METRICS", None)
+        else:
+            os.environ["BYTEPS_METRICS"] = prior
+    on_ms = min(on_walls) * 1e3
+    off_ms = min(off_walls) * 1e3
+    last = (snap.get("steps") or {}).get("last") or {}
+    return {"metrics_on_step_ms": round(on_ms, 2),
+            "metrics_off_step_ms": round(off_ms, 2),
+            "metrics_overhead_pct": round(
+                (on_ms - off_ms) / off_ms * 100.0, 2) if off_ms else None,
+            "metrics_last_step_report": {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in last.items()}}
+
+
 def phase_stream_ab(steps: int = 6, reps: int = 4,
                     throttle_mbps: float = 400.0) -> dict:
     """A/B the COMPUTE/PUSH/UPDATE pipeline (BYTEPS_STREAM_EXPORT +
@@ -937,6 +1015,7 @@ _PHASES = {
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
     "arena_ab": phase_arena_ab,
+    "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
@@ -1041,6 +1120,9 @@ def main() -> None:
         "pushpull_throttled_2srv_gbps": None,
         "arena_on_step_ms": None,
         "arena_off_step_ms": None,
+        "metrics_on_step_ms": None,
+        "metrics_off_step_ms": None,
+        "metrics_overhead_pct": None,
         "stream_on_step_ms": None,
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
@@ -1183,6 +1265,10 @@ def main() -> None:
                             # staging-arena A/B: two short loopback
                             # train runs (arena on vs off)
                             ("arena_ab", 240.0),
+                            # metrics-registry A/B: instrumented vs
+                            # frozen (BYTEPS_METRICS=0) step wall — the
+                            # <=2% observability-overhead guard
+                            ("metrics_ab", 240.0),
                             # COMPUTE/PUSH/UPDATE pipeline A/B: stream
                             # export + sharded apply on vs off, step
                             # wall + time-to-first-push

@@ -38,7 +38,8 @@ __all__ = [
     "rank", "size", "local_rank", "local_size",
     "push_pull", "push_pull_async", "poll", "synchronize", "broadcast",
     "declare_tensor", "profiler_step",
-    "get_pushpull_speed", "get_arena_stats",
+    "get_pushpull_speed", "get_metrics", "get_step_reports",
+    "get_arena_stats",
     "Config", "DataType", "QueueType", "Status",
 ]
 
@@ -91,6 +92,36 @@ def get_pushpull_speed() -> tuple:
     return get_state().telemetry.speed()
 
 
+def get_metrics() -> dict:
+    """Structured snapshot of the unified metrics registry
+    (core/metrics.py; schema in docs/observability.md):
+
+    - ``counters`` — monotonic totals (wire requests/bytes, compression
+      pre/post bytes, scheduler credit stalls, push_pull byte totals);
+    - ``gauges`` — last-write values (scheduler queue depth);
+    - ``histograms`` — fixed-log2-bucket latency distributions
+      (per-stage per-key-class scheduler latencies, admission wait,
+      per-leaf H2D+UPDATE drain spans) with count/sum/min/max/p50/p95/
+      p99;
+    - ``arena`` — the staging-arena + streamed-export counters
+      (identical keys to ``get_arena_stats()``);
+    - ``steps`` — the per-step pipeline profiler: ring-buffer window,
+      the last ``StepReport`` and its stall diagnosis.
+
+    ``BYTEPS_METRICS=0`` freezes the instruments (hot paths become a
+    flag check); the snapshot still returns with zeroed values.
+    """
+    state = get_state()
+    return state.metrics.snapshot()
+
+
+def get_step_reports() -> list:
+    """The last N ``StepReport``s (BYTEPS_STEP_REPORTS window) from the
+    per-step pipeline profiler, oldest first — the raw material of the
+    stall diagnosis (core/metrics.py classify_step)."""
+    return [r.as_dict() for r in get_state().profiler.reports()]
+
+
 def get_arena_stats() -> dict:
     """Host staging arena counters (core/arena.py): slots live, bytes
     pinned, allocations avoided, checkout conflicts, fresh fallbacks —
@@ -103,7 +134,11 @@ def get_arena_stats() -> dict:
     ``allocs_avoided`` growing and ``slot_allocs`` flat after warmup;
     with BYTEPS_STREAM_EXPORT on and leaves above the fusion
     threshold, ``export_streamed_leaves`` growing proves the
-    COMPUTE/PUSH overlap engaged rather than silently falling back."""
+    COMPUTE/PUSH overlap engaged rather than silently falling back.
+
+    Deprecated alias: this is ``get_metrics()["arena"]`` — the unified
+    registry snapshot is the maintained surface; the keys here are
+    stable for existing callers."""
     return get_state().telemetry.arena_stats()
 
 

@@ -126,18 +126,25 @@ class MetricAverageCallback(Callback):
                 out = bps.push_pull(v, name=f"metric/{name}", average=True)
                 metrics[name] = float(np.asarray(out)[0])
             return
-        # PS tier: submit all, then drain under a deadline — a metric
-        # key logged by only one worker can never reach num_workers
-        # contributions, and hanging the job at epoch end with no
-        # diagnostic is the worst failure mode
+        # PS tier: submit all, then drain under ONE shared deadline — a
+        # metric key logged by only one worker can never reach
+        # num_workers contributions, and hanging the job at epoch end
+        # with no diagnostic is the worst failure mode. The deadline is
+        # computed once and each wait gets the REMAINING time: the old
+        # per-metric full timeout let N slow metrics stack up to
+        # N x BYTEPS_METRIC_TIMEOUT_S of wall before the diagnostic.
+        import time as _time
+
         timeout = float(os.environ.get("BYTEPS_METRIC_TIMEOUT_S", "60"))
+        deadline = _time.monotonic() + timeout
         hs = {name: bps.push_pull_async(
                   np.asarray([float(metrics[name])], np.float32),
                   f"metric/{name}", average=True)
               for name in sorted(metrics)}
         for name, h in hs.items():
             try:
-                out = bps.synchronize(h, timeout=timeout)
+                out = bps.synchronize(
+                    h, timeout=max(0.0, deadline - _time.monotonic()))
             except TimeoutError as e:
                 # the raise is fatal for this epoch's metrics and nothing
                 # retries them: drop the timed-out handle and every

@@ -139,6 +139,18 @@ class Config:
     telemetry_on: bool = True             # BYTEPS_TELEMETRY_ON
     debug_sample_tensor: str = ""         # BYTEPS_DEBUG_SAMPLE_TENSOR
 
+    # --- metrics / observability (rebuild addition; core/metrics.py:
+    # the unified registry + per-step pipeline profiler every perf PR
+    # reports against). metrics_on=0 turns every instrument op into a
+    # flag check (the bench metrics_ab A/B); metrics_port > 0 serves a
+    # stdlib Prometheus text endpoint on 127.0.0.1; stall_diag logs a
+    # one-line per-step bound-stage diagnosis from the StepReport ring
+    # (window = step_report_window). ---
+    metrics_on: bool = True               # BYTEPS_METRICS
+    metrics_port: int = 0                 # BYTEPS_METRICS_PORT (0 = off)
+    stall_diag: bool = False              # BYTEPS_STALL_DIAG
+    step_report_window: int = 64          # BYTEPS_STEP_REPORTS
+
     # --- multi-process runtime (SURVEY §2.4: scheduler rendezvous ->
     # jax.distributed coordination service) ---
     num_processes: int = 1                # BYTEPS_NUM_PROCESS
@@ -186,6 +198,10 @@ class Config:
             jax_profiler_dir=_env_str("BYTEPS_JAX_PROFILER_DIR", ""),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            metrics_on=_env_bool("BYTEPS_METRICS", True),
+            metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),
+            stall_diag=_env_bool("BYTEPS_STALL_DIAG"),
+            step_report_window=_env_int("BYTEPS_STEP_REPORTS", 64),
             num_processes=_env_int("BYTEPS_NUM_PROCESS", 1),
             process_id=_env_int("BYTEPS_PROCESS_ID",
                                 _env_int("DMLC_WORKER_ID", 0)),

@@ -1,0 +1,594 @@
+"""Unified metrics registry + per-step pipeline profiler.
+
+The measurement plane the overlap story reports against. BytePS's
+performance case rests on COMPUTE→PUSH→UPDATE overlap and priority
+scheduling; before this module the evidence lived on ad-hoc surfaces
+(arena counters bolted onto ``get_arena_stats()``, a byte-rate sampler
+in ``core/state.py``, raw spans in ``utils/tracing.py``) with nothing
+aggregating them into an answer to "what is this step bound on?".
+
+Three layers:
+
+- ``MetricsRegistry`` — process-wide monotonic ``Counter``s, ``Gauge``s
+  (direct or lazily collected from a callback) and fixed-log2-bucket
+  ``Histogram``s. Thread-safe; the hot path is one lock + integer
+  mutation on preallocated storage (no per-sample allocation). Disabled
+  (``BYTEPS_METRICS=0``) every instrument op is a flag check + return —
+  the A/B ``bench.py --phase metrics_ab`` measures exactly this delta.
+- ``StepProfiler`` — per-train-step ``StepReport`` assembly: the PS
+  train step opens a report, the scheduler's stage pool threads feed
+  per-task stage samples into it, and ``end_step`` closes it into a
+  ring buffer of the last N reports, runs the straggler/stall detector
+  (one-line per-step diagnosis under ``BYTEPS_STALL_DIAG=1``) and
+  mirrors aggregate counters into the Chrome-trace ``Tracer`` as
+  counter events so Perfetto shows queue depth alongside spans.
+- exposition — ``bps.get_metrics()`` structured snapshot, plus an
+  opt-in stdlib-only Prometheus text endpoint
+  (``BYTEPS_METRICS_PORT``, default off).
+
+Adaptive-compression systems (PAPERS.md: Compressed Communication for
+Distributed Training) and update-sharding work (Automatic Cross-Replica
+Sharding of Weight Update) drive their decisions from exactly this kind
+of per-stage timing and byte accounting — this module is what makes
+those ROADMAP directions measurable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "StepReport", "StepProfiler", "classify_step",
+    "prometheus_text", "start_http_server",
+]
+
+
+# 34 log2 buckets in microseconds: bucket i counts samples with
+# us.bit_length() == i, so the span runs 1us .. ~2.3 hours — every
+# latency this pipeline can produce lands inside, and the bucket count
+# is fixed so a histogram never allocates after construction.
+HIST_BUCKETS = 34
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is one lock + int add."""
+
+    __slots__ = ("name", "_v", "_mu", "_reg")
+
+    def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self._v = 0
+        self._mu = threading.Lock()
+        self._reg = reg
+
+    def inc(self, n: int = 1) -> None:
+        if self._reg is not None and not self._reg.enabled:
+            return
+        with self._mu:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        with self._mu:
+            return self._v
+
+
+class Gauge:
+    """Last-write-wins gauge; ``set_fn`` makes it lazily collected (the
+    callback is read at snapshot/exposition time — how live structures
+    like the staging arena surface without a write on their hot path)."""
+
+    __slots__ = ("name", "_v", "_fn", "_mu", "_reg")
+
+    def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self._v = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+        self._mu = threading.Lock()
+        self._reg = reg
+
+    def set(self, v: float) -> None:
+        if self._reg is not None and not self._reg.enabled:
+            return
+        with self._mu:
+            self._v = v
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        with self._mu:
+            self._fn = fn
+
+    def set_max(self, v: float) -> None:
+        """Ratchet: keep the max of all sets (peak gauges)."""
+        if self._reg is not None and not self._reg.enabled:
+            return
+        with self._mu:
+            if v > self._v:
+                self._v = v
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            fn = self._fn
+            if fn is None:
+                return self._v
+        try:
+            return float(fn())
+        except Exception:  # noqa: BLE001 - a dead collector reads 0
+            return 0.0
+
+
+class Histogram:
+    """Fixed-log2-bucket latency/size histogram.
+
+    ``record(value)`` buckets by ``int(value).bit_length()`` — for
+    latencies, record MICROSECONDS (``record_seconds`` converts). The
+    bucket array is preallocated; the hot path is one lock, one
+    bit_length, four int mutations. Percentiles come back as the upper
+    bound of the covering bucket (log2 resolution — the stall detector
+    needs "41ms vs 12ms", not nanosecond truth)."""
+
+    __slots__ = ("name", "unit", "_counts", "_count", "_sum", "_min",
+                 "_max", "_mu", "_reg")
+
+    def __init__(self, name: str, unit: str = "us",
+                 reg: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self.unit = unit
+        self._counts = [0] * HIST_BUCKETS
+        self._count = 0
+        self._sum = 0
+        self._min = None
+        self._max = None
+        self._mu = threading.Lock()
+        self._reg = reg
+
+    def record(self, value: float) -> None:
+        if self._reg is not None and not self._reg.enabled:
+            return
+        v = int(value)
+        if v < 0:
+            v = 0
+        b = v.bit_length()
+        if b >= HIST_BUCKETS:
+            b = HIST_BUCKETS - 1
+        with self._mu:
+            self._counts[b] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    def record_seconds(self, seconds: float) -> None:
+        self.record(seconds * 1e6)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Upper bucket bound covering the p-quantile (0 < p <= 1)."""
+        with self._mu:
+            counts, count, mx = list(self._counts), self._count, self._max
+        return self._pct_from(counts, count, mx, p)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
+            counts = list(self._counts)
+        out = {"count": count, "sum": total, "min": mn, "max": mx,
+               "unit": self.unit, "buckets": counts}
+        for p, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            out[key] = self._pct_from(counts, count, mx, p)
+        return out
+
+    @staticmethod
+    def _pct_from(counts, count, mx, p) -> Optional[float]:
+        if count == 0:
+            return None
+        target = p * count
+        seen = 0
+        for b, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return float((1 << b) - 1) if b else 0.0
+        return float(mx)
+
+
+class MetricsRegistry:
+    """Process-wide instrument table. Instrument lookup takes the
+    registry lock (call sites cache their references for hot paths);
+    instrument ops take only the instrument's own lock."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._mu = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        # sections collected live at snapshot time (name -> dict fn):
+        # how the staging arena / export counters surface without a
+        # registry write on their own hot paths
+        self._sections: Dict[str, Callable[[], dict]] = {}
+
+    # -- instrument get-or-create ------------------------------------- #
+
+    def counter(self, name: str) -> Counter:
+        with self._mu:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, self)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._mu:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, self)
+            return g
+
+    def histogram(self, name: str, unit: str = "us") -> Histogram:
+        with self._mu:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name, unit, self)
+            return h
+
+    def section(self, name: str, collect: Callable[[], dict]) -> None:
+        """Register a live-collected snapshot section (e.g. "arena")."""
+        with self._mu:
+            self._sections[name] = collect
+
+    # -- exposition ---------------------------------------------------- #
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+            sections = dict(self._sections)
+        out = {
+            "enabled": self.enabled,
+            "counters": {n: c.value for n, c in counters.items()},
+            "gauges": {n: g.value for n, g in gauges.items()},
+            "histograms": {n: h.snapshot() for n, h in hists.items()},
+        }
+        for name, collect in sections.items():
+            try:
+                out[name] = collect()
+            except Exception:  # noqa: BLE001 - a dead section reads {}
+                out[name] = {}
+        return out
+
+
+# --------------------------------------------------------------------- #
+# per-step pipeline profiler
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class StepReport:
+    """One PS train step's pipeline accounting (docs/observability.md).
+
+    Stage walls are milliseconds. ``compute_ms`` covers backward
+    dispatch through the last gradient leaf leaving the device
+    (submission loop end — np.asarray blocks on XLA); ``drain_ms`` is
+    the completion-ordered PULL→H2D→UPDATE loop; ``tail_ms`` everything
+    after the last pull landed (fused apply barrier / lease release /
+    merge). Stage percentile fields aggregate the scheduler's per-task
+    samples for THIS step only."""
+
+    step: int = 0
+    wall_ms: float = 0.0
+    compute_ms: float = 0.0
+    drain_ms: float = 0.0
+    tail_ms: float = 0.0
+    ttfp_ms: Optional[float] = None
+    streamed_leaves: int = 0
+    fallback_leaves: int = 0
+    queue_depth_peak: int = 0
+    credit_stalls: int = 0
+    push_p95_ms: Optional[float] = None
+    pull_p95_ms: Optional[float] = None
+    compress_p95_ms: Optional[float] = None
+    h2d_update_p95_ms: Optional[float] = None
+    pull_wait_ms: float = 0.0  # time the drain sat blocked on ready.get
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _p95(samples: List[float]) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.95 * len(s)))]
+
+
+def classify_step(r: StepReport) -> str:
+    """Straggler/stall diagnosis: name the stage the step is bound on.
+
+    The comparison is stage p95 (a single slow partition decides the
+    step wall under completion-ordered draining) against the compute
+    wall; the PULL signal also considers the drain's aggregate blocked
+    time (``pull_wait_ms`` — many medium pulls serializing reads as a
+    stall even when no single partition's p95 does). Queue pressure
+    annotates the verdict. Returns e.g. ``"PULL-bound: pull p95 41.0ms
+    vs compute 12.0ms; queue depth peaked 37"``."""
+    pull_sig = max(r.pull_p95_ms or 0.0, r.pull_wait_ms or 0.0)
+    candidates = {
+        "COMPUTE": r.compute_ms,
+        "PUSH": r.push_p95_ms or 0.0,
+        "PULL": pull_sig,
+        "COMPRESS": r.compress_p95_ms or 0.0,
+        "UPDATE": r.h2d_update_p95_ms or 0.0,
+    }
+    bound = max(candidates, key=lambda k: candidates[k])
+    if bound == "COMPUTE":
+        label = "compute wall"
+    elif bound == "PULL" and pull_sig != (r.pull_p95_ms or 0.0):
+        label = "pull wait"  # the aggregate drain block decided it
+    else:
+        label = f"{bound.lower()} p95"
+    parts = [f"{bound}-bound: {label} {candidates[bound]:.1f}ms"]
+    if bound != "COMPUTE":
+        parts.append(f"vs compute {r.compute_ms:.1f}ms")
+    else:
+        comm = max(candidates["PUSH"], candidates["PULL"])
+        parts.append(f"vs comm p95 {comm:.1f}ms")
+    msg = " ".join(parts)
+    extras = []
+    if r.queue_depth_peak:
+        extras.append(f"queue depth peaked {r.queue_depth_peak}")
+    if r.credit_stalls:
+        extras.append(f"{r.credit_stalls} credit stalls")
+    if r.ttfp_ms is not None:
+        extras.append(f"ttfp {r.ttfp_ms:.1f}ms")
+    if extras:
+        msg += "; " + ", ".join(extras)
+    return msg
+
+
+class _StepBuilder:
+    """Mutable collection state for one in-flight step. Scheduler pool
+    threads append stage samples concurrently with the train thread's
+    phase marks; one lock serializes them (sample rate is per-partition,
+    not per-byte — contention is negligible)."""
+
+    __slots__ = ("step", "t0", "_mu", "stage_samples", "queue_peak",
+                 "credit_stalls", "marks", "pull_wait_s")
+
+    def __init__(self, step: int):
+        self.step = step
+        self.t0 = time.perf_counter()
+        self._mu = threading.Lock()
+        self.stage_samples: Dict[str, List[float]] = {}
+        self.queue_peak = 0
+        self.credit_stalls = 0
+        self.marks: Dict[str, float] = {}
+        self.pull_wait_s = 0.0
+
+    def stage_sample(self, stage: str, seconds: float) -> None:
+        with self._mu:
+            self.stage_samples.setdefault(stage, []).append(seconds * 1e3)
+
+    def queue_depth(self, depth: int) -> None:
+        with self._mu:
+            if depth > self.queue_peak:
+                self.queue_peak = depth
+
+    def credit_stall(self) -> None:
+        with self._mu:
+            self.credit_stalls += 1
+
+    def mark(self, name: str) -> None:
+        """Phase boundary relative to step start (train-thread only)."""
+        self.marks[name] = time.perf_counter() - self.t0
+
+    def add_pull_wait(self, seconds: float) -> None:
+        self.pull_wait_s += seconds
+
+
+class StepProfiler:
+    """Assembles ``StepReport``s and keeps the last N in a ring.
+
+    One step is active at a time (the PS train step is synchronous);
+    scheduler threads read ``current()`` — samples that land between
+    steps (async tails) are dropped, which is the honest choice: they
+    belong to no step's critical path."""
+
+    def __init__(self, window: int = 64, enabled: bool = True,
+                 stall_diag: bool = False, tracer=None):
+        import collections
+        self.enabled = enabled
+        self.stall_diag = stall_diag
+        self._tracer = tracer
+        self._mu = threading.Lock()
+        self._reports = collections.deque(maxlen=max(1, window))
+        self._current: Optional[_StepBuilder] = None
+        self._step_no = 0
+
+    def begin_step(self) -> Optional[_StepBuilder]:
+        if not self.enabled:
+            return None
+        with self._mu:
+            self._step_no += 1
+            self._current = _StepBuilder(self._step_no)
+            return self._current
+
+    def current(self) -> Optional[_StepBuilder]:
+        # racy read by design: scheduler threads sample whatever step is
+        # open right now; a stale builder reference still collects into
+        # a consistent (that step's) report
+        return self._current
+
+    def end_step(self, b: Optional[_StepBuilder], ttfp_ms=None,
+                 streamed: int = 0, fallback: int = 0) -> Optional[StepReport]:
+        if b is None:
+            return None
+        wall = (time.perf_counter() - b.t0) * 1e3
+        with b._mu:
+            samples = {k: list(v) for k, v in b.stage_samples.items()}
+            queue_peak, stalls = b.queue_peak, b.credit_stalls
+        r = StepReport(
+            step=b.step,
+            wall_ms=wall,
+            compute_ms=b.marks.get("export_done", 0.0) * 1e3,
+            drain_ms=(b.marks.get("drain_done", 0.0)
+                      - b.marks.get("export_done", 0.0)) * 1e3,
+            tail_ms=wall - b.marks.get("drain_done", 0.0) * 1e3
+            if "drain_done" in b.marks else 0.0,
+            ttfp_ms=ttfp_ms,
+            streamed_leaves=streamed,
+            fallback_leaves=fallback,
+            queue_depth_peak=queue_peak,
+            credit_stalls=stalls,
+            push_p95_ms=_p95(samples.get("PUSH", [])),
+            pull_p95_ms=_p95(samples.get("PULL", [])),
+            compress_p95_ms=_p95(samples.get("COMPRESS", [])
+                                 + samples.get("DECOMPRESS", [])),
+            h2d_update_p95_ms=_p95(samples.get("H2D_UPDATE", [])),
+            pull_wait_ms=b.pull_wait_s * 1e3,
+        )
+        with self._mu:
+            self._reports.append(r)
+            if self._current is b:
+                self._current = None
+        if self.stall_diag:
+            from ..utils.logging import log
+            log.info("step %d [%.1fms] %s", r.step, r.wall_ms,
+                     classify_step(r))
+        if self._tracer is not None:
+            # aggregate counters as Chrome-trace counter events: queue
+            # depth / stage p95s render as tracks alongside the spans in
+            # Perfetto (docs/timeline.md)
+            self._tracer.counter("bps:queue_depth_peak",
+                                 {"depth": r.queue_depth_peak})
+            self._tracer.counter("bps:step_ms", {
+                "wall": round(r.wall_ms, 3),
+                "compute": round(r.compute_ms, 3),
+                "pull_p95": round(r.pull_p95_ms or 0.0, 3),
+                "push_p95": round(r.push_p95_ms or 0.0, 3),
+            })
+        return r
+
+    def reports(self) -> List[StepReport]:
+        with self._mu:
+            return list(self._reports)
+
+    def last(self) -> Optional[StepReport]:
+        with self._mu:
+            return self._reports[-1] if self._reports else None
+
+    def snapshot(self) -> dict:
+        reports = self.reports()
+        out = {"window": self._reports.maxlen, "count": len(reports),
+               "last": reports[-1].as_dict() if reports else None}
+        if reports:
+            out["last_diagnosis"] = classify_step(reports[-1])
+        return out
+
+
+# --------------------------------------------------------------------- #
+# Prometheus text exposition (stdlib only)
+# --------------------------------------------------------------------- #
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    n = "".join(out)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return "byteps_" + n
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Render the registry in Prometheus text exposition format 0.0.4.
+    Histograms emit cumulative ``_bucket{le=...}`` series with the
+    log2 upper bounds, plus ``_sum``/``_count``; snapshot sections
+    flatten to gauges (non-numeric values are skipped)."""
+    snap = registry.snapshot()
+    lines: List[str] = []
+    for name, v in sorted(snap["counters"].items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    for name, v in sorted(snap["gauges"].items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for name, h in sorted(snap["histograms"].items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for b, c in enumerate(h["buckets"]):
+            if c == 0:
+                continue
+            cum += c
+            le = (1 << b) - 1
+            lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {h['sum']}")
+        lines.append(f"{pn}_count {h['count']}")
+    for section, values in snap.items():
+        if section in ("enabled", "counters", "gauges", "histograms",
+                       "steps"):
+            continue
+        if not isinstance(values, dict):
+            continue
+        for k, v in sorted(values.items()):
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                continue
+            pn = _prom_name(f"{section}_{k}")
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def start_http_server(registry: MetricsRegistry, port: int,
+                      snapshot_fn: Optional[Callable[[], dict]] = None):
+    """Serve ``/metrics`` (Prometheus text) and ``/`` (JSON snapshot)
+    on a daemon thread. Stdlib only. ``registry`` may be the registry
+    itself or a zero-arg callable returning it (resolved per request,
+    so a re-init that replaces the registry keeps the endpoint live).
+    Returns the server; call ``.shutdown()`` + ``.server_close()`` to
+    stop (GlobalState.shutdown does). Binds 127.0.0.1 — scrape-proxy or
+    port-forward to expose."""
+    import http.server
+    import json
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            try:
+                reg = registry() if callable(registry) else registry
+                if self.path.startswith("/metrics"):
+                    body = prometheus_text(reg).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    snap = snapshot_fn() if snapshot_fn \
+                        else reg.snapshot()
+                    body = json.dumps(snap, default=str).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except BrokenPipeError:
+                pass
+
+        def log_message(self, *args):  # silence per-request stderr
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever,
+                         name="bps-metrics-http", daemon=True)
+    t.start()
+    return server

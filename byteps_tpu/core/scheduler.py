@@ -32,6 +32,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -47,7 +48,7 @@ UNLIMITED_CREDIT = 32 << 30
 class ScheduledQueue:
     """Priority + credit gated task queue (scheduled_queue.cc)."""
 
-    def __init__(self, credit_bytes: int = 0):
+    def __init__(self, credit_bytes: int = 0, metrics=None, profiler=None):
         # credit_bytes <= 0 -> scheduling disabled -> huge credit
         self._credit = credit_bytes if credit_bytes > 0 else UNLIMITED_CREDIT
         self._capacity = self._credit
@@ -61,21 +62,41 @@ class ScheduledQueue:
         # so overlapping push_pulls of one tensor can't interleave their
         # PUSH/PULL into the same server aggregation round
         self._inflight: set = set()
+        # measurement plane (core/metrics.py); None when metrics off —
+        # instrument refs cached here so the hot path never takes the
+        # registry lock
+        self._profiler = profiler
+        self._credit_blocked = False  # set by _pop_admissible_locked
+        if metrics is not None:
+            self._depth_gauge = metrics.gauge("scheduler/queue_depth")
+            self._admit_hist = metrics.histogram(
+                "scheduler/admission_wait_us")
+            self._stall_ctr = metrics.counter("scheduler/credit_stalls")
+        else:
+            self._depth_gauge = self._admit_hist = self._stall_ctr = None
 
     def add_task(self, task: "PartitionTask") -> None:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("scheduler stopped")
+            task.enqueue_t = time.perf_counter()
             # (priority desc, key asc): negate priority for the min-heap;
             # seq keeps same-key tasks in submission order
             heapq.heappush(self._heap,
                            (-task.priority, task.key, next(self._counter),
                             task))
+            depth = len(self._heap)
             self._cv.notify()
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(depth)
+            prof = self._profiler.current() if self._profiler else None
+            if prof is not None:
+                prof.queue_depth(depth)
 
     def get_task(self) -> Optional["PartitionTask"]:
         """Block until a task is admitted (enough credit, key not already
         in flight) or stop()."""
+        stall_counted = False
         with self._cv:
             while True:
                 if self._stopped:
@@ -84,8 +105,25 @@ class ScheduledQueue:
                 if task is not None:
                     self._credit -= task.nbytes
                     self._inflight.add(task.key)
-                    return task
+                    depth = len(self._heap)
+                    break
+                if (self._credit_blocked and not stall_counted
+                        and self._stall_ctr is not None):
+                    # one stall EPISODE per blocked admission attempt,
+                    # not one per 0.1s poll of the same starvation
+                    stall_counted = True
+                    self._stall_ctr.inc()
+                    prof = self._profiler.current() if self._profiler \
+                        else None
+                    if prof is not None:
+                        prof.credit_stall()
                 self._cv.wait(timeout=0.1)
+        if self._admit_hist is not None:
+            self._depth_gauge.set(depth)
+            if task.enqueue_t is not None:
+                self._admit_hist.record_seconds(
+                    time.perf_counter() - task.enqueue_t)
+        return task
 
     def _pop_admissible_locked(self) -> Optional["PartitionTask"]:
         """Pop the highest-priority admissible task. In-flight keys are
@@ -95,6 +133,7 @@ class ScheduledQueue:
         (scheduled_queue.cc:136-149 admits strictly in order)."""
         skipped: List = []
         found = None
+        self._credit_blocked = False
         while self._heap:
             item = heapq.heappop(self._heap)
             t = item[3]
@@ -107,6 +146,7 @@ class ScheduledQueue:
                 found = t
             else:
                 skipped.append(item)
+                self._credit_blocked = True
             break
         for item in skipped:
             heapq.heappush(self._heap, item)
@@ -147,7 +187,7 @@ class PartitionTask:
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
-                 "cmd_pull", "pull_len", "push_len", "lease")
+                 "cmd_pull", "pull_len", "push_len", "lease", "enqueue_t")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
                  group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
@@ -167,6 +207,7 @@ class PartitionTask:
         self.pull_len = pull_len   # reply bytes when not dense (telemetry)
         self.push_len = None       # actual pushed bytes (set by _do_push)
         self.lease = None          # arena lease for reply scratch (if any)
+        self.enqueue_t = None      # admission-wait clock (metrics)
 
     @property
     def key(self) -> int:
@@ -343,15 +384,28 @@ class PipelineScheduler:
 
     def __init__(self, client, num_threads: int = 8,
                  credit_bytes: int = 0, tracer=None, telemetry=None,
-                 config=None, arena=None):
+                 config=None, arena=None, metrics=None, profiler=None):
         import concurrent.futures
         import os
 
         self._client = client
-        self._queue = ScheduledQueue(credit_bytes)
+        self._queue = ScheduledQueue(credit_bytes, metrics=metrics,
+                                     profiler=profiler)
         self._tracer = tracer
         self._telemetry = telemetry
         self._config = config
+        # measurement plane (core/metrics.py): per-(stage, key-class)
+        # latency histograms cached locally so a stage completion is one
+        # dict lookup + one histogram record, never the registry lock;
+        # compression ratio counters accumulate pre/post wire bytes
+        self._metrics = metrics
+        self._profiler = profiler
+        self._stage_hists: Dict[tuple, Any] = {}
+        if metrics is not None:
+            self._comp_pre = metrics.counter("compress/bytes_pre")
+            self._comp_post = metrics.counter("compress/bytes_post")
+        else:
+            self._comp_pre = self._comp_post = None
         # persistent host staging arena (core/arena.py): reply scratch
         # for compressed pulls checks out of it instead of np.empty per
         # round; None = allocate fresh (the pre-arena behavior)
@@ -475,11 +529,40 @@ class PipelineScheduler:
     def _span(self, task, stage):
         return f"{stage}.{task.partition.index}"
 
+    @staticmethod
+    def _key_class(task) -> str:
+        """Traffic class for per-class stage metrics: "compressed" rides
+        the host codec stages, "wire" is a prebuilt payload (device-
+        compressed or rowsparse), "dense" everything else."""
+        if task.stack is not None:
+            return "compressed"
+        if task.wire is not None:
+            return "wire"
+        return "dense"
+
+    def _stage_done(self, task, stage: str, t0: float) -> None:
+        """One stage completion's measurement: per-(stage, class) log2
+        latency histogram + the active StepReport's stage sample."""
+        if self._metrics is None:
+            return
+        dt = time.perf_counter() - t0
+        key = (stage, self._key_class(task))
+        h = self._stage_hists.get(key)
+        if h is None:
+            h = self._metrics.histogram(
+                f"scheduler/{stage.lower()}_us/{key[1]}")
+            self._stage_hists[key] = h
+        h.record_seconds(dt)
+        prof = self._profiler.current() if self._profiler else None
+        if prof is not None:
+            prof.stage_sample(stage, dt)
+
     def _do_compress(self, task: PartitionTask) -> None:
         name = task.ctx.name
         span = self._span(task, "COMPRESS")
         if self._tracer:
             self._tracer.begin(name, span)
+        t0 = time.perf_counter()
         try:
             from ..server.compressed import compress_partition
             task.wire = compress_partition(task.stack, task.in_view,
@@ -490,6 +573,7 @@ class PipelineScheduler:
         finally:
             if self._tracer:  # end in finally: no dangling span on error
                 self._tracer.end(name, span)
+            self._stage_done(task, "COMPRESS", t0)
         self._submit_stage(self._push_pool, self._do_push, task)
 
     def _do_push(self, task: PartitionTask) -> None:
@@ -508,6 +592,7 @@ class PipelineScheduler:
             return
         if self._tracer:
             self._tracer.begin(name, span)
+        t0 = time.perf_counter()
         try:
             # async push: the payload hits the wire and the stage ends —
             # no ACK round-trip on the critical path (the pull is the
@@ -523,6 +608,7 @@ class PipelineScheduler:
         finally:
             if self._tracer:
                 self._tracer.end(name, span)
+            self._stage_done(task, "PUSH", t0)
         self._submit_stage(self._pull_pool, self._do_pull, task)
 
     def _do_pull(self, task: PartitionTask) -> None:
@@ -530,6 +616,7 @@ class PipelineScheduler:
         span = self._span(task, "PULL")
         if self._tracer:
             self._tracer.begin(name, span)
+        t0 = time.perf_counter()
         try:
             if task.stack is not None:
                 wb = task.stack.wire_bytes()
@@ -555,6 +642,7 @@ class PipelineScheduler:
         finally:
             if self._tracer:
                 self._tracer.end(name, span)
+            self._stage_done(task, "PULL", t0)
         if (task.stack is None and task.pull_len is None
                 and self._config is not None):
             # pull_len set = device-compressed wire reply: NOT dense
@@ -577,6 +665,7 @@ class PipelineScheduler:
         span = self._span(task, "DECOMPRESS")
         if self._tracer:
             self._tracer.begin(name, span)
+        t0 = time.perf_counter()
         try:
             from ..server.compressed import decompress_partition
             decompress_partition(task.stack, task.wire, task.out_view)
@@ -586,6 +675,7 @@ class PipelineScheduler:
         finally:
             if self._tracer:
                 self._tracer.end(name, span)
+            self._stage_done(task, "DECOMPRESS", t0)
         self._finish(task, None)
 
     def _finish(self, task: PartitionTask, err: Optional[Exception]) -> None:
@@ -614,6 +704,11 @@ class PipelineScheduler:
                 recvd = len(task.wire) if task.wire is not None \
                     else task.stack.wire_bytes()
                 self._telemetry.record(sent + recvd)
+                if self._comp_pre is not None:
+                    # dense-equivalent bytes vs actual wire bytes, both
+                    # directions: post/pre is the achieved wire ratio
+                    self._comp_pre.inc(task.nbytes * 2)
+                    self._comp_post.inc(sent + recvd)
             elif task.wire is not None:
                 # prebuilt payload up; reply is dense unless pull_len says
                 # otherwise (device-compressed pulls are wire-sized)
@@ -621,7 +716,7 @@ class PipelineScheduler:
                     else task.nbytes
                 self._telemetry.record(len(task.wire) + down)
             else:
-                self._telemetry.record(task.nbytes * 2)
+                self._telemetry.record_round_trip(task.nbytes)
         with self._inflight_mu:
             self._inflight -= 1
             if self._inflight == 0:

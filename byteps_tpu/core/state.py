@@ -22,6 +22,7 @@ import jax
 from ..config import Config
 from ..parallel import mesh as mesh_lib
 from ..utils.logging import log, refresh_level, bps_check
+from .metrics import MetricsRegistry, StepProfiler
 from .registry import TensorRegistry
 
 
@@ -40,8 +41,16 @@ class _Telemetry:
         self._window_bytes = 0
         self._last_sample = (0.0, 0.0)  # (timestamp, MB/s)
         self.enabled = True  # BYTEPS_TELEMETRY_ON; set by GlobalState.init
+        # registry mirror (core/metrics.py), set by GlobalState.init:
+        # every recorded byte also lands on the unified counter surface
+        self._wire_counter = None
+
+    def attach_metrics(self, metrics) -> None:
+        self._wire_counter = metrics.counter("pushpull/bytes_total")
 
     def record(self, nbytes: int) -> None:
+        if self._wire_counter is not None:
+            self._wire_counter.inc(int(nbytes))
         if not self.enabled:
             return
         with self._lock:
@@ -53,6 +62,14 @@ class _Telemetry:
                 self._last_sample = (now, mbps)
                 self._window_start = now
                 self._window_bytes = 0
+
+    def record_round_trip(self, nbytes: int) -> None:
+        """THE adapter byte-accounting entry point for a symmetric
+        push+pull round trip (``nbytes`` each way): one definition
+        behind one registry counter, so the mxnet/tf/jax async adapters
+        can't drift apart in how they count wire bytes (they used to
+        hand-roll ``record(nbytes * 2)`` each)."""
+        self.record(int(nbytes) * 2)
 
     def speed(self) -> tuple:
         with self._lock:
@@ -122,6 +139,12 @@ class GlobalState:
         self.initialized = False
         self.suspended = False
         self.telemetry = _Telemetry()
+        # unified metrics registry + per-step pipeline profiler
+        # (core/metrics.py); replaced fresh at init() so counters start
+        # clean per lifecycle, like the arena
+        self.metrics = MetricsRegistry()
+        self.profiler = StepProfiler()
+        self._metrics_server = None  # BYTEPS_METRICS_PORT http server
         self.tracer = None           # set lazily by utils.tracing
         self._jax_profiling = False  # jax.profiler trace active
         self.ps_client = None        # set by server.client when PS configured
@@ -163,6 +186,13 @@ class GlobalState:
             from .arena import StagingArena
             self.arena = StagingArena(enabled=self.config.staging_arena)
             self.telemetry.attach_arena(self.arena)
+            # fresh metrics plane per init (counters clean per
+            # lifecycle, like the arena); live sections collect the
+            # arena/export counters at snapshot time — one source of
+            # truth, no double accounting
+            self.metrics = MetricsRegistry(enabled=self.config.metrics_on)
+            self.telemetry.attach_metrics(self.metrics)
+            self.metrics.section("arena", self.telemetry.arena_stats)
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.
@@ -207,6 +237,15 @@ class GlobalState:
                 # (Chrome-trace events stay gated on trace_on's window)
                 from ..utils.tracing import Tracer
                 self.tracer = Tracer(self.config)
+            # per-step pipeline profiler rides the same lifecycle as the
+            # registry; the tracer reference mirrors aggregate counters
+            # into the Chrome trace as counter events
+            self.profiler = StepProfiler(
+                window=self.config.step_report_window,
+                enabled=self.config.metrics_on,
+                stall_diag=self.config.stall_diag,
+                tracer=self.tracer)
+            self.metrics.section("steps", self.profiler.snapshot)
             if self.config.jax_profiler_dir and not self._jax_profiling:
                 # device (XLA) trace for TensorBoard/Perfetto alongside
                 # the Chrome comm timeline (SURVEY §5.1 TPU note); host
@@ -238,13 +277,25 @@ class GlobalState:
                     and self.config.role == "worker"):
                 from ..server.client import connect_from_config
                 self.ps_client = connect_from_config(self.config)
+                self.ps_client.attach_metrics(self.metrics)
                 from .scheduler import HandleManager, PipelineScheduler
                 self.scheduler = PipelineScheduler(
                     self.ps_client,
                     credit_bytes=self.config.scheduling_credit,
                     tracer=self.tracer, telemetry=self.telemetry,
-                    config=self.config, arena=self.arena)
+                    config=self.config, arena=self.arena,
+                    metrics=self.metrics, profiler=self.profiler)
                 self.handles = HandleManager()
+            if self.config.metrics_port > 0 and self._metrics_server is None:
+                from .metrics import start_http_server
+                try:
+                    self._metrics_server = start_http_server(
+                        lambda: self.metrics, self.config.metrics_port)
+                    log.info("metrics endpoint on 127.0.0.1:%d/metrics",
+                             self.config.metrics_port)
+                except Exception as e:  # noqa: BLE001 - metrics are aux
+                    log.warning("metrics HTTP server failed to start: %s",
+                                e)
             self.initialized = True
             self.suspended = False
             log.info("byteps_tpu initialized: rank=%d size=%d devices=%d mesh=%s",
@@ -260,6 +311,13 @@ class GlobalState:
                 except Exception:  # noqa: BLE001 - best-effort teardown
                     pass
                 self.ps_client = None
+            if self._metrics_server is not None:
+                try:
+                    self._metrics_server.shutdown()
+                    self._metrics_server.server_close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+                self._metrics_server = None
             if self.tracer is not None:
                 self.tracer.flush()
             if self._jax_profiling:

@@ -597,6 +597,11 @@ def make_ps_train_step(
             loss, grads = grad_fn(params, batch)
             params, opt_state = apply_fn(params, opt_state, grads)
             return params, opt_state, loss
+        # per-step pipeline profile (core/metrics.py): the scheduler's
+        # stage threads feed samples into this builder; end_step below
+        # closes it into the StepReport ring (+ stall diagnosis when
+        # BYTEPS_STALL_DIAG=1). None when metrics are off.
+        prof = state.profiler.begin_step()
         # names/shapes come from the params tree (value_and_grad gives
         # gradients the identical structure), so the whole export plan
         # exists BEFORE the backward is dispatched — the streamed taps
@@ -617,7 +622,14 @@ def make_ps_train_step(
                 state, client, comp_state, compression,
                 min_compress_bytes, rowsparse_params, names,
                 jax.tree.leaves(grads), treedef)
+            if prof is not None:
+                # device tier: the round is monolithic (compute + wire
+                # inside one helper), so compute_ms covers through the
+                # round and the apply is the tail
+                prof.mark("export_done")
+                prof.mark("drain_done")
             params, opt_state = apply_fn(params, opt_state, grads)
+            state.profiler.end_step(prof, fallback=len(names))
             return params, opt_state, loss
         # ---- host tier: dense D2H (streamed where possible), codecs
         # in numpy ----
@@ -940,6 +952,11 @@ def make_ps_train_step(
                     flush_bucket()
                     waiters.append((i, *submit(name, h.reshape(-1))))
             flush_bucket()
+            if prof is not None:
+                # every leaf is now off the device and submitted (each
+                # np.asarray above blocked on ITS leaf): the compute +
+                # export wall of this step's report
+                prof.mark("export_done")
             shapes = [np.shape(leaf) for leaf in g_leaves]
             # Completion-ordered drain — IMPORT + UPDATE: issue the
             # async H2D device_put for each leaf THE MOMENT its pull
@@ -961,16 +978,31 @@ def make_ps_train_step(
                         lambda *_a, wi=wi: ready.put(wi))
 
             sa_round = sa.begin(opt_state) if sa is not None else None
+            # per-leaf PULL→H2D→UPDATE drain spans (the ISSUE's
+            # measurement of the import half of the pipeline): each
+            # land() is one leaf's H2D issue + sharded-update dispatch
+            h2d_hist = state.metrics.histogram("step/h2d_update_us")
 
             def land(s, piece):
+                t0 = _time.perf_counter()
                 arr = jax.device_put(piece.reshape(shapes[s]))
                 imported[s] = arr
                 if sa_round is not None:
                     new_params[s], apply_parts[s] = sa_round.apply(
                         p_leaves[s], s, arr)
+                dt = _time.perf_counter() - t0
+                h2d_hist.record_seconds(dt)
+                if prof is not None:
+                    prof.stage_sample("H2D_UPDATE", dt)
 
             for _ in range(len(waiters)):
-                slot, finish, _ = waiters[ready.get()]
+                t_wait = _time.perf_counter()
+                wi = ready.get()
+                if prof is not None:
+                    # time the drain sat blocked waiting for a pull to
+                    # land — the direct "PULL is the bottleneck" signal
+                    prof.add_pull_wait(_time.perf_counter() - t_wait)
+                slot, finish, _ = waiters[wi]
                 if isinstance(slot, list):
                     for s, piece in zip(slot, finish()):
                         land(s, piece)
@@ -982,6 +1014,8 @@ def make_ps_train_step(
                 # idle before release
                 jax.block_until_ready([x for x in imported
                                        if x is not None])
+            if prof is not None:
+                prof.mark("drain_done")
         except BaseException:
             # a failed round (submission OR drain) may leave pulls
             # mid-flight into these slots: abandon (drop from the
@@ -1051,6 +1085,12 @@ def make_ps_train_step(
                 lease.release()
             grads = treedef.unflatten(imported)
             params, opt_state = apply_fn(params, opt_state, grads)
+        n_streamed = round_obj.streamed if round_obj is not None else 0
+        state.profiler.end_step(
+            prof,
+            ttfp_ms=first_push[0] * 1e3 if first_push[0] is not None
+            else None,
+            streamed=n_streamed, fallback=len(names) - n_streamed)
         return params, opt_state, loss
 
     # tick the Chrome-trace step counter: the PUSH/PULL/COMPRESS spans the
@@ -1135,7 +1175,7 @@ def make_async_ps_train_step(
         def one(item):
             ctx, leaf, d = item
             out = client.push_delta_pull_weights(ctx, d)
-            state.telemetry.record(out.nbytes * 2)
+            state.telemetry.record_round_trip(out.nbytes)
             return jnp.asarray(out.reshape(leaf.shape))
 
         pulled = list(_comp_pool().map(one, leaves))

@@ -161,7 +161,7 @@ class DistributedOptimizer:
                 j[1], j[2]), jobs))
         for (d, _, _), out in zip(jobs, outs):
             d[:] = out.reshape(d.shape)
-        state.telemetry.record(sum(j[2].nbytes * 2 for j in jobs))
+        state.telemetry.record_round_trip(sum(j[2].nbytes for j in jobs))
 
     def _update_impl(self, index, weight, grad, state, multi: bool):
         upd = (self._optimizer.update_multi_precision if multi

@@ -131,7 +131,7 @@ def ps_round_trip(state, name: str, host: np.ndarray,
     res = state.ps_client.push_pull(
         ctx, host, average=average, num_workers=state.config.num_workers,
         out=out)
-    state.telemetry.record(host.nbytes * 2)
+    state.telemetry.record_round_trip(host.nbytes)
     return res
 
 
@@ -161,6 +161,24 @@ class PSClient:
         # (server-side initialization is per-store, distinct from registry
         # declaration; a resize needs a fresh init push)
         self._inited_keys: dict = {}
+        # wire-layer instrument refs (core/metrics.py), attached by
+        # GlobalState.init after connect; None = uninstrumented (direct
+        # construction in tests/benches)
+        self._m_push_req = self._m_push_bytes = None
+        self._m_pull_req = self._m_pull_bytes = None
+        self._m_errors = None
+
+    def attach_metrics(self, metrics) -> None:
+        """Cache wire counters off the registry: every ZPush/ZPull
+        request and its payload bytes land on the unified surface
+        (``wire/*`` — request counts, bytes each way, failed requests;
+        the native transport has no app-level retry, so ``wire/errors``
+        is the retry-pressure signal)."""
+        self._m_push_req = metrics.counter("wire/push_requests")
+        self._m_push_bytes = metrics.counter("wire/push_bytes")
+        self._m_pull_req = metrics.counter("wire/pull_requests")
+        self._m_pull_bytes = metrics.counter("wire/pull_bytes")
+        self._m_errors = metrics.counter("wire/errors")
 
     @property
     def ipc_conns(self) -> int:
@@ -186,7 +204,12 @@ class PSClient:
         data = np.ascontiguousarray(data)  # .ctypes.data of a strided
         rc = self._lib.bps_client_push(   # view points at the base buffer
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+        if self._m_push_req is not None:
+            self._m_push_req.inc()
+            self._m_push_bytes.inc(data.nbytes)
         if rc != 0:
+            if self._m_errors is not None:
+                self._m_errors.inc()
             raise RuntimeError(f"push failed key={key}")
 
     def zpush_async(self, server: int, key: int, data: np.ndarray,
@@ -201,7 +224,12 @@ class PSClient:
         data = np.ascontiguousarray(data)
         rc = self._lib.bps_client_push_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+        if self._m_push_req is not None:
+            self._m_push_req.inc()
+            self._m_push_bytes.inc(data.nbytes)
         if rc != 0:
+            if self._m_errors is not None:
+                self._m_errors.inc()
             raise RuntimeError(f"async push failed key={key}")
 
     def zpull(self, server: int, key: int, out: np.ndarray,
@@ -215,8 +243,14 @@ class PSClient:
             raise ValueError("zpull requires a C-contiguous output array")
         rc = self._lib.bps_client_pull(
             self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
+        if self._m_pull_req is not None:
+            self._m_pull_req.inc()
         if rc < 0:
+            if self._m_errors is not None:
+                self._m_errors.inc()
             raise RuntimeError(f"pull failed key={key}")
+        if self._m_pull_bytes is not None:
+            self._m_pull_bytes.inc(rc)  # actual reply length
         return rc
 
     def comp_init(self, server: int, key: int, kwargs_wire: str) -> None:

@@ -281,7 +281,7 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
                                           np.float32).reshape(-1)
             ctx = get_or_init_ctx(state, name, host_d)
             out = client.push_delta_pull_weights(ctx, host_d)
-            state.telemetry.record(out.nbytes * 2)
+            state.telemetry.record_round_trip(out.nbytes)
             return tf.constant(
                 out.reshape(tuple(d_t.shape)).astype(
                     d_t.dtype.as_numpy_dtype()))

@@ -103,6 +103,21 @@ class Tracer:
                 "pid": os.getpid(), "tid": name, "args": {"tensor": name},
             })
 
+    def counter(self, name: str, values: dict) -> None:
+        """Chrome-trace counter event (``ph: "C"``): Perfetto renders
+        each key of ``values`` as a stacked counter track alongside the
+        comm spans — how queue depth and per-step stage aggregates from
+        the metrics plane (core/metrics.py StepProfiler) appear in the
+        same timeline. Gated on the trace window like span events."""
+        if not self._active():
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": "comm", "ph": "C",
+                "ts": self._us(), "pid": os.getpid(),
+                "args": dict(values),
+            })
+
     def instant(self, name: str, stage: str) -> None:
         if not self._active():
             return

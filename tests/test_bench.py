@@ -70,6 +70,10 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
         if name == "arena_ab":
             return {"arena_on_step_ms": 5.0,
                     "arena_off_step_ms": 6.5}, None
+        if name == "metrics_ab":
+            return {"metrics_on_step_ms": 5.1,
+                    "metrics_off_step_ms": 5.0,
+                    "metrics_overhead_pct": 2.0}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8,
@@ -81,6 +85,8 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
     assert out["value"] == 100000.0
+    assert out["metrics_on_step_ms"] == 5.1
+    assert out["metrics_overhead_pct"] == 2.0
     assert out["stream_on_step_ms"] == 4.0
     assert out["stream_ttfp_on_ms"] == 0.9
     assert out["pushpull_throttled_2srv_gbps"] == 0.2
@@ -113,6 +119,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
         if name == "arena_ab":
             return {"arena_on_step_ms": 5.0,
                     "arena_off_step_ms": 6.5}, None
+        if name == "metrics_ab":
+            return {"metrics_on_step_ms": 5.1,
+                    "metrics_off_step_ms": 5.0,
+                    "metrics_overhead_pct": 2.0}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8}, None
@@ -133,11 +143,12 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    assert calls.count("probe") == 7 + n_final
+    assert calls.count("probe") == 8 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull", "after_pushpull_2srv",
-        "after_pushpull_throttled", "after_arena_ab", "after_stream_ab",
+        "after_pushpull_throttled", "after_arena_ab",
+        "after_metrics_ab", "after_stream_ab",
         "after_scaling",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
@@ -258,8 +269,8 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
     skipped = {k: v for k, v in out["phase_errors"].items()
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
-                            "pushpull_throttled", "arena_ab", "stream_ab",
-                            "scaling"}
+                            "pushpull_throttled", "arena_ab", "metrics_ab",
+                            "stream_ab", "scaling"}
 
 
 def test_partial_snapshots_survive_a_kill(bench, monkeypatch, capsys):

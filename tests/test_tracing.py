@@ -1,0 +1,135 @@
+"""Chrome-trace Tracer window edges (utils/tracing.py): a span that
+straddles trace_end_step must still close its TraceAnnotation (or every
+later annotation on that pool thread nests inside the orphan forever),
+the dump must stay valid JSON after an abnormal (exception) span exit,
+and counter events ride the same window as spans."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from byteps_tpu.config import Config
+from byteps_tpu.utils.tracing import Tracer
+
+
+class _FakeAnnotation:
+    """Stand-in for jax.profiler.TraceAnnotation that records its
+    enter/exit balance (the real one is opaque)."""
+
+    instances = []
+
+    def __init__(self, name):
+        self.name = name
+        self.entered = 0
+        self.exited = 0
+        _FakeAnnotation.instances.append(self)
+
+    def __enter__(self):
+        self.entered += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.exited += 1
+        return False
+
+
+@pytest.fixture(autouse=True)
+def _fresh_annotations(monkeypatch):
+    _FakeAnnotation.instances = []
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", _FakeAnnotation)
+    yield
+
+
+def _tracer(tmp_path, **kw):
+    cfg = Config(trace_on=True, trace_start_step=0, trace_end_step=2,
+                 trace_dir=str(tmp_path), jax_profiler_dir=str(tmp_path),
+                 **kw)
+    return Tracer(cfg)
+
+
+def test_span_straddling_window_end_closes_annotation(tmp_path):
+    tr = _tracer(tmp_path)
+    tr.step()  # step 1, inside window
+    tr.begin("t0", "PUSH.0")
+    assert len(_FakeAnnotation.instances) == 1
+    ann = _FakeAnnotation.instances[0]
+    assert ann.entered == 1
+    # the window closes while the span is still open (a slow partition
+    # finishing after trace_end_step — the straddle case)
+    tr.step()
+    tr.step()  # step 3 > trace_end_step: flush fired, window closed
+    tr.end("t0", "PUSH.0")
+    assert ann.exited == 1, \
+        "annotation must close even though the trace window ended"
+    # and the flushed file is valid JSON
+    out = tr.flush()
+    if out is not None:  # events were flushed by step(); path may repeat
+        with open(out) as f:
+            json.load(f)
+
+
+def test_dump_valid_json_after_abnormal_span_exit(tmp_path):
+    tr = _tracer(tmp_path)
+    tr.step()
+    # normal complete span
+    tr.begin("good", "PULL.0")
+    tr.end("good", "PULL.0")
+    # abnormal exit: the stage body raises; end() still runs from the
+    # stage's finally (scheduler discipline) with the error in flight
+    tr.begin("bad", "PUSH.0")
+    try:
+        raise RuntimeError("stage exploded")
+    except RuntimeError:
+        tr.end("bad", "PUSH.0")
+    # orphan: begin with NO end at all (a crashed pool thread)
+    tr.begin("orphan", "COMPRESS.0")
+    out = tr.flush()
+    assert out is not None and os.path.exists(out)
+    with open(out) as f:
+        data = json.load(f)
+    names = {(e["tid"], e["name"]) for e in data["traceEvents"]
+             if e["ph"] == "X"}
+    assert ("good", "PULL.0") in names
+    assert ("bad", "PUSH.0") in names, \
+        "the abnormal-exit span must still record a complete event"
+    assert ("orphan", "COMPRESS.0") not in names, \
+        "an orphan open span must not emit a bogus event"
+
+
+def test_double_begin_closes_orphan_annotation(tmp_path):
+    tr = _tracer(tmp_path)
+    tr.step()
+    tr.begin("t", "PUSH.0")
+    first = _FakeAnnotation.instances[0]
+    tr.begin("t", "PUSH.0")  # double-begin without end
+    assert first.exited == 1, \
+        "the orphan annotation must close before the new one enters"
+    second = _FakeAnnotation.instances[1]
+    tr.end("t", "PUSH.0")
+    assert second.exited == 1
+
+
+def test_counter_events_ride_the_window(tmp_path):
+    tr = _tracer(tmp_path)
+    tr.step()
+    tr.counter("bps:queue_depth_peak", {"depth": 7})
+    for _ in range(3):
+        tr.step()  # leave the window
+    tr.counter("bps:queue_depth_peak", {"depth": 99})  # dropped
+    out = tr.flush(path=str(tmp_path / "late"))
+    with open(out) as f:
+        data = json.load(f)
+    counters = [e for e in data["traceEvents"] if e["ph"] == "C"]
+    assert len(counters) == 1
+    assert counters[0]["args"] == {"depth": 7}
+
+
+def test_flush_with_no_events_returns_none(tmp_path):
+    cfg = Config(trace_on=True, trace_start_step=5, trace_end_step=6,
+                 trace_dir=str(tmp_path))
+    tr = Tracer(cfg)
+    tr.begin("t", "PUSH.0")  # outside window, no profiler dir: no-op
+    tr.end("t", "PUSH.0")
+    assert tr.flush() is None
