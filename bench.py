@@ -668,6 +668,94 @@ def phase_metrics_ab(steps: int = 6, reps: int = 3) -> dict:
                 for k, v in last.items()}}
 
 
+def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the fused PUSHPULL wire op (BYTEPS_FUSED_PUSHPULL,
+    native/ps.cc PUSHPULL + the completion-reactor client) on the PS
+    train step's steady state: the same model/batch trained through the
+    loopback PS with the fused single-message round trip vs the two-op
+    push+pull pair, INTERLEAVED reps (host-load drift lands on both
+    arms), best-of step wall per arm.
+
+    Wall-clock on a 2-core loopback box flakes — both arms move the
+    same bytes through the same CPUs — so the phase ALSO carries a
+    DETERMINISTIC proof from the ``wire/*`` counters: fused mode must
+    send exactly HALF the request messages per round (one PUSHPULL vs a
+    push + a pull per partition), asserted hard; payload bytes must
+    match both ways. The JSON reports both walls, both message counts
+    and the ratio."""
+    import gc
+
+    def run(fused: bool, walls: list):
+        os.environ["BYTEPS_FUSED_PUSHPULL"] = "1" if fused else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # the metrics_ab layout: 4MB leaves ride their own keys,
+            # biases keep the fused-bucket path in the measurement
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            return bps.get_metrics()["counters"]
+
+    prior = os.environ.get("BYTEPS_FUSED_PUSHPULL")
+    on_walls, off_walls = [], []
+    c_on = c_off = None
+    try:
+        for _ in range(reps):
+            c_on = run(True, on_walls)
+            c_off = run(False, off_walls)
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_FUSED_PUSHPULL", None)
+        else:
+            os.environ["BYTEPS_FUSED_PUSHPULL"] = prior
+    fused_msgs = c_on["wire/pushpull_requests"] + \
+        c_on["wire/push_requests"] + c_on["wire/pull_requests"]
+    twoop_msgs = c_off["wire/pushpull_requests"] + \
+        c_off["wire/push_requests"] + c_off["wire/pull_requests"]
+    # the deterministic wire-efficiency proof (counters from the LAST
+    # rep of each arm — identical round counts by construction)
+    assert c_off["wire/pushpull_requests"] == 0, c_off
+    assert c_on["wire/push_requests"] == 0, c_on
+    assert fused_msgs * 2 == twoop_msgs, (fused_msgs, twoop_msgs)
+    assert c_on["wire/push_bytes"] == c_off["wire/push_bytes"], \
+        (c_on, c_off)
+    return {"wire_fused_step_ms": round(min(on_walls) * 1e3, 2),
+            "wire_twoop_step_ms": round(min(off_walls) * 1e3, 2),
+            "wire_fused_requests": int(fused_msgs),
+            "wire_twoop_requests": int(twoop_msgs),
+            "wire_request_ratio": round(fused_msgs / twoop_msgs, 4),
+            "wire_half_proof": True}
+
+
 def phase_stream_ab(steps: int = 6, reps: int = 4,
                     throttle_mbps: float = 400.0) -> dict:
     """A/B the COMPUTE/PUSH/UPDATE pipeline (BYTEPS_STREAM_EXPORT +
@@ -1017,6 +1105,7 @@ _PHASES = {
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
+    "wire_ab": phase_wire_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
 }
@@ -1127,6 +1216,9 @@ def main() -> None:
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
         "stream_ttfp_off_ms": None,
+        "wire_fused_step_ms": None,
+        "wire_twoop_step_ms": None,
+        "wire_request_ratio": None,
         "scaling_efficiency_2w": None,
     }
     errors = {}
@@ -1273,6 +1365,10 @@ def main() -> None:
                             # export + sharded apply on vs off, step
                             # wall + time-to-first-push
                             ("stream_ab", 240.0),
+                            # fused PUSHPULL wire-op A/B: one message
+                            # vs push+pull pair, plus the deterministic
+                            # half-the-request-messages counter proof
+                            ("wire_ab", 240.0),
                             # scaling deadline sized for 6 server+worker
                             # launches (3 interleaved 1w/2w reps,
                             # 200-step windows, best-of-3 per config)

@@ -121,6 +121,17 @@ class Config:
     # 0 disables. ---
     fusion_bytes: int = DEFAULT_FUSION_BYTES  # BYTEPS_FUSION_BYTES
 
+    # --- fused wire op (rebuild addition; THC, arxiv 2302.08545: the PS
+    # exchange is ONE aggregation round trip). On: the scheduler's PUSH
+    # and PULL stages collapse into a single non-blocking WIRE stage —
+    # one fused PUSHPULL message per partition per round (half the
+    # request messages), with the reply landed by a completion reactor
+    # (one thread per client, O(connections)) instead of a thread parked
+    # in recv per in-flight partition. Off: the two-op push+pull path
+    # (required against servers that predate the PUSHPULL op; numerics
+    # identical either way). ---
+    fused_pushpull: bool = True           # BYTEPS_FUSED_PUSHPULL
+
     # --- async / elastic (server.cc:434-436) ---
     enable_async: bool = False            # BYTEPS_ENABLE_ASYNC
 
@@ -189,6 +200,7 @@ class Config:
             sharded_apply=_env_bool("BYTEPS_SHARDED_APPLY", True),
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
+            fused_pushpull=_env_bool("BYTEPS_FUSED_PUSHPULL", True),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),

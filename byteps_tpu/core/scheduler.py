@@ -6,10 +6,11 @@ host-thread pipeline exists because every stage (NCCL, D2H, compress, push)
 must be hand-overlapped; on TPU, XLA owns everything on-device, so the host
 pipeline shrinks to the stages that actually cross the DCN boundary:
 
-    EXPORT (device->host) -> PUSH -> PULL -> IMPORT (host->device)
+    EXPORT (device->host) -> WIRE (fused PUSHPULL) -> IMPORT (host->device)
 
-with per-partition tasks, priority scheduling and credit-based admission
-exactly as the reference's worker side does it:
+(the two-op PUSH -> PULL pair remains as the BYTEPS_FUSED_PUSHPULL=0 /
+old-server fallback) with per-partition tasks, priority scheduling and
+credit-based admission exactly as the reference's worker side does it:
 
 - ``ScheduledQueue``: tasks ordered by (priority desc, key asc)
   (scheduled_queue.cc:82-102), admitted while the in-flight byte credit
@@ -370,16 +371,25 @@ class PipelineScheduler:
 
     The priority queue decides admission order and the credit bounds
     in-flight bytes; once admitted, a partition flows through independent
-    per-stage thread pools with continuation passing —
+    per-stage thread pools with continuation passing. Default (fused,
+    BYTEPS_FUSED_PUSHPULL):
+
+        [COMPRESS ->] WIRE [-> DECOMPRESS]
+
+    — WIRE submits ONE fused PUSHPULL message and returns its thread to
+    the pool; the reply lands via the client's completion reactor, which
+    runs DECOMPRESS/finish. No thread parks per in-flight key, so
+    concurrent partitions are bounded by scheduling credit, not pool
+    size. Two-op fallback (old servers / BYTEPS_FUSED_PUSHPULL=0):
 
         [COMPRESS ->] PUSH -> PULL [-> DECOMPRESS]
 
-    — so the PULL of partition k overlaps the PUSH of partition k+1 (the
+    — the PULL of partition k overlaps the PUSH of partition k+1 (the
     reference runs PUSH and PULL as separate stage loops with callbacks,
-    core_loops.cc:538-618) and codec work never blocks a network thread
-    (COMPRESS/DECOMPRESS spliced into the pipeline as in
-    operations.cc:199-204). Credit is held from admission until PULL (and
-    DECOMPRESS, if any) completes.
+    core_loops.cc:538-618). Either way codec work never blocks a network
+    thread (COMPRESS/DECOMPRESS spliced into the pipeline as in
+    operations.cc:199-204) and credit is held from admission until the
+    reply (and DECOMPRESS, if any) completes.
     """
 
     def __init__(self, client, num_threads: int = 8,
@@ -389,6 +399,22 @@ class PipelineScheduler:
         import os
 
         self._client = client
+        # Fused PUSHPULL (BYTEPS_FUSED_PUSHPULL, default on): PUSH and
+        # PULL collapse into ONE non-blocking WIRE stage — submit the
+        # fused op, return the thread to the pool, and run the finish
+        # (or DECOMPRESS) from the client's completion-reactor callback.
+        # In-flight partitions are then bounded by scheduling credit,
+        # not by pull-pool thread count. Requires the client to speak
+        # the fused op (old servers / fake test clients fall back to
+        # the two-op path).
+        if config is not None:
+            fused_flag = getattr(config, "fused_pushpull", True)
+        else:
+            fused_flag = os.environ.get(
+                "BYTEPS_FUSED_PUSHPULL", "1").lower() not in (
+                "0", "false", "off", "no")
+        self._fused = bool(fused_flag) and getattr(
+            client, "supports_fused", False)
         self._queue = ScheduledQueue(credit_bytes, metrics=metrics,
                                      profiler=profiler)
         self._tracer = tracer
@@ -510,6 +536,8 @@ class PipelineScheduler:
                 self._inflight += 1
             if task.stack is not None:
                 self._submit_stage(self._codec_pool, self._do_compress, task)
+            elif self._fused:
+                self._submit_stage(self._push_pool, self._do_wire, task)
             else:
                 self._submit_stage(self._push_pool, self._do_push, task)
 
@@ -574,7 +602,101 @@ class PipelineScheduler:
             if self._tracer:  # end in finally: no dangling span on error
                 self._tracer.end(name, span)
             self._stage_done(task, "COMPRESS", t0)
-        self._submit_stage(self._push_pool, self._do_push, task)
+        if self._fused:
+            self._submit_stage(self._push_pool, self._do_wire, task)
+        else:
+            self._submit_stage(self._push_pool, self._do_push, task)
+
+    def _do_wire(self, task: PartitionTask) -> None:
+        """The fused WIRE stage (BYTEPS_FUSED_PUSHPULL): one PUSHPULL
+        message replaces the PUSH send + blocking PULL pair. The stage
+        thread only BUILDS the request and hands it to the wire — the
+        reply lands in the (arena-leased) buffer from the client's
+        native recv loop, and the completion reactor runs the
+        continuation (DECOMPRESS/finish). Stage accounting moves onto
+        completion timestamps: the PUSH sample is the send wall, the
+        PULL sample is submit→completion (exactly what the blocking
+        pull used to measure: wire + server aggregation wait)."""
+        name = task.ctx.name
+        span = self._span(task, "PUSHPULL")
+        try:
+            buf = task.wire if task.wire is not None else task.in_view
+            task.push_len = len(buf)  # actual bytes (varint wires vary)
+            if (self._config is not None and task.stack is None
+                    and task.in_view is not None):
+                from ..utils.logging import debug_sample
+                debug_sample(self._config, name, span,
+                             task.in_view, task.ctx.dtype.np_dtype)
+            # reply staging (the old _do_pull's buffer selection):
+            # compressed tasks land the wire reply in arena scratch,
+            # everything else straight into the caller's output view
+            if task.stack is not None:
+                wb = task.stack.wire_bytes()
+                if self._arena is not None:
+                    task.lease = self._arena.checkout(
+                        f"pull:{task.key}", wb)
+                    reply = task.lease.buf
+                else:
+                    reply = np.empty(wb, np.uint8)
+            else:
+                reply = task.out_view
+        except Exception as e:  # noqa: BLE001 - forwarded to waiter
+            self._finish(task, e)
+            return
+        # dense/rowsparse replies are the whole partition — a short
+        # reply must fail, not leave the output tail unwritten; wire
+        # (device-compressed) and codec replies are variable-length
+        exact = task.stack is None and task.pull_len is None
+        if self._tracer:
+            # end() runs on the reactor thread: skip the per-thread
+            # profiler-annotation mirror, keep the Chrome-trace span
+            self._tracer.begin(name, span, cross_thread=True)
+        t0 = time.perf_counter()
+
+        def _complete_dense(t: PartitionTask) -> None:
+            # runs on a pull-pool thread (idle in fused mode): the
+            # per-tensor finish work — debug sampling and, on the last
+            # partition, the averaging divide + handle done-callbacks —
+            # must not serialize on the single reactor thread
+            if (t.pull_len is None and self._config is not None):
+                try:
+                    from ..utils.logging import debug_sample
+                    debug_sample(self._config, name, span,
+                                 t.out_view, t.ctx.dtype.np_dtype)
+                except Exception as e:  # noqa: BLE001
+                    self._finish(t, e)
+                    return
+            self._finish(t, None)
+
+        def on_done(got: int, err) -> None:
+            if self._tracer:
+                self._tracer.end(name, span)
+            self._stage_done(task, "PULL", t0)
+            if err is None and exact and got != len(reply):
+                err = RuntimeError(
+                    f"fused pushpull reply for {name!r} key={task.key} is "
+                    f"{got} bytes, expected {len(reply)}")
+            if err is not None:
+                self._finish(task, err)
+                return
+            if task.stack is not None:
+                task.wire = reply[:got]  # variable-length wires (varint)
+                self._submit_stage(self._codec_pool, self._do_decompress,
+                                   task)
+                return
+            self._submit_stage(self._pull_pool, _complete_dense, task)
+
+        try:
+            self._client.zpushpull_async(task.partition.server, task.key,
+                                         buf, reply, task.cmd, on_done)
+        except Exception as e:  # noqa: BLE001
+            if self._tracer:
+                self._tracer.end(name, span)
+            self._finish(task, e)
+            return
+        # send wall only — the request is on the wire and this thread is
+        # free; the aggregation wait shows up in the PULL sample above
+        self._stage_done(task, "PUSH", t0)
 
     def _do_push(self, task: PartitionTask) -> None:
         name = task.ctx.name
@@ -634,8 +756,11 @@ class PipelineScheduler:
                                          reply, task.cmd_pull)
                 task.wire = reply[:got]  # variable-length wires (varint)
             else:
+                # dense/rowsparse replies must fill the whole view; wire
+                # (device-compressed) replies are pull_len-sized
                 self._client.zpull(task.partition.server, task.key,
-                                   task.out_view, task.cmd_pull)
+                                   task.out_view, task.cmd_pull,
+                                   exact=task.pull_len is None)
         except Exception as e:  # noqa: BLE001
             self._finish(task, e)
             return

@@ -94,6 +94,15 @@ enum Op : uint8_t {
   COMP_INIT = 8,  // per-key compressor kwargs (operations.cc:396-408)
   IPC_HELLO = 9,  // colocated shm-transport upgrade (BYTEPS_ENABLE_IPC)
   IPC_CONFIRM = 10,  // client commit of the upgrade (3rd handshake leg)
+  // Fused push+pull in ONE wire message (the THC observation, arxiv
+  // 2302.08545: the PS exchange is a single aggregation round trip).
+  // The payload is folded exactly like PUSH; the reply is withheld and
+  // parked alongside parked pulls, streaming to every fused requester
+  // the moment the aggregation round completes. Replaces a
+  // PUSH + PULL pair (two wire transitions, one thread parked in recv
+  // for the aggregation wait) with one request and a completion-queue
+  // reply. A push-stage error replies ACK with flags=1 instead.
+  PUSHPULL = 11,
 };
 
 enum ReqType : uint32_t {
@@ -1591,8 +1600,16 @@ class Server {
         case INIT_PUSH: DoInit(m); break;
         case PUSH: DoPush(m); break;
         case PULL: DoPull(m); break;
+        case PUSHPULL: DoPush(m, /*fused=*/true); break;
         case COMP_INIT: DoCompInit(m); break;
-        default: break;
+        default:
+          // Unknown op (version skew: a newer client against this
+          // server). Error-reply instead of dropping — a fused client
+          // would otherwise wait out its full request timeout on a
+          // request this server can never answer.
+          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          m.conn->send_msg(r, nullptr);
+          break;
       }
     }
   }
@@ -1789,7 +1806,26 @@ class Server {
     return false;
   }
 
-  void DoPushCompressed(EngineMsg& m, KeyStore& ks) {
+  // Fused PUSHPULL tail after a SUCCESSFUL fold: park the reply
+  // alongside the parked pulls, or answer it now when this worker's
+  // contribution is already covered (it completed the round, or async
+  // mode). Runs its readiness check in its own ks.mu section — if a
+  // peer completes the round between the fold's unlock and this lock,
+  // the parked_pulls flush ran without us but the re-check then sees
+  // completed_rounds caught up and answers immediately, so the race is
+  // benign (no lost reply).
+  void FusedReply(KeyStore& ks, EngineMsg& m, bool compressed) {
+    bool ready;
+    {
+      std::lock_guard<std::mutex> lk(ks.mu);
+      ready = PullReady(ks, m.sender);
+      if (!ready)
+        ks.parked_pulls.push_back({m.conn, m.rid, m.sender, compressed});
+    }
+    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender, compressed});
+  }
+
+  void DoPushCompressed(EngineMsg& m, KeyStore& ks, bool fused) {
     std::vector<ParkedPull> flush;
     {
       std::lock_guard<std::mutex> lk(ks.mu);
@@ -1947,12 +1983,17 @@ class Server {
       }
     }
   ack:
-    MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
-    m.conn->send_msg(r, nullptr);
+    if (!fused) {
+      MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+      m.conn->send_msg(r, nullptr);
+    }
     for (auto& p : flush) AnswerPull(ks, p);
+    // fused: the compressed-wire aggregate IS the reply — parked (or
+    // answered now) instead of the push ACK
+    if (fused) FusedReply(ks, m, /*compressed=*/true);
   }
 
-  void DoPushSparse(EngineMsg& m, KeyStore& ks) {
+  void DoPushSparse(EngineMsg& m, KeyStore& ks, bool fused) {
     // kRowSparsePushPull — the op the reference reserves but never
     // implements (common.h:267-271, server.h:39-41). Self-describing
     // payload: [u32 nrows][u32 width_f32s][i32 ids[nrows]]
@@ -2027,16 +2068,22 @@ class Server {
     if (!ok)
       std::fprintf(stderr, "[bps-server] sparse push rejected key=%llu "
                    "len=%zu\n", (unsigned long long)m.key, m.payload.size());
-    MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key, 0, 0};
-    m.conn->send_msg(r, nullptr);
+    if (!ok || !fused) {
+      MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key,
+                  0, 0};
+      m.conn->send_msg(r, nullptr);
+    }
     for (auto& p : flush) AnswerPull(ks, p);
+    // fused rowsparse: the reply is the DENSE aggregate (exactly what
+    // the two-op path pulls with cmd_dense after its sparse push)
+    if (ok && fused) FusedReply(ks, m, /*compressed=*/false);
   }
 
-  void DoPush(EngineMsg& m) {
+  void DoPush(EngineMsg& m, bool fused = false) {
     std::vector<ParkedPull> flush;
     KeyStore& ks = store_of(m.key);
     if (m.req == kRowSparsePushPull) {
-      DoPushSparse(m, ks);
+      DoPushSparse(m, ks, fused);
       return;
     }
     {
@@ -2056,7 +2103,7 @@ class Server {
       }
     }
     if (m.req == kCompressedPushPull) {
-      DoPushCompressed(m, ks);
+      DoPushCompressed(m, ks, fused);
       return;
     }
     {
@@ -2117,10 +2164,14 @@ class Server {
         }
       }
     }
-    // ack the push (ZPush completion callback)
-    MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
-    m.conn->send_msg(r, nullptr);
+    if (!fused) {
+      // ack the push (ZPush completion callback)
+      MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+      m.conn->send_msg(r, nullptr);
+    }
     for (auto& p : flush) AnswerPull(ks, p);
+    // fused: the aggregate IS the reply — park or answer instead of ACK
+    if (fused) FusedReply(ks, m, /*compressed=*/false);
   }
 
   bool PullReady(KeyStore& ks, uint16_t sender) {
@@ -2263,6 +2314,66 @@ class Server {
 // client
 // ------------------------------------------------------------------ //
 
+// One fused-request completion, drained in batches by the worker's
+// Python reactor thread (bps_client_cq_poll). status: 0 ok, -1 failed
+// (server error reply, oversized reply, or connection death), -2 the
+// client-side request timeout expired.
+struct CompletionRec {
+  uint64_t ticket;
+  int32_t status;
+  uint32_t len;
+};
+
+// MPSC completion queue: per-connection recv loops push, ONE reactor
+// thread pops. This is what replaces the thread-parked-in-recv model —
+// any number of fused requests can be in flight while the reactor is
+// the only thread that ever blocks.
+class CompletionQueue {
+ public:
+  void push(const CompletionRec& r) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (closed_) return;  // teardown: nobody will read it
+      q_.push_back(r);
+    }
+    cv_.notify_one();
+  }
+
+  // Blocks up to timeout_ms for >=1 record; returns the batch size,
+  // 0 on timeout, -1 once closed AND drained (reactor exit signal).
+  int pop_batch(CompletionRec* out, int max_n, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                 [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return closed_ ? -1 : 0;
+    int n = 0;
+    while (n < max_n && !q_.empty()) {
+      out[n++] = q_.front();
+      q_.pop_front();
+    }
+    return n;
+  }
+
+  int depth() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int)q_.size();
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      closed_ = true;
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<CompletionRec> q_;
+  bool closed_ = false;
+};
+
 struct Waiter {
   std::mutex mu;
   std::condition_variable cv;
@@ -2275,10 +2386,20 @@ struct Waiter {
   // an error reply instead poisons the connection (fail-fast for the
   // paired pull, which would otherwise park server-side forever)
   bool detached = false;
+  // fused = PUSHPULL: no thread waits on cv either — the reply lands in
+  // `out` and a CompletionRec carrying `ticket` goes to the client's
+  // completion queue (status -1 on any failure, -2 on timeout expiry)
+  bool fused = false;
+  uint64_t ticket = 0;
+  std::chrono::steady_clock::time_point sent_at;
 };
 
 class ServerConn {
  public:
+  // completion queue for fused requests (owned by the Client, shared by
+  // every conn); set once before Connect
+  void set_cq(CompletionQueue* cq) { cq_ = cq; }
+
   ~ServerConn() {
     // a partially-connected group destroyed on Connect failure must not
     // abort the process: Close() joins the recv thread (std::thread's
@@ -2373,6 +2494,100 @@ class ServerConn {
       waiters_.erase(rid);
     }
     return sent;
+  }
+
+  // Fused PUSHPULL: enqueue and RETURN — no thread parks for the reply.
+  // The recv loop lands the aggregated payload in `out` and pushes a
+  // CompletionRec carrying `ticket` onto the client's completion queue.
+  // Returns false when the send failed or the conn is poisoned (the
+  // caller raises; no record will ever surface for the ticket).
+  bool RequestFused(uint64_t key, uint32_t cmd, uint16_t sender,
+                    const void* data, uint32_t len, void* out,
+                    uint32_t out_len, uint64_t ticket) {
+    if (sticky_err_.load()) return false;
+    auto w = std::make_shared<Waiter>();
+    w->fused = true;
+    w->ticket = ticket;
+    w->out = out;
+    w->out_len = out_len;
+    w->sent_at = std::chrono::steady_clock::now();
+    uint32_t rid = next_rid_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      // same re-check-under-lock as RequestAsync: a poison landing
+      // between the entry check and this insert already ran the
+      // fail-all sweep, which would never complete this waiter
+      if (sticky_err_.load()) return false;
+      waiters_[rid] = w;
+    }
+    MsgHeader h{kMagic, PUSHPULL, 0, sender, rid, key, cmd, len};
+    std::lock_guard<std::mutex> lk(send_mu_);
+    bool sent = chan_ ? chan_->send_msg(h, data)
+                      : send_msg_iov(fd_, h, data);
+    if (!sent) {
+      std::lock_guard<std::mutex> lk2(waiters_mu_);
+      if (waiters_.erase(rid) == 0) {
+        // the recv loop's fail-all sweep already claimed this waiter
+        // and pushed its failure record: report success here so the
+        // ticket fails through the completion queue ONCE — returning
+        // false too would double-fail the request (caller raise AND
+        // reactor callback)
+        return true;
+      }
+    }
+    return sent;
+  }
+
+  // Expire fused waiters older than `timeout_s` (called from the
+  // reactor's poll loop): each expired waiter is REMOVED first (the
+  // recv loop's claim point is the waiters_ erasure, so a late reply
+  // drains as unknown-rid junk and can never write into an `out`
+  // buffer the Python side has already released) and then reported as
+  // status -2. Returns how many expired.
+  int SweepExpiredFused(long timeout_s) {
+    if (timeout_s <= 0) return 0;
+    auto cutoff = std::chrono::steady_clock::now() -
+                  std::chrono::seconds(timeout_s);
+    std::vector<CompletionRec> expired;
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      for (auto it = waiters_.begin(); it != waiters_.end();) {
+        auto& w = it->second;
+        if (w->fused && w->sent_at < cutoff) {
+          expired.push_back({w->ticket, -2, 0});
+          it = waiters_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& r : expired) {
+      std::fprintf(stderr, "[bps-client] fused pushpull timeout "
+                   "(ticket=%llu) after %lds\n",
+                   (unsigned long long)r.ticket, timeout_s);
+      if (cq_) cq_->push(r);
+    }
+    return (int)expired.size();
+  }
+
+  // Fail every outstanding fused waiter NOW (teardown): records land in
+  // the completion queue so the reactor can resolve their callbacks
+  // before the native client is destroyed.
+  void AbortFused() {
+    std::vector<CompletionRec> victims;
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      for (auto it = waiters_.begin(); it != waiters_.end();) {
+        if (it->second->fused) {
+          victims.push_back({it->second->ticket, -1, 0});
+          it = waiters_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& r : victims)
+      if (cq_) cq_->push(r);
   }
 
   // blocking request: returns got_len or ~0u on failure
@@ -2538,6 +2753,17 @@ class ServerConn {
         }
       }
       bool server_err = (h.flags & 1) != 0;
+      if (w->fused) {
+        // fused completion: payload already landed in w->out above (or
+        // was drained on a size mismatch); hand the verdict to the
+        // reactor via the completion queue — no cv, no parked thread
+        if (cq_)
+          cq_->push({w->ticket,
+                     (ok && !server_err && !len_mismatch) ? 0 : -1,
+                     h.len});
+        if (!ok) break;  // transport died mid-payload: fail-all below
+        continue;
+      }
       if (w->detached) {
         // async push ACK: success is silent; an error poisons the conn
         // (sticky) and fails everything in flight on it NOW — the
@@ -2566,18 +2792,25 @@ class ServerConn {
     // sweep below would block for the full client timeout even though
     // the recv thread is gone), then fail all waiters
     sticky_err_.store(true);
-    std::lock_guard<std::mutex> lk(waiters_mu_);
-    for (auto& [rid, w] : waiters_) {
-      std::lock_guard<std::mutex> lk2(w->mu);
-      w->ok = false;
-      w->done = true;
-      w->cv.notify_one();
+    {
+      std::lock_guard<std::mutex> lk(waiters_mu_);
+      for (auto& [rid, w] : waiters_) {
+        if (w->fused) continue;  // reported via the cq below
+        std::lock_guard<std::mutex> lk2(w->mu);
+        w->ok = false;
+        w->done = true;
+        w->cv.notify_one();
+      }
+      for (auto& [rid, w] : waiters_) {
+        if (w->fused && cq_) cq_->push({w->ticket, -1, 0});
+      }
+      waiters_.clear();
     }
-    waiters_.clear();
   }
 
   int fd_ = -1;
   std::unique_ptr<IpcChan> chan_;  // set before recv_thread_ spawns
+  CompletionQueue* cq_ = nullptr;  // Client-owned; set before Connect
   std::mutex send_mu_;
   std::thread recv_thread_;
   std::mutex waiters_mu_;
@@ -2613,6 +2846,7 @@ class Client {
       auto g = std::make_unique<ConnGroup>();
       for (int j = 0; j < k; ++j) {
         auto c = std::make_unique<ServerConn>();
+        c->set_cq(&cq_);
         if (!c->Connect(servers[i].first, servers[i].second, worker_id_))
           return false;
         g->conns.push_back(std::move(c));
@@ -2626,6 +2860,52 @@ class Client {
     for (auto& g : groups_)
       for (auto& c : g->conns)
         if (c) c->Close();
+    cq_.close();
+  }
+
+  // fused PUSHPULL over the key-affine conn (same FIFO stream as the
+  // two-op push->pull pair, so server-side ordering is unchanged)
+  int PushPull(int server, uint64_t key, const void* data, uint32_t len,
+               uint32_t cmd, void* out, uint32_t out_len,
+               uint64_t ticket) {
+    return pick(server, key)->RequestFused(key, cmd, worker_id_, data,
+                                           len, out, out_len, ticket)
+               ? 0
+               : -1;
+  }
+
+  // Reactor drain: blocks up to timeout_ms for completions, sweeping
+  // expired fused requests between waits so a silent server can't
+  // strand a ticket forever. Returns batch size, 0 on timeout, -1 once
+  // the queue is closed and drained.
+  int CqPoll(CompletionRec* out, int max_n, int timeout_ms) {
+    static const long timeout_s = [] {
+      const char* e = ::getenv("BYTEPS_CLIENT_TIMEOUT_S");
+      return e && *e ? std::atol(e) : 600L;
+    }();
+    int remain = timeout_ms;
+    for (;;) {
+      int chunk = remain > 500 ? 500 : remain;
+      int n = cq_.pop_batch(out, max_n, chunk > 0 ? chunk : 0);
+      if (n != 0) return n;
+      for (auto& g : groups_)
+        for (auto& c : g->conns)
+          if (c) c->SweepExpiredFused(timeout_s);
+      remain -= chunk;
+      if (remain <= 0) return 0;
+    }
+  }
+
+  int CqDepth() { return cq_.depth(); }
+
+  // Teardown half-step for the Python reactor: fail every outstanding
+  // fused request into the queue, then close it — the reactor drains
+  // the failures and exits on -1 BEFORE the native client is destroyed.
+  void CqAbort() {
+    for (auto& g : groups_)
+      for (auto& c : g->conns)
+        if (c) c->AbortFused();
+    cq_.close();
   }
 
   int InitKey(int server, uint64_t key, const void* data, uint32_t len,
@@ -2724,6 +3004,7 @@ class Client {
 
   uint16_t worker_id_ = 0;
   std::vector<std::unique_ptr<ConnGroup>> groups_;
+  CompletionQueue cq_;  // fused-request completions, all conns
 };
 
 }  // namespace bps
@@ -2798,6 +3079,42 @@ int bps_client_pull(void* c, int server, uint64_t key, void* out,
                     uint32_t out_len, uint32_t cmd) {
   return ((bps::Client*)c)->Pull(server, key, out, out_len, cmd);
 }
+
+// Fused PUSHPULL: push `data` and receive the aggregated reply into
+// `out` in ONE wire round trip. Returns 0 once the request is on the
+// wire (-1 on send failure); completion surfaces as a CompletionRec
+// carrying `ticket` via bps_client_cq_poll. `out` must stay alive (and
+// unreleased) until the ticket's record is drained.
+int bps_client_pushpull_async(void* c, int server, uint64_t key,
+                              const void* data, uint32_t len, uint32_t cmd,
+                              void* out, uint32_t out_len,
+                              uint64_t ticket) {
+  return ((bps::Client*)c)->PushPull(server, key, data, len, cmd, out,
+                                     out_len, ticket);
+}
+
+// Drain up to max_n fused completions into the three parallel arrays;
+// blocks up to timeout_ms. Returns the batch size, 0 on timeout, -1
+// once the queue is closed and drained (reactor exit).
+int bps_client_cq_poll(void* c, uint64_t* tickets, int32_t* statuses,
+                       uint32_t* lens, int max_n, int timeout_ms) {
+  if (max_n <= 0) return 0;
+  std::vector<bps::CompletionRec> recs(max_n);
+  int n = ((bps::Client*)c)->CqPoll(recs.data(), max_n, timeout_ms);
+  for (int i = 0; i < n; ++i) {
+    tickets[i] = recs[i].ticket;
+    statuses[i] = recs[i].status;
+    lens[i] = recs[i].len;
+  }
+  return n;
+}
+
+int bps_client_cq_depth(void* c) { return ((bps::Client*)c)->CqDepth(); }
+
+// Fail all outstanding fused requests and close the completion queue:
+// the Python reactor drains the failures, sees -1, and exits — call
+// BEFORE bps_client_destroy.
+void bps_client_cq_abort(void* c) { ((bps::Client*)c)->CqAbort(); }
 
 int bps_client_barrier(void* c) { return ((bps::Client*)c)->Barrier(); }
 

@@ -6,6 +6,12 @@ byteps_tpu/native/ps.cc. Per-partition push/pull runs on a thread pool in
 priority order — the worker-side seed of the reference's PUSH/PULL pipeline
 stages (core_loops.cc:538-618) — with partitions of one tensor fanned out
 across servers by the registry's key->server assignment.
+
+Beyond the reference surface: ``zpushpull_async`` — the fused PUSHPULL
+wire op (one message per aggregation round trip, the THC shape) whose
+replies are drained by a single **completion-reactor** thread off the
+native completion queue, so in-flight requests are unbounded by thread
+count (O(connections) threads total).
 """
 
 from __future__ import annotations
@@ -14,7 +20,7 @@ import concurrent.futures
 import ctypes
 import os
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -41,6 +47,24 @@ def _load_lib() -> ctypes.CDLL:
     lib.bps_client_push_async.argtypes = lib.bps_client_init_key.argtypes
     lib.bps_client_pull.restype = ctypes.c_int
     lib.bps_client_pull.argtypes = lib.bps_client_init_key.argtypes
+    if hasattr(lib, "bps_client_pushpull_async"):
+        # guarded: a stale .so predating the fused PUSHPULL op must
+        # still load so supports_fused can return False and the
+        # scheduler falls back to the two-op path (version skew)
+        lib.bps_client_pushpull_async.restype = ctypes.c_int
+        lib.bps_client_pushpull_async.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
+        lib.bps_client_cq_poll.restype = ctypes.c_int
+        lib.bps_client_cq_poll.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int, ctypes.c_int]
+        lib.bps_client_cq_depth.restype = ctypes.c_int
+        lib.bps_client_cq_depth.argtypes = [ctypes.c_void_p]
+        lib.bps_client_cq_abort.restype = None
+        lib.bps_client_cq_abort.argtypes = [ctypes.c_void_p]
     lib.bps_client_comp_init.restype = ctypes.c_int
     lib.bps_client_comp_init.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
@@ -166,19 +190,75 @@ class PSClient:
         # construction in tests/benches)
         self._m_push_req = self._m_push_bytes = None
         self._m_pull_req = self._m_pull_bytes = None
-        self._m_errors = None
+        self._m_pushpull_req = self._m_errors = None
+        self._m_inflight = self._m_inflight_peak = self._m_cq_depth = None
+        # fused PUSHPULL completion reactor: ticket -> (callback,
+        # reply-buffer ref). The buffer ref is load-bearing — the native
+        # recv loop writes through its pointer until the ticket's
+        # completion record is drained, so it must not be collectable.
+        self._fused_mu = threading.Lock()
+        self._fused: dict = {}
+        self._next_ticket = 1
+        self._reactor: Optional[threading.Thread] = None
+        self._reactor_started = False
+        # outstanding wire requests awaiting a server reply (fused
+        # requests + blocking pulls): THE concurrency the reactor model
+        # unlocks — two-op mode caps it at the pull-pool thread count,
+        # fused mode at scheduling credit
+        self._inflight = 0
+        self._inflight_peak = 0
 
     def attach_metrics(self, metrics) -> None:
         """Cache wire counters off the registry: every ZPush/ZPull
         request and its payload bytes land on the unified surface
         (``wire/*`` — request counts, bytes each way, failed requests;
         the native transport has no app-level retry, so ``wire/errors``
-        is the retry-pressure signal)."""
+        is the retry-pressure signal). Fused PUSHPULL requests count
+        under ``wire/pushpull_requests`` (one per partition per round —
+        half the request messages of the two-op push+pull pair);
+        ``wire/inflight`` / ``wire/inflight_peak`` gauge outstanding
+        wire requests, ``wire/cq_depth`` the undrained completion-queue
+        backlog."""
         self._m_push_req = metrics.counter("wire/push_requests")
         self._m_push_bytes = metrics.counter("wire/push_bytes")
         self._m_pull_req = metrics.counter("wire/pull_requests")
         self._m_pull_bytes = metrics.counter("wire/pull_bytes")
+        self._m_pushpull_req = metrics.counter("wire/pushpull_requests")
         self._m_errors = metrics.counter("wire/errors")
+        self._m_inflight = metrics.gauge("wire/inflight")
+        self._m_inflight_peak = metrics.gauge("wire/inflight_peak")
+        self._m_cq_depth = metrics.gauge("wire/cq_depth")
+
+    def _inflight_add(self, d: int) -> None:
+        # gauge writes INSIDE the lock: set() calls from two threads must
+        # land in counter order, or a delayed stale set could leave the
+        # gauge nonzero after the last request drained
+        with self._lock:
+            self._inflight += d
+            cur = self._inflight
+            if cur > self._inflight_peak:
+                self._inflight_peak = cur
+            if self._m_inflight is not None:
+                self._m_inflight.set(cur)
+                self._m_inflight_peak.set_max(cur)
+
+    @property
+    def inflight_peak(self) -> int:
+        """Max simultaneously outstanding wire requests (proof surface
+        for the reactor model: fused mode sustains more in-flight
+        partitions than the pull pool has threads)."""
+        with self._lock:
+            return self._inflight_peak
+
+    def _check_server(self, server: int) -> None:
+        # the native connection table is indexed UNCHECKED — an
+        # out-of-range index from a stale/corrupt partition assignment
+        # would read garbage or segfault the whole worker, so reject it
+        # here, before anything touches the wire
+        if not 0 <= server < len(self._servers):
+            raise ValueError(
+                f"server index {server} out of range "
+                f"[0, {len(self._servers)}) — stale partition table?")
 
     @property
     def ipc_conns(self) -> int:
@@ -193,6 +273,7 @@ class PSClient:
 
     def init_key(self, server: int, key: int, data: np.ndarray,
                  cmd: int) -> None:
+        self._check_server(server)
         buf = np.ascontiguousarray(data)
         rc = self._lib.bps_client_init_key(
             self._handle, server, key, buf.ctypes.data, buf.nbytes, cmd)
@@ -201,6 +282,7 @@ class PSClient:
 
     def zpush(self, server: int, key: int, data: np.ndarray,
               cmd: int) -> None:
+        self._check_server(server)
         data = np.ascontiguousarray(data)  # .ctypes.data of a strided
         rc = self._lib.bps_client_push(   # view points at the base buffer
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
@@ -221,6 +303,7 @@ class PSClient:
         zpull. Removes the ACK round-trip from the pipeline's critical
         path — the pull is the only synchronization, matching ps-lite's
         asynchronous ZPush."""
+        self._check_server(server)
         data = np.ascontiguousarray(data)
         rc = self._lib.bps_client_push_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
@@ -233,29 +316,197 @@ class PSClient:
             raise RuntimeError(f"async push failed key={key}")
 
     def zpull(self, server: int, key: int, out: np.ndarray,
-              cmd: int) -> int:
+              cmd: int, exact: bool = False) -> int:
         """Pull into ``out``; returns the ACTUAL reply length (equal to
         out.nbytes for dense/fixed formats, possibly shorter for
-        variable-length wires like varint-coded dithering)."""
+        variable-length wires like varint-coded dithering).
+
+        ``exact=True``: the caller means ``out`` as the whole reply
+        (dense pulls) — a SHORTER reply then raises instead of leaving
+        the tail of ``out`` unwritten garbage (stale partitioning after
+        a tensor resize). A reply LONGER than ``out`` always fails: the
+        native side drains it whole — the byte stream stays
+        message-aligned, so the connection survives — and reports the
+        mismatch instead of truncating."""
+        self._check_server(server)
         if not out.flags["C_CONTIGUOUS"]:
             # the native side writes through .ctypes.data — a strided
             # view would silently receive bytes at the wrong offsets
             raise ValueError("zpull requires a C-contiguous output array")
-        rc = self._lib.bps_client_pull(
-            self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
+        self._inflight_add(1)
+        try:
+            rc = self._lib.bps_client_pull(
+                self._handle, server, key, out.ctypes.data, out.nbytes, cmd)
+        finally:
+            self._inflight_add(-1)
         if self._m_pull_req is not None:
             self._m_pull_req.inc()
         if rc < 0:
             if self._m_errors is not None:
                 self._m_errors.inc()
-            raise RuntimeError(f"pull failed key={key}")
+            raise RuntimeError(
+                f"pull failed key={key} (server error, reply larger than "
+                f"the {out.nbytes}-byte output view, or connection lost)")
+        if exact and rc != out.nbytes:
+            if self._m_errors is not None:
+                self._m_errors.inc()
+            raise RuntimeError(
+                f"pull reply for key={key} is {rc} bytes, expected exactly "
+                f"{out.nbytes} — stale partitioning after a tensor resize?")
         if self._m_pull_bytes is not None:
             self._m_pull_bytes.inc(rc)  # actual reply length
         return rc
 
+    # ------------------------------------------------------------ #
+    # fused PUSHPULL + completion reactor
+    # ------------------------------------------------------------ #
+
+    @property
+    def supports_fused(self) -> bool:
+        """True when the loaded native library has the fused PUSHPULL op
+        (always, for in-tree builds; False only under version skew)."""
+        return hasattr(self._lib, "bps_client_pushpull_async")
+
+    def zpushpull_async(self, server: int, key: int, data: np.ndarray,
+                        out: np.ndarray, cmd: int,
+                        on_done: Callable[[int, Optional[Exception]], None],
+                        ) -> None:
+        """Fused push+pull in ONE wire round trip: push ``data``, and
+        when the server's aggregation round completes, the aggregate
+        lands in ``out`` and ``on_done(reply_len, error)`` runs on the
+        completion-reactor thread (keep it tiny or hand off). Returns
+        the moment the request is on the wire — no thread parks for the
+        aggregation wait, so in-flight partitions are bounded by
+        scheduling credit, not pool size. ``out`` must stay alive until
+        ``on_done`` fires (the registration table pins it)."""
+        self._check_server(server)
+        if not out.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "zpushpull_async requires a C-contiguous reply buffer")
+        if self._closed:
+            raise RuntimeError("zpushpull_async on a closed PSClient")
+        data = np.ascontiguousarray(data)
+        with self._fused_mu:
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            # register BEFORE the send: the reply can complete (and the
+            # reactor dispatch) before the native call returns
+            self._fused[ticket] = (on_done, out)
+        self._ensure_reactor()
+        self._inflight_add(1)
+        rc = self._lib.bps_client_pushpull_async(
+            self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
+            out.ctypes.data, out.nbytes, ticket)
+        if self._m_pushpull_req is not None:
+            self._m_pushpull_req.inc()
+            self._m_push_bytes.inc(data.nbytes)
+        if rc != 0:
+            # rc != 0 means the native side still OWNED the waiter when
+            # the send failed (a fail-all sweep that claimed it first
+            # reports success and fails the ticket through the queue
+            # instead) — so exactly one of {this raise, the reactor
+            # callback} fires. The pop guard keeps it that way even if
+            # a stray record raced us.
+            with self._fused_mu:
+                owned = self._fused.pop(ticket, None) is not None
+            if not owned:
+                return  # reactor already delivered/owns the failure
+            self._inflight_add(-1)
+            if self._m_errors is not None:
+                self._m_errors.inc()
+            raise RuntimeError(
+                f"fused pushpull failed to send key={key} "
+                f"(connection poisoned or lost)")
+
+    def _ensure_reactor(self) -> None:
+        if self._reactor_started:
+            return
+        with self._lock:
+            if self._reactor_started:
+                return
+            self._reactor = threading.Thread(
+                target=self._reactor_loop, name="bps-cq-reactor",
+                daemon=True)
+            self._reactor_started = True
+            self._reactor.start()
+
+    def _reactor_loop(self) -> None:
+        """THE receive-completion thread: drains the native completion
+        queue in batches and resolves per-ticket callbacks. One thread
+        regardless of how many partitions are in flight — the
+        O(connections) half of the reactor model (the per-connection
+        recv loops are native)."""
+        max_n = 128
+        tickets = (ctypes.c_uint64 * max_n)()
+        statuses = (ctypes.c_int32 * max_n)()
+        lens = (ctypes.c_uint32 * max_n)()
+        while True:
+            n = self._lib.bps_client_cq_poll(
+                self._handle, tickets, statuses, lens, max_n, 250)
+            if n < 0:
+                return  # queue closed and drained: teardown
+            if self._m_cq_depth is not None:
+                self._m_cq_depth.set(
+                    self._lib.bps_client_cq_depth(self._handle))
+            for i in range(n):
+                with self._fused_mu:
+                    entry = self._fused.pop(int(tickets[i]), None)
+                if entry is None:
+                    # already failed locally (close() / send-failure
+                    # raise): that path decremented inflight — doing it
+                    # again here would underflow the gauge
+                    continue
+                self._inflight_add(-1)
+                cb, _out = entry
+                status = int(statuses[i])
+                err = None
+                if status == -2:
+                    err = TimeoutError(
+                        "fused pushpull timed out waiting for the "
+                        "aggregation round (BYTEPS_CLIENT_TIMEOUT_S)")
+                elif status != 0:
+                    err = RuntimeError(
+                        "fused pushpull failed (server error reply, "
+                        "oversized reply, or connection lost)")
+                elif self._m_pull_bytes is not None:
+                    self._m_pull_bytes.inc(int(lens[i]))
+                try:
+                    cb(int(lens[i]), err)
+                except Exception:  # noqa: BLE001 - must not kill reactor
+                    log.exception(
+                        "fused completion callback raised (ticket %d)",
+                        int(tickets[i]))
+
+    def _stop_reactor(self) -> None:
+        """Teardown half-step: fail outstanding fused requests into the
+        queue, close it, and join the reactor so no native callback can
+        run after the client handle is freed."""
+        if not self._reactor_started:
+            return
+        try:
+            self._lib.bps_client_cq_abort(self._handle)
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        if self._reactor is not None:
+            self._reactor.join(timeout=10)
+        # anything the reactor didn't get to (it died, or records were
+        # dropped after close): resolve with an error so waiters raise
+        # instead of hanging
+        with self._fused_mu:
+            leftovers = list(self._fused.items())
+            self._fused.clear()
+        for ticket, (cb, _out) in leftovers:
+            try:
+                cb(0, RuntimeError("PSClient closed with the fused "
+                                   "request still in flight"))
+            except Exception:  # noqa: BLE001
+                log.exception("fused teardown callback raised (ticket %d)",
+                              ticket)
+
     def comp_init(self, server: int, key: int, kwargs_wire: str) -> None:
         """Install a server-side compressor for ``key`` (the reference's
         in-band kCompressedPushPull kwargs push, operations.cc:396-408)."""
+        self._check_server(server)
         rc = self._lib.bps_client_comp_init(
             self._handle, server, key, kwargs_wire.encode())
         if rc != 0:
@@ -332,7 +583,8 @@ class PSClient:
             self.zpush(p.server, p.key,
                        in_view[p.offset:p.offset + p.length], cmd)
             self.zpull(p.server, p.key,
-                       out_view[p.offset:p.offset + p.length], cmd)
+                       out_view[p.offset:p.offset + p.length], cmd,
+                       exact=True)  # dense: a short reply is an error
 
         futures = [self._pool.submit(one, p) for p in ctx.partitions]
         for f in futures:
@@ -365,7 +617,7 @@ class PSClient:
             buf = build_rowsparse_payload(p, nz, host2d)
             self.zpush(p.server, p.key, buf, cmd_sparse)
             dst = out.view(np.uint8)[p.offset:p.offset + p.length]
-            self.zpull(p.server, p.key, dst, cmd_dense)
+            self.zpull(p.server, p.key, dst, cmd_dense, exact=True)
 
         futures = [self._pool.submit(one, p) for p in ctx.partitions]
         for f in futures:
@@ -431,6 +683,10 @@ class PSClient:
         # drain in-flight partition tasks BEFORE freeing the native client —
         # wait=False would leave pool threads calling into freed memory
         self._pool.shutdown(wait=True)
+        # fail + drain fused completions and JOIN the reactor before the
+        # native handle goes away (a reactor poll on a freed handle is a
+        # use-after-free)
+        self._stop_reactor()
         if shutdown_servers:
             try:
                 self._lib.bps_client_shutdown(self._handle)

