@@ -756,6 +756,135 @@ def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
             "wire_half_proof": True}
 
 
+def phase_shard_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the locality-sharded export/import path
+    (BYTEPS_LOCAL_SHARD_EXPORT, jax/train.py): reduce-scatter → push
+    shard → update shard → all-gather vs the whole-leaf psum path, on
+    an 8-virtual-device CPU mesh through the loopback PS. INTERLEAVED
+    reps, best-of step wall per arm.
+
+    Wall-clock on a shared CPU box flakes, so the phase carries a HARD
+    DETERMINISTIC proof from the ``export/*`` + ``wire/*`` counters
+    (the wire_ab pattern): with shard export on, the bytes any single
+    device exports for the shard-eligible leaves must be EXACTLY
+    1/local_size of what the whole-leaf arm exports from its one
+    device — the weight leaves are sized divisible by local_size so
+    the equalities are integer-exact — while total wire payload bytes
+    match both ways (shards re-concatenate to the same leaves). All
+    counters are deltas taken after warmup, so init-push traffic and
+    compile noise never enter the proof."""
+    import gc
+
+    # the virtual 8-device mesh must exist BEFORE jax initializes its
+    # CPU backend in this child (the phase subprocess is fresh, so this
+    # cannot leak into other phases); on 1 device there is no locality
+    # axis and the A/B would be vacuous
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    def run(enabled: bool, walls: list):
+        os.environ["BYTEPS_LOCAL_SHARD_EXPORT"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            local_size = int(get_state().mesh.shape.get("dp", 1))
+            rng = np.random.RandomState(0)
+            # 4MB weight leaves, element counts divisible by the mesh
+            # size (1024*1024 % 8 == 0): the per-shard keys carry zero
+            # padding, so the counter equalities below are exact;
+            # biases keep the fused-bucket (whole-leaf) path in the
+            # same round. UNcommitted placement (jnp.asarray, not
+            # _cpu_put): an array committed to cpu:0 is rejected by the
+            # 8-device shard_map, and this child already CPU-forced the
+            # whole process — the mixed-backend hazard _cpu_put guards
+            # against cannot arise here
+            params = {f"w{i}": jnp.asarray(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": jnp.asarray(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = jnp.asarray(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.adam(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            c0 = dict(bps.get_metrics()["counters"])
+            s0 = bps.get_arena_stats()["export_shard_leaves"]
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            c1 = dict(bps.get_metrics()["counters"])
+            delta = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+            delta["_shard_leaves"] = (
+                bps.get_arena_stats()["export_shard_leaves"] - s0)
+            delta["_local_size"] = local_size
+            return delta
+
+    prior = os.environ.get("BYTEPS_LOCAL_SHARD_EXPORT")
+    on_walls, off_walls = [], []
+    d_on = d_off = None
+    try:
+        for _ in range(reps):
+            d_on = run(True, on_walls)
+            d_off = run(False, off_walls)
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_LOCAL_SHARD_EXPORT", None)
+        else:
+            os.environ["BYTEPS_LOCAL_SHARD_EXPORT"] = prior
+    n = d_on["_local_size"]
+    shard_bytes = d_on.get("export/shard_bytes", 0)
+    # bytes the eligible (weight) leaves exported in the whole-leaf arm
+    # = its whole-leaf exports minus the shared bucket traffic (the
+    # on-arm's whole bytes ARE exactly that bucket traffic)
+    eligible_off = (d_off.get("export/whole_bytes", 0)
+                    - d_on.get("export/whole_bytes", 0))
+    per_dev_on = d_on.get("export/device_bytes/%d" % (n - 1), 0)
+    per_dev_off = d_off.get("export/device_bytes/0", 0)
+    # ---- the hard proof ----
+    assert d_on["_shard_leaves"] > 0, "shard export never engaged"
+    assert d_off.get("export/shard_bytes", 0) == 0, d_off
+    # total exported bytes for the eligible leaves match across arms
+    # (shards re-concatenate to the leaves; zero padding by sizing)
+    assert shard_bytes == eligible_off, (shard_bytes, eligible_off)
+    # a single device's shard exports are EXACTLY 1/local_size of the
+    # whole-leaf arm's single-device exports for the same leaves
+    assert per_dev_on * n == shard_bytes, (per_dev_on, n, shard_bytes)
+    # the whole-leaf arm put everything on one device
+    assert per_dev_off == d_off.get("export/whole_bytes", 0), d_off
+    # same payload bytes on the wire either way
+    assert d_on.get("wire/push_bytes", 0) == \
+        d_off.get("wire/push_bytes", 0), (d_on, d_off)
+    return {"shard_on_step_ms": round(min(on_walls) * 1e3, 2),
+            "shard_off_step_ms": round(min(off_walls) * 1e3, 2),
+            "shard_local_size": n,
+            "shard_bytes_per_device_on": int(per_dev_on),
+            "shard_bytes_per_device_off": int(per_dev_off),
+            "shard_reduction_ratio": round(per_dev_off / per_dev_on, 2)
+            if per_dev_on else None,
+            "shard_counter_proof": True,
+            "shard_leaves_per_arm": int(d_on["_shard_leaves"])}
+
+
 def phase_stream_ab(steps: int = 6, reps: int = 4,
                     throttle_mbps: float = 400.0) -> dict:
     """A/B the COMPUTE/PUSH/UPDATE pipeline (BYTEPS_STREAM_EXPORT +
@@ -1106,6 +1235,7 @@ _PHASES = {
     "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
     "wire_ab": phase_wire_ab,
+    "shard_ab": phase_shard_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
 }
@@ -1219,6 +1349,9 @@ def main() -> None:
         "wire_fused_step_ms": None,
         "wire_twoop_step_ms": None,
         "wire_request_ratio": None,
+        "shard_on_step_ms": None,
+        "shard_off_step_ms": None,
+        "shard_reduction_ratio": None,
         "scaling_efficiency_2w": None,
     }
     errors = {}
@@ -1369,6 +1502,11 @@ def main() -> None:
                             # vs push+pull pair, plus the deterministic
                             # half-the-request-messages counter proof
                             ("wire_ab", 240.0),
+                            # locality-shard A/B: reduce-scatter +
+                            # per-device shard export vs whole-leaf,
+                            # with the per-device-bytes / local_size
+                            # counter proof on an 8-device CPU mesh
+                            ("shard_ab", 240.0),
                             # scaling deadline sized for 6 server+worker
                             # launches (3 interleaved 1w/2w reps,
                             # 200-step windows, best-of-3 per config)

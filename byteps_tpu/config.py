@@ -49,6 +49,11 @@ PAGE_SIZE = 4096
 DEFAULT_MIN_COMPRESS_BYTES = 1024000
 # Gradient bucket fusion threshold (rebuild addition, see Config).
 DEFAULT_FUSION_BYTES = 2097152
+# Minimum leaf size eligible for locality-sharded export (see Config):
+# below this the per-shard key overhead (scheduler admission, handle,
+# wire round trip, H2D dispatch — all flat per key, times local_size)
+# outweighs the divided D2H/wire bytes.
+DEFAULT_SHARD_MIN_BYTES = 65536
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +115,25 @@ class Config:
     # to the fused apply. Off: one fused apply jit after the last pull
     # (the pre-split behavior; numerics identical). ---
     sharded_apply: bool = True            # BYTEPS_SHARDED_APPLY
+
+    # --- locality-sharded export/import (rebuild addition; BytePS's
+    # hierarchical strategy: the intra-machine reduce puts only
+    # 1/local_size of each tensor on the inter-machine wire,
+    # core_loops.cc:216-268, layered with the weight-update sharding of
+    # "Automatic Cross-Replica Sharding of Weight Update" (PAPERS.md)).
+    # On: the PS train step reduce-SCATTERS eligible gradient leaves
+    # instead of psum'ing them, each local device taps and exports ONLY
+    # its own 1/local_size shard (per-device export workers), each shard
+    # rides its own PS key spread across servers, the drain imports
+    # shard k back into the device that owns it, the optimizer update
+    # runs on the shard alone, and a jitted all-gather rebuilds
+    # replicated params — dividing per-device D2H/H2D and per-key wire
+    # bytes by local_size. Leaves below shard_min_bytes, non-divisible
+    # leaves past the pad threshold, rowsparse/compressed/bucket-fused
+    # leaves and single-device meshes fall back to the whole-leaf path
+    # (numerics bitwise identical). Requires stream_export. ---
+    local_shard_export: bool = True       # BYTEPS_LOCAL_SHARD_EXPORT
+    shard_min_bytes: int = DEFAULT_SHARD_MIN_BYTES  # BYTEPS_SHARD_MIN_BYTES
 
     # --- gradient bucket fusion (rebuild addition; the reference only
     # SPLITS large tensors at partition_bytes — small-tensor fusion is
@@ -198,6 +222,9 @@ class Config:
             staging_arena=_env_bool("BYTEPS_STAGING_ARENA", True),
             stream_export=_env_bool("BYTEPS_STREAM_EXPORT", True),
             sharded_apply=_env_bool("BYTEPS_SHARDED_APPLY", True),
+            local_shard_export=_env_bool("BYTEPS_LOCAL_SHARD_EXPORT", True),
+            shard_min_bytes=_env_int("BYTEPS_SHARD_MIN_BYTES",
+                                     DEFAULT_SHARD_MIN_BYTES),
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
             fused_pushpull=_env_bool("BYTEPS_FUSED_PUSHPULL", True),

@@ -216,4 +216,10 @@ class StagingArena:
                 "fresh_allocs": self._fresh,
                 "resizes": self._resizes,
                 "export_checkouts": self._tag_checkouts.get("export", 0),
+                # per-shard result-slot leases (tag="shard"): the
+                # locality-sharded export path checks out one slot per
+                # (leaf, local device) instead of one whole-leaf slot —
+                # this counter is how the shard churn test proves the
+                # per-shard lease discipline engaged
+                "shard_checkouts": self._tag_checkouts.get("shard", 0),
             }

@@ -295,6 +295,11 @@ class StepReport:
     compress_p95_ms: Optional[float] = None
     h2d_update_p95_ms: Optional[float] = None
     pull_wait_ms: float = 0.0  # time the drain sat blocked on ready.get
+    # wall spent issuing the post-update all-gathers that rebuild
+    # replicated params from shard updates (locality-sharded export;
+    # dispatch wall — the gathers themselves complete asynchronously
+    # under XLA, overlapped with later pulls). 0.0 when no leaf sharded.
+    allgather_ms: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -451,6 +456,7 @@ class StepProfiler:
                                  + samples.get("DECOMPRESS", [])),
             h2d_update_p95_ms=_p95(samples.get("H2D_UPDATE", [])),
             pull_wait_ms=b.pull_wait_s * 1e3,
+            allgather_ms=sum(samples.get("ALLGATHER", [])),
         )
         with self._mu:
             self._reports.append(r)

@@ -113,6 +113,57 @@ class TensorRegistry:
         with self._lock:
             return name in self._contexts
 
+    # ------------------------------------------------------------------ #
+    # locality-shard subranges (BYTEPS_LOCAL_SHARD_EXPORT)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def shard_name(name: str, k: int, num_shards: int) -> str:
+        """Stable per-shard key name. The scheme is part of the wire
+        contract: every worker derives the same names from the same
+        flatten order, so the per-shard declared keys agree."""
+        return f"{name}@shard{k}of{num_shards}"
+
+    def declare_shards(self, name: str, shard_nbytes: int, num_shards: int,
+                       dtype: Optional[DataType] = None) -> List[TensorContext]:
+        """Split one logical tensor into ``num_shards`` equal-size
+        subrange keys (the locality-sharded export path: each local
+        device pushes only its own 1/local_size shard). Each subrange is
+        a full TensorContext — its own declared key, its own partitions,
+        its own server assignment — so the load-balanced/hashed
+        assignment spreads the shards of one leaf ACROSS servers instead
+        of pinning the whole leaf to one. Idempotent for unchanged
+        sizes; call :meth:`free` on the subrange names when the shard
+        plan changes so their load accounting retires."""
+        bps_check(num_shards >= 1, f"{name}: num_shards must be >= 1")
+        return [self.init_tensor(self.shard_name(name, k, num_shards),
+                                 shard_nbytes, dtype)
+                for k in range(num_shards)]
+
+    def free(self, name: str) -> bool:
+        """Retire a declared tensor: subtract its partitions from the
+        per-server load table (so later assignments are not skewed by
+        dead keys — the shard-subrange free path when a leaf's shard
+        plan changes), drop its staged arena slots, and remove it from
+        the declaration order (a freed name never re-registers on
+        ``redeclare_all``; re-declaring it later assigns a NEW key, the
+        same on every worker that freed in the same order). Returns
+        False for unknown names."""
+        with self._lock:
+            ctx = self._contexts.pop(name, None)
+            if ctx is None:
+                return False
+            if self._arena is not None:
+                self._arena.invalidate_prefix(name + ":")
+            for p in ctx.partitions:
+                if p.server < len(self._server_load):
+                    self._server_load[p.server] -= p.length
+            try:
+                self._declaration_order.remove(name)
+            except ValueError:
+                pass
+            return True
+
     def get(self, name: str) -> Optional[TensorContext]:
         with self._lock:
             return self._contexts.get(name)
@@ -221,6 +272,14 @@ class TensorRegistry:
     def _assign_server_locked(self, key: int, length: int) -> int:
         num_servers = max(1, self._config.num_servers)
         if num_servers == 1:
+            # record the load even for the trivial assignment: the
+            # retire paths (re-partition, free) subtract
+            # unconditionally, and skipping the add here drove server
+            # 0's accumulated load negative on every re-init/free —
+            # breaking the "sum of loads == sum of live partition
+            # lengths" invariant the balance tests (and any operator
+            # reading server_loads()) rely on
+            self._server_load[0] += length
             return 0
         fn_name = self._config.key_hash_fn
         if self._config.enable_mixed_mode:

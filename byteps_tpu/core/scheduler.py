@@ -458,7 +458,8 @@ class PipelineScheduler:
             target=self._dispatch, name="bps-sched-dispatch", daemon=True)
         self._dispatcher.start()
 
-    def production_priority(self, ctx: TensorContext) -> int:
+    def production_priority(self, ctx: TensorContext,
+                            parent: Optional[TensorContext] = None) -> int:
         """Priority from MEASURED production order: the n-th distinct key
         to first cross the export boundary gets ordinal n and priority
         ``-n``, so the first gradient XLA actually produces is served
@@ -468,13 +469,33 @@ class PipelineScheduler:
         last-served whenever XLA's schedule disagrees with flatten
         order. The assignment pins the key's priority (see
         _pin_priority) — later submissions of the same key, streamed or
-        not, reuse it, keeping cross-round admission order stable."""
+        not, reuse it, keeping cross-round admission order stable.
+
+        ``parent``: the logical tensor a shard subrange belongs to
+        (locality-sharded export). All shard keys of one leaf are ONE
+        production event — the leaf's reduce-scatter completes on every
+        local device at the same collective — so they share the
+        parent's ordinal; the queue's key-ascending tie-break then
+        keeps a leaf's shards adjacent in admission order instead of
+        interleaving them with whichever leaf's shard fired next on a
+        racing export worker."""
         with self._prio_mu:
             pr = self._key_priority.get(ctx.declared_key)
             if pr is None:
-                o = self._export_ordinal
-                self._export_ordinal += 1
-                self._export_order[ctx.declared_key] = o
+                anchor = ctx.declared_key if parent is None \
+                    else parent.declared_key
+                o = self._export_order.get(anchor)
+                if o is None:
+                    o = self._export_ordinal
+                    self._export_ordinal += 1
+                    self._export_order[anchor] = o
+                if ctx.declared_key != anchor:
+                    self._export_order[ctx.declared_key] = o
+                    # pin the PARENT too: if its whole-leaf key ever
+                    # submits later (shard plan change, broken-tap
+                    # fallback), it must ride the measured ordinal, not
+                    # the static -declared_key default
+                    self._key_priority.setdefault(anchor, -o)
                 pr = self._key_priority[ctx.declared_key] = -o
             return pr
 

@@ -96,7 +96,8 @@ class _Telemetry:
     # --- streamed-export stage counters (jax/train.py) ---------------- #
 
     def record_export(self, streamed: int, fallback: int,
-                      ttfp_s: Optional[float]) -> None:
+                      ttfp_s: Optional[float],
+                      shard_leaves: int = 0) -> None:
         """One PS train round's export accounting: how many gradient
         leaves were streamed out of the backward by io_callback taps vs
         served by the post-jit fallback loop, and the round's
@@ -111,6 +112,12 @@ class _Telemetry:
             self._export_fallback = \
                 getattr(self, "_export_fallback", 0) + int(fallback)
             self._export_rounds = getattr(self, "_export_rounds", 0) + 1
+            # leaves that left the device as per-device reduce-scatter
+            # shards (BYTEPS_LOCAL_SHARD_EXPORT) — a subset of
+            # ``streamed``; the shard A/B asserts this engaged instead
+            # of silently riding the whole-leaf path
+            self._export_shard_leaves = \
+                getattr(self, "_export_shard_leaves", 0) + int(shard_leaves)
             if ttfp_s is not None:
                 self._export_ttfp_ms = ttfp_s * 1e3
 
@@ -122,6 +129,8 @@ class _Telemetry:
                 "export_fallback_leaves": getattr(
                     self, "_export_fallback", 0),
                 "export_rounds": getattr(self, "_export_rounds", 0),
+                "export_shard_leaves": getattr(
+                    self, "_export_shard_leaves", 0),
                 "export_ttfp_ms": getattr(self, "_export_ttfp_ms", None),
             }
 

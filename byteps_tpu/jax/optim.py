@@ -258,6 +258,251 @@ def _analyze_state(opt_state, params_treedef):
     return top, kinds
 
 
+# --------------------------------------------------------------------- #
+# shard-mapped optimizer apply (BYTEPS_LOCAL_SHARD_EXPORT)
+# --------------------------------------------------------------------- #
+
+
+class LeafGather:
+    """Cached jitted all-gathers: flat P(axis)-sharded arrays back to
+    replicated leaves shaped/typed like the given templates. One jit per
+    ((shape, dtype), ...) signature — two leaves can share a shard shape
+    but trim to different sizes (padding), so the trim is part of the
+    cache key, not data. Shared by :class:`ShardApply` (params + state
+    nodes after the shard update) and the train step's gradient-gather
+    fallback (shard-exported leaves whose transform cannot shard)."""
+
+    def __init__(self, mesh, axis: str):
+        self._mesh = mesh
+        self._axis = axis
+        self._cache: dict = {}
+
+    def __call__(self, shards, templates):
+        from jax.sharding import PartitionSpec as P
+
+        meta = tuple((tuple(t.shape), jnp.dtype(t.dtype).name)
+                     for t in templates)
+        fn = self._cache.get(meta)
+        if fn is None:
+            axis = self._axis
+
+            def body(flats):
+                outs = []
+                for sh, (shape, dtype) in zip(flats, meta):
+                    full = jax.lax.all_gather(
+                        sh, axis_name=axis, axis=0,
+                        tiled=False).reshape(-1)
+                    size = 1
+                    for d in shape:
+                        size *= d
+                    outs.append(full[:size].reshape(shape).astype(dtype))
+                return tuple(outs)
+
+            fn = jax.jit(jax.shard_map(
+                body, mesh=self._mesh, in_specs=(P(axis),),
+                out_specs=P(), check_vma=False))
+            self._cache[meta] = fn
+        return fn(tuple(shards))
+
+
+class ShardApply:
+    """Per-leaf update over 1/N shards, compiled as a shard_map.
+
+    The locality-sharded import path lands each leaf's PS-aggregated
+    gradient as a sharded jax.Array (shard k on the device that owns
+    it); this class runs the optimizer update ON THE SHARD ONLY — each
+    device slices its 1/N of the (replicated) param and param-shaped
+    state nodes by ``axis_index``, applies the full transform chain to
+    the slice, and emits sharded results — then a separate jitted
+    all-gather (:meth:`gather`) rebuilds replicated params and state so
+    the step's external contract (replicated trees in, replicated trees
+    out) is unchanged. Per-device H2D and update FLOPs divide by N.
+
+    Built by :func:`make_shard_apply`, which layers a SHARD-granularity
+    separability probe on top of the per-leaf one: per-leaf separable
+    transforms that mix elements WITHIN a leaf (block-norm clipping)
+    pass the leaf probe but fail here and fall back to the full-leaf
+    sharded apply. State plumbing (slice/merge) is shared with the base
+    :class:`ShardedApply` so mixed rounds — some leaves sharded, some
+    whole — merge through one code path."""
+
+    def __init__(self, tx, base: ShardedApply, mesh, axis: str):
+        from jax.sharding import PartitionSpec as P
+
+        self.base = base
+        self._axis = axis
+        std, kinds = base._std, base._kinds
+
+        def leaf_update_shard(param, pparts, shared, grad_shard):
+            # inside shard_map: grad_shard is THIS device's flat shard;
+            # param/pparts are replicated and sliced to the matching
+            # subrange — the same padded layout as ops.push_pull.
+            # shard_layout, so shard k of the gradient meets shard k of
+            # the param bit-for-bit
+            n = jax.lax.axis_size(axis)
+            shard_len = grad_shard.shape[0]
+            idx = jax.lax.axis_index(axis)
+
+            def slice_shard(x):
+                flat = x.reshape(-1)
+                pad = shard_len * n - flat.shape[0]
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                return jax.lax.dynamic_slice(flat, (idx * shard_len,),
+                                             (shard_len,))
+
+            p_sh = slice_shard(param)
+            pparts_sh = [slice_shard(x) for x in pparts]
+            nodes, pi, si = [], 0, 0
+            for is_param in kinds:
+                if is_param:
+                    nodes.append(pparts_sh[pi])
+                    pi += 1
+                else:
+                    nodes.append(shared[si])
+                    si += 1
+            state_i = jax.tree.unflatten(std, nodes)
+            import optax
+            updates, new_state = tx.update(grad_shard, state_i, p_sh)
+            new_p = optax.apply_updates(p_sh, updates)
+            out_nodes = std.flatten_up_to(new_state)
+            n_pparts = [nd for nd, k in zip(out_nodes, kinds) if k]
+            n_shared = [nd for nd, k in zip(out_nodes, kinds) if not k]
+            return new_p, n_pparts, n_shared
+
+        # no donation: replicated inputs cannot alias sharded outputs,
+        # and the donation warning would fire per leaf per step
+        self._jit = jax.jit(jax.shard_map(
+            leaf_update_shard, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis)),
+            out_specs=(P(axis), P(axis), P()), check_vma=False))
+
+        self._gatherer = LeafGather(mesh, axis)
+
+    def apply(self, param_leaf, pparts, shared, grad_sharded):
+        """One leaf's shard update. ``grad_sharded`` is the flat padded
+        P(axis)-sharded gradient; ``pparts``/``shared`` come from the
+        shared ``ShardedApply`` round's ``slice(i)``. Returns
+        ``(new_param_shard, new_pparts_shards, new_shared)`` — the
+        first two still sharded (feed :meth:`gather`)."""
+        return self._jit(param_leaf, pparts, shared, grad_sharded)
+
+    def gather(self, shards, templates):
+        """All-gather flat shards back to replicated leaves shaped/typed
+        like ``templates``; returns a tuple aligned with ``shards``.
+        The BROADCAST half of the hierarchical exchange — dispatched
+        asynchronously, so the gather of leaf k overlaps the PULL of
+        leaf k+1."""
+        return self._gatherer(shards, templates)
+
+
+def _probe_shard_separable(tx, params_treedef, num_shards: int) -> bool:
+    """SHARD-granularity separability probe: the per-leaf update
+    restricted to each padded 1/N subrange must equal the subrange of
+    the full-leaf update. Per-LEAF separable transforms that mix
+    elements within a leaf — block-RMS/block-norm scaling — pass the
+    base probe but must fail here (a shard's RMS is not the leaf's).
+    Emulated eagerly on tiny surrogates with plain slicing, no mesh."""
+    import numpy as np
+    import optax
+
+    from ..ops.push_pull import shard_layout
+
+    n_leaves = params_treedef.num_leaves
+    rng = np.random.RandomState(1)
+    pp = jax.tree.unflatten(params_treedef, [
+        jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        for _ in range(n_leaves)])
+    gg = jax.tree.unflatten(params_treedef, [
+        jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        for _ in range(n_leaves)])
+    state0 = tx.init(pp)
+    std, kinds = _analyze_state(state0, params_treedef)
+    if std is None:
+        return False
+    nodes = std.flatten_up_to(state0)
+    pnode_leaves = [jax.tree.leaves(nd)
+                    for nd, k in zip(nodes, kinds) if k]
+    shared = [nd for nd, k in zip(nodes, kinds) if not k]
+    full_u, full_s = tx.update(gg, state0, pp)
+    full_new = jax.tree.map(optax.apply_updates, pp, full_u)
+    fn_leaves = jax.tree.leaves(full_new)
+    p_leaves, g_leaves = jax.tree.leaves(pp), jax.tree.leaves(gg)
+
+    def pad_flat(x, total):
+        flat = np.asarray(x).reshape(-1)
+        return np.pad(flat, (0, total - flat.size))
+
+    shard_len, _ = shard_layout(p_leaves[0].size, num_shards)
+    total = shard_len * num_shards
+    for i in range(n_leaves):
+        pf = pad_flat(p_leaves[i], total)
+        gf = pad_flat(g_leaves[i], total)
+        parts_f = [pad_flat(pl[i], total) for pl in pnode_leaves]
+        got = np.empty(total, np.float32)
+        for k in range(num_shards):
+            lo, hi = k * shard_len, (k + 1) * shard_len
+            nds, pi, si = [], 0, 0
+            for is_param in kinds:
+                if is_param:
+                    nds.append(jnp.asarray(parts_f[pi][lo:hi]))
+                    pi += 1
+                else:
+                    nds.append(shared[si])
+                    si += 1
+            state_i = jax.tree.unflatten(std, nds)
+            try:
+                u, _ = tx.update(jnp.asarray(gf[lo:hi]), state_i,
+                                 jnp.asarray(pf[lo:hi]))
+            except Exception:  # noqa: BLE001 - shape-dependent: fused
+                return False
+            got[lo:hi] = np.asarray(
+                optax.apply_updates(jnp.asarray(pf[lo:hi]), u))
+        want = np.asarray(fn_leaves[i]).reshape(-1)
+        if not np.array_equal(got[:want.size], want):
+            return False
+    return True
+
+
+def make_shard_apply(tx, params, opt_state, mesh, axis: str,
+                     num_shards: int,
+                     base: Optional[ShardedApply] = None
+                     ) -> Optional["ShardApply"]:
+    """Build the shard-mapped per-leaf apply for the locality-sharded
+    import path, or None when the transform cannot decompose to shard
+    granularity (the caller then gathers gradients and keeps the
+    full-leaf apply). Requires a prior :func:`make_sharded_apply`
+    success (``base``); additionally verifies that every param-shaped
+    state leaf matches its param leaf's SHAPE on the real trees (a
+    factored/covariance state would slice the wrong subranges) and that
+    the update is shard-separable (see :func:`_probe_shard_separable`).
+    """
+    if base is None:
+        base = make_sharded_apply(tx, params, opt_state, donate=False)
+    if base is None:
+        return None
+    p_leaves = jax.tree.leaves(params)
+    try:
+        nodes = base._std.flatten_up_to(opt_state)
+    except Exception:  # noqa: BLE001 - structure drifted: fused
+        return None
+    for nd, k in zip(nodes, base._kinds):
+        if not k:
+            continue
+        for pl, sl in zip(p_leaves, jax.tree.leaves(nd)):
+            if tuple(getattr(sl, "shape", ())) != tuple(pl.shape):
+                return None
+    try:
+        if not _probe_shard_separable(tx, base._ptd, num_shards):
+            return None
+    except Exception:  # noqa: BLE001 - probe failures mean "no shard"
+        return None
+    try:
+        return ShardApply(tx, base, mesh, axis)
+    except Exception:  # noqa: BLE001 - build failures mean "no shard"
+        return None
+
+
 def make_sharded_apply(tx, params, opt_state,
                        donate: bool = True) -> Optional[ShardedApply]:
     """Build per-leaf partial updates for ``tx``, or return None when

@@ -169,20 +169,44 @@ _stream_build_warned: list = []  # once-only streamed-export build warning
 
 
 def _export_pool():
-    """The ONE stream-export worker. The io_callback tap itself only
+    """The stream-export ROUTER worker. The io_callback tap itself only
     enqueues here: a callback arg is a lazy jax.Array whose
     materialization needs the very executor running the tapped program
     — touching it on the callback (= device) thread self-deadlocks the
     step at the next collective. This thread materializes and submits
-    OFF the device threads; a single worker also means ingests run in
-    fire order, so production-order priority assignment is measured
-    from the real schedule."""
+    whole-leaf exports OFF the device threads (a single worker also
+    means whole-leaf ingests run in fire order, so production-order
+    priority assignment is measured from the real schedule); per-device
+    SHARD fires (BYTEPS_LOCAL_SHARD_EXPORT) are only routed here — the
+    router resolves the tiny step/device scalars and hands the heavy
+    shard materialization to that device's own worker
+    (``_shard_export_pool``), so the 1/N shards of different devices
+    materialize and submit in parallel."""
     global _EXPORT_POOL
     if _EXPORT_POOL is None:
         import concurrent.futures
         _EXPORT_POOL = concurrent.futures.ThreadPoolExecutor(
             1, thread_name_prefix="bps-export")
     return _EXPORT_POOL
+
+
+# per-LOCAL-DEVICE shard-export workers (BYTEPS_LOCAL_SHARD_EXPORT):
+# device k's reduce-scatter shard is materialized and submitted by
+# worker k — one thread per device keeps each device's fires in order
+# (the per-shard analogue of the single router's FIFO guarantee) while
+# devices proceed independently, parallelizing the D2H export across
+# the local slice exactly as BytePS's per-GPU copy threads do
+_SHARD_POOLS: Dict[int, Any] = {}
+_SHARD_INGESTS: Dict[int, int] = {}  # per-device ingest totals (gauges)
+
+
+def _shard_export_pool(dev: int):
+    pool = _SHARD_POOLS.get(dev)
+    if pool is None:
+        import concurrent.futures
+        pool = _SHARD_POOLS[dev] = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix=f"bps-export-d{dev}")
+    return pool
 
 
 _RELEASE_POOL = None
@@ -242,32 +266,56 @@ class _StreamRound:
     submit is impossible by construction.
     """
 
-    def __init__(self, tag: int, names, submit_streamed, mark_first_push):
+    def __init__(self, tag: int, names, submit_streamed, mark_first_push,
+                 shard_plan: Optional[dict] = None, submit_shard=None):
         self.tag = tag
         self._names = names
         self._submit = submit_streamed  # (name, flat) -> (finish, notifier)
+        self._submit_shard = submit_shard  # (i, dev, flat) -> waiter
         self._mark = mark_first_push
+        # leaf index -> num shards expected (BYTEPS_LOCAL_SHARD_EXPORT);
+        # a planned leaf fires once per local device with ITS shard
+        self._shard_plan: dict = shard_plan or {}
         self._mu = threading.Lock()
         self._events: Dict[int, threading.Event] = {}
         self._waiters: Dict[int, tuple] = {}
+        self._shard_waiters: Dict[int, dict] = {}
+        self._shard_left: Dict[int, int] = {}
+        self._shard_started: set = set()
         self._errors: Dict[int, BaseException] = {}
         self._claimed: set = set()
-        self._done: set = set()
+        self._done: set = set()   # whole leaves done + (i, dev) shard fires
         self.streamed = 0
+        self.shard_leaves = 0  # leaves exported as per-device shards
         self.broken = False  # a final claim timed out: callbacks dead
         self.dead = False    # cancelled: late ingests must no-op
 
     def expect(self, i: int) -> None:
         self._events[i] = threading.Event()
+        n = self._shard_plan.get(i)
+        if n is not None:
+            self._shard_left[i] = n
+            self._shard_waiters[i] = {}
 
-    def on_leaf(self, i: int, step_no: int, arr) -> None:
-        """Ingest — runs on the export worker; free to block (the
-        materialization below waits until XLA has the leaf's buffer),
-        but must never raise."""
+    def on_fire(self, i: int, step_no: int, dev: int, arr) -> None:
+        """One tap fire — runs on the export ROUTER; must never raise.
+        Whole leaves dedup per leaf (every device fires the identical
+        post-psum value; first wins) and materialize inline. Shard
+        leaves dedup per (leaf, device) — every device's fire carries a
+        DIFFERENT shard — and hand the materialization to that device's
+        own worker so the shards export in parallel."""
         if self.dead or step_no != self.tag:
             return  # cancelled round / stale fire from an earlier round
         ev = self._events.get(i)
         if ev is None:
+            return
+        if i in self._shard_plan:
+            with self._mu:
+                if (i, dev) in self._done or i in self._claimed:
+                    return
+                self._done.add((i, dev))
+                self._shard_started.add(i)
+            _shard_export_pool(dev).submit(self._ingest_shard, i, dev, arr)
             return
         with self._mu:
             if i in self._done or i in self._claimed:
@@ -287,31 +335,76 @@ class _StreamRound:
         finally:
             ev.set()
 
+    def _ingest_shard(self, i: int, dev: int, arr) -> None:
+        """Device ``dev``'s shard of leaf ``i`` — runs on that device's
+        export worker; free to block on XLA, must never raise. The
+        leaf's event fires when its LAST shard submission lands, so
+        ``claim`` sees either the complete per-shard waiter set or an
+        error."""
+        ev = self._events.get(i)
+        try:
+            host = np.asarray(arr)  # materialize this device's shard
+            if self.dead:  # cancelled while materializing: no submit
+                return
+            self._mark()
+            w = self._submit_shard(i, dev, host.reshape(-1))
+            with self._mu:
+                self._shard_waiters[i][dev] = w
+                self._shard_left[i] -= 1
+                fire = self._shard_left[i] == 0
+                if fire:
+                    # counters mutate under the lock: final shards of
+                    # two leaves can complete concurrently on different
+                    # per-device workers, and an unlocked += loses
+                    # increments the export telemetry (and the shard
+                    # A/B proof) reads
+                    self.streamed += 1
+                    self.shard_leaves += 1
+            if fire:
+                ev.set()
+        except BaseException as e:  # noqa: BLE001 - surfaced via claim()
+            self._errors[i] = e
+            if ev is not None:
+                ev.set()
+
     def cancel(self) -> None:
         """Error-path quiesce: mark the round dead (any ingest that
-        starts from now no-ops) and drain the single-FIFO export worker
-        so an ingest already in flight — which may be checking out an
-        arena lease and allocating a handle — finishes BEFORE the
-        caller's abandon/discard cleanup runs. Without this, a late
-        submit after cleanup leaks a permanently-busy slot and a
-        gradient-sized handle entry (and, on the dispatch-fallback
-        path, hands a stale-pull-targeted lease to the live round)."""
+        starts from now no-ops) and drain the export workers — the
+        router FIRST (it is the only dispatcher into the per-device
+        shard pools, so once its sentinel runs no new shard ingests can
+        appear), then every per-device pool — so an ingest already in
+        flight, which may be checking out an arena lease and allocating
+        a handle, finishes BEFORE the caller's abandon/discard cleanup
+        runs. Without this, a late submit after cleanup leaks a
+        permanently-busy slot and a gradient-sized handle entry (and,
+        on the dispatch-fallback path, hands a stale-pull-targeted
+        lease to the live round)."""
         self.dead = True
-        try:
-            _export_pool().submit(lambda: None).result(timeout=120)
-        except Exception:  # noqa: BLE001 - quiesce is best-effort
-            from ..utils.logging import log
-            log.warning("stream-export worker did not quiesce in time; "
-                        "a late ingest may leak one staging slot")
+        pools = [_export_pool()]
+        pools.extend(_shard_export_pool(d) for d in sorted(_SHARD_POOLS))
+        for pool in pools:
+            try:
+                pool.submit(lambda: None).result(timeout=120)
+            except Exception:  # noqa: BLE001 - quiesce is best-effort
+                from ..utils.logging import log
+                log.warning(
+                    "stream-export worker did not quiesce in time; "
+                    "a late ingest may leak one staging slot")
 
     def claim(self, i: int, timeout: float, final: bool):
-        """Collect leaf ``i``'s waiter, or None when the ingest hasn't
-        fired within ``timeout``. ``final=False`` just peeks (the loop
-        then blocks on the leaf itself, surfacing a compute error
-        promptly instead of stalling here); ``final=True`` claims the
-        leaf for the synchronous fallback on timeout — a late ingest is
-        then ignored — and latches ``broken`` so the round's remaining
-        leaves skip straight to the fallback."""
+        """Collect leaf ``i``'s waiter — a ``(finish, notifier)`` tuple
+        for whole leaves, ``("shards", [(dev, waiter), ...])`` for
+        shard-planned leaves — or None when the ingest hasn't fired
+        within ``timeout``. ``final=False`` just peeks (the loop then
+        blocks on the leaf itself, surfacing a compute error promptly
+        instead of stalling here); ``final=True`` claims the leaf for
+        the synchronous fallback on timeout — a late ingest is then
+        ignored — and latches ``broken`` so the round's remaining
+        leaves skip straight to the fallback. A shard leaf whose round
+        PARTIALLY started is never claimed for fallback: some of its
+        shard keys are already on the wire, and a whole-leaf resubmit
+        would desynchronize this worker's key set from its peers' — the
+        claim blocks for the in-flight submissions instead."""
         if self.broken:
             timeout = 0.0
         ev = self._events[i]
@@ -319,7 +412,9 @@ class _StreamRound:
             if not final:
                 return None
             with self._mu:
-                if i not in self._done:
+                started = (i in self._done
+                           or i in self._shard_started)
+                if not started:
                     self._claimed.add(i)
                     self.broken = True
                     return None
@@ -327,13 +422,33 @@ class _StreamRound:
         err = self._errors.get(i)
         if err is not None:
             raise err
+        if i in self._shard_plan:
+            with self._mu:
+                return ("shards",
+                        sorted(self._shard_waiters[i].items()))
         return self._waiters[i]
 
-    def handles(self):
-        """Handles of every streamed submission (error-path discard)."""
+    def any_submitted(self) -> bool:
+        """True when ANY submission reached the scheduler — including a
+        PARTIAL shard round (some of a leaf's shard keys on the wire,
+        the leaf not yet counted in ``streamed``). Read after
+        ``cancel()`` (the quiesce guarantees no ingest is mid-submit):
+        the dispatch-failure handler must not retry the round when
+        anything was pushed, or the resubmitted keys would double-push
+        and positionally shift every later aggregation."""
         with self._mu:
-            return [n for _, n in self._waiters.values()
-                    if hasattr(n, "id")]
+            return bool(self._waiters) or any(
+                ws for ws in self._shard_waiters.values())
+
+    def handles(self):
+        """Handles of every streamed submission, whole-leaf and
+        per-shard alike (error-path discard)."""
+        with self._mu:
+            hs = [n for _, n in self._waiters.values()
+                  if hasattr(n, "id")]
+            for ws in self._shard_waiters.values():
+                hs.extend(n for _, n in ws.values() if hasattr(n, "id"))
+            return hs
 
 
 def _comp_pool():
@@ -426,6 +541,7 @@ def make_ps_train_step(
     device_compress: Optional[bool] = None,
     stream_export: Optional[bool] = None,
     sharded_apply: Optional[bool] = None,
+    local_shard_export: Optional[bool] = None,
 ):
     """Three-stage COMPUTE → PUSH → UPDATE train step for the DCN PS
     path — the reference's actual architecture (docs/architecture.md
@@ -464,6 +580,25 @@ def make_ps_train_step(
     that honor donation — treat a raised step like the donated fused
     apply's mid-apply failure and restart from a checkpoint rather than
     retrying with the same trees.
+
+    ``local_shard_export`` (BYTEPS_LOCAL_SHARD_EXPORT, default on;
+    requires streaming): the hierarchical exchange —
+    reduce-scatter → push shard → update shard → all-gather. Eligible
+    leaves are reduce-SCATTERED instead of psum'd, so each local
+    device taps and exports only its own flat 1/local_size shard
+    (per-device export workers parallelize the D2H); each shard rides
+    its own PS key, spread across servers by the registry's
+    load-balanced assignment; the completion-ordered drain imports
+    shard k back into the device that owns it (1/local_size H2D per
+    device instead of the full aggregated leaf to every device), runs
+    the optimizer update on the shard alone (jax/optim.py
+    make_shard_apply; shard-separability verified by probe), and a
+    jitted all-gather rebuilds the replicated params and state.
+    Per-device D2H/H2D and per-key wire bytes divide by local_size.
+    Leaves below BYTEPS_SHARD_MIN_BYTES, leaves whose padding would
+    exceed 1/8 of their size, rowsparse/host-compressed/bucket-fused
+    leaves, multi-axis meshes and single-device meshes fall back to
+    the whole-leaf path — numerics bitwise identical either way.
 
     ``compression``: string-kwargs dict for the codec registry (e.g.
     ``{"compressor": "onebit", "ef": "vanilla"}``) — gradients then ride
@@ -506,10 +641,18 @@ def make_ps_train_step(
     # a build/dispatch failure so a broken callback path costs one
     # warning, not one attempt per step)
     stream_state: dict = {"fn": None, "key": None, "disabled": False,
-                          "tag": 0, "holder": {"round": None}}
+                          "tag": 0, "holder": {"round": None},
+                          # locality-shard plan (BYTEPS_LOCAL_SHARD_EXPORT):
+                          # leaf index -> sizing/names, the declared shard
+                          # subrange names (freed when the plan changes),
+                          # and the cached P(axis) sharding for imports
+                          "shard_info": {}, "shard_names": set(),
+                          "nsharding": None}
     # sharded-apply build cache (keyed by params+opt_state structure;
-    # sa None = transform not separable -> fused apply)
-    sa_state: dict = {"sa": None, "key": None}
+    # sa None = transform not separable -> fused apply; ssa None =
+    # not SHARD-separable -> gather gradients, full-leaf apply)
+    sa_state: dict = {"sa": None, "key": None, "ssa": None,
+                      "ssa_key": None, "gather": None}
     # deferred arena releases from sharded rounds: (leases, imported)
     pending: list = []
 
@@ -523,42 +666,83 @@ def make_ps_train_step(
         local_grads, mesh=mesh, in_specs=(P(), P(axis)),
         out_specs=(P(), P()), check_vma=False))
 
-    def _build_streamed_fn(eligible):
+    def _build_streamed_fn(eligible, shard_set=(), n_leaves=0):
         """The tapped backward: identical math to ``grad_fn`` plus an
         io_callback on each eligible gradient leaf INSIDE the
         shard_mapped body — XLA schedules each tap right after its
-        leaf's psum, so the callback fires while later gradients are
-        still being produced (measured: first fire at ~1/3 of the
+        leaf's collective, so the callback fires while later gradients
+        are still being produced (measured: first fire at ~1/3 of the
         backward wall). The step tag rides through the program so a
         late duplicate fire can never be mistaken for the next round's
-        export."""
+        export.
+
+        Leaves in ``shard_set`` (BYTEPS_LOCAL_SHARD_EXPORT) ride
+        ``reduce_scatter`` instead of the psum: each device's tap then
+        carries only ITS flat 1/local_size shard (the device index
+        rides alongside), the program returns those leaves
+        P(axis)-sharded, and only 1/local_size of the leaf ever crosses
+        device->host per device — BytePS's hierarchical "the
+        intra-machine reduce puts 1/local_size on the wire". The
+        remaining leaves keep the exact whole-leaf path (one psum over
+        their subtree, replicated output), so disabling sharding per
+        leaf is bitwise-invisible."""
         from jax.experimental import io_callback
 
-        holder = stream_state["holder"]
+        from ..ops.push_pull import scatter_leaf
 
-        def _ingest(i, step_arr, arr):
+        holder = stream_state["holder"]
+        shard_set = frozenset(shard_set)
+
+        def _ingest(i, step_arr, dev_arr, arr):
             # round resolved at INGEST time: a stale fire then fails
-            # the tag check instead of resurrecting a finished round
+            # the tag check instead of resurrecting a finished round.
+            # int() here materializes only the two scalars — the heavy
+            # payload is materialized by whichever worker the round
+            # routes it to (router for whole leaves, per-device worker
+            # for shards)
             rnd = holder["round"]
             if rnd is not None:
-                rnd.on_leaf(i, int(step_arr), arr)
+                rnd.on_fire(i, int(step_arr), int(dev_arr), arr)
 
-        def _tap(i, step_arr, arr):
+        def _tap(i, step_arr, dev_arr, arr):
             # device thread: enqueue ONLY (see _export_pool — touching
-            # the lazy callback arg here would self-deadlock)
-            _export_pool().submit(_ingest, i, step_arr, arr)
+            # the lazy callback args here would self-deadlock)
+            _export_pool().submit(_ingest, i, step_arr, dev_arr, arr)
 
         def streamed_local(step_tag, params, batch):
-            loss, grads = local_grads(params, batch)
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             leaves = jax.tree.leaves(grads)
-            for i in eligible:
-                io_callback(functools.partial(_tap, i), None, step_tag,
-                            leaves[i], ordered=False)
-            return loss, grads
+            # ONE psum over the whole-leaf subtree (identical reduction
+            # grouping to the untapped grad_fn's full-tree psum), RS
+            # per shard leaf
+            whole_idx = [i for i in range(len(leaves))
+                         if i not in shard_set]
+            whole = psum_tree([leaves[i] for i in whole_idx],
+                              axis=axis, average=True)
+            whole_map = dict(zip(whole_idx, whole))
+            idx = jax.lax.axis_index(axis)
+            outs = []
+            for i in range(len(leaves)):
+                if i in shard_set:
+                    sh = scatter_leaf(leaves[i], axis=axis, average=True)
+                    io_callback(functools.partial(_tap, i), None,
+                                step_tag, idx, sh, ordered=False)
+                    outs.append(sh)
+                else:
+                    g = whole_map[i]
+                    if i in eligible:
+                        io_callback(functools.partial(_tap, i), None,
+                                    step_tag, idx, g, ordered=False)
+                    outs.append(g)
+            loss = jax.lax.pmean(loss, axis)
+            return loss, tuple(outs)
 
+        out_leaf_specs = tuple(
+            P(axis) if i in shard_set else P()
+            for i in range(n_leaves))
         return jax.jit(jax.shard_map(
             streamed_local, mesh=mesh, in_specs=(P(), P(), P(axis)),
-            out_specs=(P(), P()), check_vma=False))
+            out_specs=(P(), out_leaf_specs), check_vma=False))
 
     def apply_updates_fn(params, opt_state, grads):
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -665,6 +849,22 @@ def make_ps_train_step(
             leases.append(lease)
             return lease.array(dtype)
 
+        # export-plane instruments (registered every round so they are
+        # present in the snapshot even when no leaf shards — the docs
+        # schema guard runs a dense whole-leaf step): whole-leaf
+        # exports are one device's replicated buffer crossing D2H, so
+        # they account to device 0; shard exports account to the
+        # device that owns the shard. The shard A/B's hard proof is
+        # the ratio between these per-device counters.
+        metrics = state.metrics
+        exp_shard_ctr = metrics.counter("export/shard_bytes")
+        exp_whole_ctr = metrics.counter("export/whole_bytes")
+        exp_dev0_ctr = metrics.counter("export/device_bytes/0")
+        metrics.gauge("export/shard_workers").set(len(_SHARD_POOLS))
+        metrics.gauge("export/worker_ingests/0").set(
+            _SHARD_INGESTS.get(0, 0))
+        ag_hist = metrics.histogram("step/allgather_us")
+
         # time-to-first-push: wall from the backward's dispatch to the
         # first submission entering the scheduler, whichever thread
         # gets there first (telemetry: export_ttfp_ms)
@@ -736,7 +936,48 @@ def make_ps_train_step(
                 flat = flat.astype(np.float32, copy=False)
             ctx = get_or_init_ctx(state, name, flat)
             pr = state.scheduler.production_priority(ctx)
+            exp_whole_ctr.inc(flat.nbytes)
+            exp_dev0_ctr.inc(flat.nbytes)
             return submit(name, flat, priority=pr, tag="export")
+
+        def submit_shard(i, dev, flat):
+            """Shard-side submit (runs on device ``dev``'s export
+            worker): device ``dev``'s 1/local_size shard of leaf ``i``
+            rides its own subrange key at the PARENT leaf's
+            production-order priority (all shards of one leaf are one
+            production event), with its own per-shard arena result
+            slot (tag="shard" in the arena counters)."""
+            from ..server.client import get_or_init_ctx
+            info = stream_state["shard_info"][i]
+            ctx = get_or_init_ctx(state, info["names"][dev], flat)
+            pr = state.scheduler.production_priority(
+                ctx, parent=info["parent"])
+            exp_shard_ctr.inc(flat.nbytes)
+            metrics.counter(f"export/device_bytes/{dev}").inc(flat.nbytes)
+            _SHARD_INGESTS[dev] = _SHARD_INGESTS.get(dev, 0) + 1
+            metrics.gauge(f"export/worker_ingests/{dev}").set(
+                _SHARD_INGESTS[dev])
+            return submit(info["names"][dev], flat, priority=pr,
+                          tag="shard")
+
+        def submit_shard_fallback(i, k, flat_piece):
+            """Post-jit shard submit (drain thread): a shard-planned
+            leaf whose taps never fired — or whose whole round runs on
+            the untapped grad_fn — STILL pushes its per-shard keys, so
+            this worker's key set never diverges from peers whose taps
+            are healthy (a whole-leaf submit here would stall every
+            worker's aggregation on both key sets). One device did the
+            whole D2H (accounted to device 0); wire and import stay
+            per-shard."""
+            from ..server.client import get_or_init_ctx
+            info = stream_state["shard_info"][i]
+            ctx = get_or_init_ctx(state, info["names"][k], flat_piece)
+            pr = state.scheduler.production_priority(
+                ctx, parent=info["parent"])
+            exp_shard_ctr.inc(flat_piece.nbytes)
+            exp_dev0_ctr.inc(flat_piece.nbytes)
+            return submit(info["names"][k], flat_piece, priority=pr,
+                          tag="shard")
 
         # Bucket fusion (BYTEPS_FUSION_BYTES; the group-push cure):
         # per-key cost (scheduler admission, handle, two syscall
@@ -821,8 +1062,17 @@ def make_ps_train_step(
             else getattr(state.config, "stream_export", True)
         stream_on = (stream_cfg and state.scheduler is not None
                      and not stream_state["disabled"])
+        # ``stream_avail`` is the DETERMINISTIC gate (config + topology
+        # — identical on every worker); ``stream_on`` additionally
+        # folds in this process's runtime latch (broken callbacks).
+        # The locality-shard PLAN below must key off stream_avail, not
+        # stream_on: the set of PS keys a worker pushes has to be a
+        # pure function of deterministic inputs, or one worker's
+        # runtime fallback would desynchronize the key sets and stall
+        # every peer's aggregation.
+        stream_avail = (stream_cfg and state.scheduler is not None)
         eligible: tuple = ()
-        if stream_on:
+        if stream_avail:
             el = []
             for i, (name, leaf) in enumerate(zip(names, p_leaves)):
                 if rowsparse_params and any(s in name
@@ -833,17 +1083,92 @@ def make_ps_train_step(
                     continue
                 el.append(i)
             eligible = tuple(el)
-            stream_on = bool(eligible)
-        if stream_on and stream_state["key"] != (treedef, eligible):
-            try:
-                stream_state["fn"] = _build_streamed_fn(eligible)
-                stream_state["key"] = (treedef, eligible)
-            except Exception as e:  # noqa: BLE001 - clean fallback
-                stream_on = False
-                _disable_stream(
-                    stream_state,
-                    "streamed gradient export unavailable (%s); "
-                    "falling back to post-jit export", e)
+        stream_on = stream_on and bool(eligible)
+        # ---- locality-shard plan (BYTEPS_LOCAL_SHARD_EXPORT): which
+        # eligible leaves reduce-scatter so each local device exports
+        # only its own 1/local_size shard. Host-compressed rounds keep
+        # whole-leaf keys (the codec unit is the declared key — a
+        # per-shard codec would reset EF/momentum state per device),
+        # multi-axis and single-device meshes have no locality axis to
+        # shard over, and leaves below the size/pad thresholds are not
+        # worth local_size extra key round-trips. All of these gates
+        # are deterministic across workers; a leaf in the plan rides
+        # its shard keys on EVERY path, streamed or fallback.
+        shard_cfg = local_shard_export if local_shard_export is not None \
+            else getattr(state.config, "local_shard_export", True)
+        n_shard = 0
+        if (shard_cfg and stream_avail and reg is None
+                and len(mesh.axis_names) == 1):
+            n_shard = int(mesh.shape.get(axis, 1))
+        shard_set: tuple = ()
+        if n_shard > 1:
+            from ..ops.push_pull import shard_layout
+            smin = max(fusion, getattr(state.config, "shard_min_bytes",
+                                       65536))
+            ss = []
+            for i in eligible:
+                leaf = p_leaves[i]
+                if leaf.nbytes < smin:
+                    continue
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                _, pad = shard_layout(size, n_shard)
+                if pad * 8 > size:
+                    continue  # padding beyond 1/8: not worth the wire
+                ss.append(i)
+            shard_set = tuple(ss)
+        plan_key = (treedef, eligible, shard_set, n_shard)
+        if stream_avail and stream_state["key"] != plan_key:
+            # declare the per-shard subrange keys FIRST, in flatten
+            # order — every worker flattens the same tree, so the
+            # shard declared_keys agree across workers (tap-order
+            # declaration would race per-device workers); the parent
+            # name is declared too, as the production-order anchor all
+            # of a leaf's shards share. This runs even when the tap
+            # build below fails or is latched off: the fallback paths
+            # still push the SHARD keys.
+            from ..core.types import DataType
+            from ..ops.push_pull import shard_layout
+            info: Dict[int, dict] = {}
+            declared: set = set()
+            for i in shard_set:
+                leaf = p_leaves[i]
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                slen, _ = shard_layout(size, n_shard)
+                dt = np.dtype(leaf.dtype)
+                ctxs = state.registry.declare_shards(
+                    names[i], slen * dt.itemsize, n_shard,
+                    DataType.from_np(dt))
+                info[i] = {
+                    "n": n_shard, "shard_len": slen, "size": size,
+                    "dtype": dt,
+                    "names": [c.name for c in ctxs],
+                    "parent": state.registry.declare(
+                        names[i], DataType.from_np(dt)),
+                }
+                declared.update(c.name for c in ctxs)
+            # shard-subrange free: retire stale keys' server-load
+            # accounting when the plan changes (leaf resized, knob
+            # flipped, mesh changed) — dead keys must not skew
+            # later least-loaded assignments
+            for stale in stream_state["shard_names"] - declared:
+                state.registry.free(stale)
+            stream_state["shard_info"] = info
+            stream_state["shard_names"] = declared
+            if shard_set:
+                from jax.sharding import NamedSharding
+                stream_state["nsharding"] = NamedSharding(mesh, P(axis))
+            if stream_on:
+                try:
+                    stream_state["fn"] = _build_streamed_fn(
+                        eligible, shard_set, len(names))
+                except Exception as e:  # noqa: BLE001 - clean fallback
+                    stream_on = False
+                    _disable_stream(
+                        stream_state,
+                        "streamed gradient export unavailable (%s); "
+                        "falling back to post-jit export", e)
+            stream_state["key"] = plan_key
+        stream_on = stream_on and stream_state["fn"] is not None
 
         # ---- sharded-apply build (cached per tree structure) ----
         sharded_cfg = sharded_apply if sharded_apply is not None \
@@ -856,14 +1181,34 @@ def make_ps_train_step(
                 sa_state["sa"] = make_sharded_apply(tx, params, opt_state)
                 sa_state["key"] = skey
             sa = sa_state["sa"]  # None -> not separable -> fused apply
+        # shard-mapped apply for shard-exported leaves: update runs on
+        # the 1/local_size shard each device just imported, then the
+        # gather jit rebuilds replicated params/state. ssa None (not
+        # shard-separable, e.g. block-norm scaling) -> the drain
+        # gathers the gradient instead and applies full-leaf.
+        ssa = None
+        if shard_set and sa is not None:
+            ssa_key = (sa_state["key"], n_shard)
+            if sa_state["ssa_key"] != ssa_key:
+                from .optim import make_shard_apply
+                sa_state["ssa"] = make_shard_apply(
+                    tx, params, opt_state, mesh, axis, n_shard, base=sa)
+                sa_state["ssa_key"] = ssa_key
+            ssa = sa_state["ssa"]
+        if shard_set and sa_state["gather"] is None:
+            from .optim import LeafGather
+            sa_state["gather"] = LeafGather(mesh, axis)
 
         # ---- dispatch the backward (tapped when streaming) ----
         round_obj = None
         loss = grads = None
         if stream_on:
             stream_state["tag"] += 1
-            round_obj = _StreamRound(stream_state["tag"], names,
-                                     submit_streamed, mark_first_push)
+            round_obj = _StreamRound(
+                stream_state["tag"], names, submit_streamed,
+                mark_first_push,
+                shard_plan={i: n_shard for i in shard_set},
+                submit_shard=submit_shard)
             for i in eligible:
                 round_obj.expect(i)
             stream_state["holder"]["round"] = round_obj
@@ -876,7 +1221,7 @@ def make_ps_train_step(
                 # submitted, and latch the fallback
                 stream_state["holder"]["round"] = None
                 round_obj.cancel()
-                streamed_any = round_obj.streamed > 0
+                streamed_any = round_obj.any_submitted()
                 for h in round_obj.handles():
                     state.handles.discard(h.id)
                 for lease in leases:
@@ -918,6 +1263,19 @@ def make_ps_train_step(
         imported: list = [None] * len(names)
         new_params: list = [None] * len(names)
         apply_parts: list = [None] * len(names)
+        # per-leaf shard import state (BYTEPS_LOCAL_SHARD_EXPORT):
+        # shard k of leaf i lands on the device that owns it the moment
+        # its pull completes; when the last shard of a leaf lands, the
+        # shards assemble into one P(axis)-sharded array and the
+        # shard update + all-gather dispatch
+        # the PLAN decides shard-key participation — with or without a
+        # live streamed round — so every path (streamed taps, broken-tap
+        # fallback, untapped grad_fn retry) pushes the same key set as
+        # every other worker
+        active_shard = stream_state["shard_info"] if shard_set else {}
+        shard_parts: Dict[int, list] = {}
+        shard_left: Dict[int, int] = {}
+        axis_devs = list(mesh.devices.flat)
         try:
             for i, (name, leaf) in enumerate(zip(names, g_leaves)):
                 if i in streamed_set:
@@ -929,13 +1287,50 @@ def make_ps_train_step(
                     # claim latches via round.broken)
                     w = round_obj.claim(i, timeout=5.0, final=False)
                     if w is None:
-                        np.asarray(leaf)  # ready-or-raise
+                        # ready-or-raise WITHOUT materializing: a
+                        # D2H here would assemble the full (for shard
+                        # leaves: cross-device) value only to discard
+                        # it when the claim then succeeds
+                        jax.block_until_ready(leaf)
                         w = round_obj.claim(i, timeout=30.0, final=True)
                     if w is not None:
-                        waiters.append((i, *w))
+                        if (isinstance(w, tuple) and len(w) == 2
+                                and w[0] == "shards"):
+                            shard_parts[i] = [None] * active_shard[i]["n"]
+                            shard_left[i] = active_shard[i]["n"]
+                            for dev, (fin, notif) in w[1]:
+                                waiters.append((("shard", i, dev),
+                                                fin, notif))
+                        else:
+                            waiters.append((i, *w))
                         continue
                     # claimed for fallback: export synchronously below
                 h = np.asarray(leaf)  # ready-or-wait for THIS leaf
+                if i in active_shard:
+                    # shard-planned leaf on a fallback path (taps dead,
+                    # or the whole round on the untapped grad_fn): keep
+                    # the SHARD keys — slice the host copy into the
+                    # same padded subranges the taps would have pushed.
+                    # From the tapped program the value is already the
+                    # reduce-scattered flat (concat of shards == padded
+                    # summed flat, bitwise); from grad_fn it is the
+                    # full psum'd leaf and pads here.
+                    info = active_shard[i]
+                    flat = h.reshape(-1)
+                    total = info["n"] * info["shard_len"]
+                    if flat.size != total:
+                        flat = np.pad(flat, (0, total - flat.size))
+                    flush_bucket()
+                    shard_parts[i] = [None] * info["n"]
+                    shard_left[i] = info["n"]
+                    slen = info["shard_len"]
+                    for k in range(info["n"]):
+                        w = submit_shard_fallback(
+                            i, k, flat[k * slen:(k + 1) * slen])
+                        waiters.append((("shard", i, k), *w))
+                    continue
+                exp_whole_ctr.inc(h.nbytes)
+                exp_dev0_ctr.inc(h.nbytes)
                 if _route_rowsparse(name, h, state, rowsparse_params):
                     flush_bucket()
                     # non-f32 grads upcast for the wire, cast back
@@ -957,7 +1352,10 @@ def make_ps_train_step(
                 # np.asarray above blocked on ITS leaf): the compute +
                 # export wall of this step's report
                 prof.mark("export_done")
-            shapes = [np.shape(leaf) for leaf in g_leaves]
+            # param shapes, not gradient-output shapes: a shard-planned
+            # leaf's program output is the flat padded sharded layout,
+            # but everything imported/applied below is leaf-shaped
+            shapes = [np.shape(pl) for pl in p_leaves]
             # Completion-ordered drain — IMPORT + UPDATE: issue the
             # async H2D device_put for each leaf THE MOMENT its pull
             # lands (XLA overlaps the import of tensor k with the DCN
@@ -995,6 +1393,53 @@ def make_ps_train_step(
                 if prof is not None:
                     prof.stage_sample("H2D_UPDATE", dt)
 
+            def land_shard(s, dev, piece):
+                # import shard `dev` of leaf `s` onto the device that
+                # owns it — 1/local_size of the H2D the whole-leaf
+                # import moved, overlapped with the remaining pulls
+                t0 = _time.perf_counter()
+                info = active_shard[s]
+                parts = shard_parts[s]
+                parts[dev] = jax.device_put(piece, axis_devs[dev])
+                shard_left[s] -= 1
+                dt = _time.perf_counter() - t0
+                h2d_hist.record_seconds(dt)
+                if prof is not None:
+                    prof.stage_sample("H2D_UPDATE", dt)
+                if shard_left[s]:
+                    return
+                # last shard landed: assemble the P(axis)-sharded
+                # gradient, run the update on the shards, and dispatch
+                # the all-gather that rebuilds the replicated leaves
+                garr = jax.make_array_from_single_device_arrays(
+                    (info["n"] * info["shard_len"],),
+                    stream_state["nsharding"], parts)
+                imported[s] = garr
+                t_ag = _time.perf_counter()
+                if ssa is not None and sa_round is not None:
+                    pparts, shared = sa_round.slice(s)
+                    new_sh, npp_sh, n_shared = ssa.apply(
+                        p_leaves[s], pparts, shared, garr)
+                    fulls = ssa.gather((new_sh, *npp_sh),
+                                       [p_leaves[s], *pparts])
+                    new_params[s] = fulls[0]
+                    apply_parts[s] = (list(fulls[1:]), n_shared)
+                else:
+                    # transform not shard-separable (or fused apply):
+                    # gather the GRADIENT instead and apply full-leaf —
+                    # the D2H/wire/H2D savings stand, only the update
+                    # FLOPs stay replicated
+                    tmpl = jax.ShapeDtypeStruct(shapes[s], info["dtype"])
+                    full = sa_state["gather"]((garr,), [tmpl])[0]
+                    imported[s] = full
+                    if sa_round is not None:
+                        new_params[s], apply_parts[s] = sa_round.apply(
+                            p_leaves[s], s, full)
+                dt = _time.perf_counter() - t_ag
+                ag_hist.record_seconds(dt)
+                if prof is not None:
+                    prof.stage_sample("ALLGATHER", dt)
+
             for _ in range(len(waiters)):
                 t_wait = _time.perf_counter()
                 wi = ready.get()
@@ -1006,6 +1451,8 @@ def make_ps_train_step(
                 if isinstance(slot, list):
                     for s, piece in zip(slot, finish()):
                         land(s, piece)
+                elif isinstance(slot, tuple):
+                    land_shard(slot[1], slot[2], finish())
                 else:
                     land(slot, finish())
             if sa is None:
@@ -1053,7 +1500,9 @@ def make_ps_train_step(
             round_obj.streamed if round_obj is not None else 0,
             len(names) - (round_obj.streamed
                           if round_obj is not None else 0),
-            first_push[0])
+            first_push[0],
+            shard_leaves=(round_obj.shard_leaves
+                          if round_obj is not None else 0))
         if sa is not None:
             # UPDATEs are already in flight; the end-of-step barrier is
             # gone. The leases release on whichever fires first: the

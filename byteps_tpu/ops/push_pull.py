@@ -93,6 +93,25 @@ def _scatter_leaf(g: jnp.ndarray, axis: str, average: bool) -> jnp.ndarray:
     return out
 
 
+def shard_layout(size: int, num_shards: int) -> tuple:
+    """THE shard sizing rule for the locality-sharded export path:
+    ``(shard_len, pad)`` such that ``shard_len * num_shards ==
+    size + pad`` — identical to the padding ``_scatter_leaf`` applies
+    inside the compiled program, so the host-side import plan
+    (per-shard key sizes, H2D shapes, trim) can never disagree with the
+    device-side reduce-scatter layout."""
+    shard_len = (size + num_shards - 1) // num_shards
+    return shard_len, shard_len * num_shards - size
+
+
+def scatter_leaf(g: jnp.ndarray, axis: str = DP_AXIS,
+                 average: bool = True) -> jnp.ndarray:
+    """Public single-leaf ReduceScatter (the locality-sharded export
+    tap reduce-scatters individual eligible leaves while the rest of
+    the tree rides one psum)."""
+    return _scatter_leaf(g, axis, average)
+
+
 def reduce_scatter_tree(tree: Any, axis: str = DP_AXIS,
                         average: bool = True) -> Any:
     """ReduceScatter every leaf: afterwards each device holds a flat 1/N shard

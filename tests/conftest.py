@@ -12,8 +12,16 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_fast_math" not in flags:
+    # XLA CPU fast-math reassociates FMA contraction per SHAPE, so the
+    # same elementwise math on a (50,7) leaf vs its flat 1/N shards can
+    # differ by 1 ULP — which would make the locality-shard parity
+    # suites (shard on vs off bitwise) flake on exactly the property
+    # they guard. TPU codegen has no fast-math reassociation; pinning
+    # it off here makes the CPU harness match the hardware contract.
+    flags = (flags + " --xla_cpu_enable_fast_math=false").strip()
+os.environ["XLA_FLAGS"] = flags
 os.environ.setdefault("BYTEPS_LOG_LEVEL", "WARNING")
 
 import jax  # noqa: E402

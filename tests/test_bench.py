@@ -86,6 +86,14 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "wire_twoop_requests": 144,
                     "wire_request_ratio": 0.5,
                     "wire_half_proof": True}, None
+        if name == "shard_ab":
+            return {"shard_on_step_ms": 3.9,
+                    "shard_off_step_ms": 4.2,
+                    "shard_local_size": 8,
+                    "shard_bytes_per_device_on": 3145728,
+                    "shard_bytes_per_device_off": 25165824,
+                    "shard_reduction_ratio": 8.0,
+                    "shard_counter_proof": True}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
@@ -98,6 +106,8 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     assert out["stream_ttfp_on_ms"] == 0.9
     assert out["wire_fused_step_ms"] == 3.6
     assert out["wire_request_ratio"] == 0.5
+    assert out["shard_on_step_ms"] == 3.9
+    assert out["shard_reduction_ratio"] == 8.0
     assert out["pushpull_throttled_2srv_gbps"] == 0.2
     assert out["arena_on_step_ms"] == 5.0
     assert out["vs_baseline"] == round(100000.0 / 51810.0, 4)
@@ -139,6 +149,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"wire_fused_step_ms": 3.6,
                     "wire_twoop_step_ms": 4.1,
                     "wire_request_ratio": 0.5}, None
+        if name == "shard_ab":
+            return {"shard_on_step_ms": 3.9,
+                    "shard_off_step_ms": 4.2,
+                    "shard_reduction_ratio": 8.0}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         raise AssertionError(name)
@@ -156,13 +170,13 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    assert calls.count("probe") == 9 + n_final
+    assert calls.count("probe") == 10 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull", "after_pushpull_2srv",
         "after_pushpull_throttled", "after_arena_ab",
         "after_metrics_ab", "after_stream_ab", "after_wire_ab",
-        "after_scaling",
+        "after_shard_ab", "after_scaling",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
     assert any(str(d.get("at", "")).startswith("final_wait")
@@ -283,7 +297,7 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "arena_ab", "metrics_ab",
-                            "stream_ab", "wire_ab", "scaling"}
+                            "stream_ab", "wire_ab", "shard_ab", "scaling"}
 
 
 def test_partial_snapshots_survive_a_kill(bench, monkeypatch, capsys):

@@ -113,3 +113,98 @@ def test_hash_naive_matches_reference_formula():
     for key in (0, 1, 65535, 65536, 1 << 16 | 5, 123456789):
         want = (((key >> 16) + (key % 65536)) * 9973)
         assert _hash_naive(str(key)) == want, key
+
+
+# --------------------------------------------------------------------- #
+# locality-shard subranges + server-load balance hardening
+# --------------------------------------------------------------------- #
+
+
+def _live_load(reg):
+    """Sum of live contexts' partition lengths per server — the ground
+    truth the _server_load table must equal at all times."""
+    want = [0] * max(1, reg._config.num_servers)
+    for ctx in reg.contexts_in_order():
+        for p in ctx.partitions:
+            want[p.server] += p.length
+    return want
+
+
+def test_declare_shards_spread_and_naming():
+    reg = make_registry(key_hash_fn="mixed", num_servers=4)
+    ctxs = reg.declare_shards("grad/w1", shard_nbytes=2048, num_shards=8,
+                              dtype=DataType.FLOAT32)
+    assert [c.name for c in ctxs] == [
+        TensorRegistry.shard_name("grad/w1", k, 8) for k in range(8)]
+    # distinct declared keys, deterministic order
+    assert [c.declared_key for c in ctxs] == list(range(8))
+    # least-loaded assignment spreads one leaf's shards ACROSS servers
+    servers = {c.partitions[0].server for c in ctxs}
+    assert len(servers) == 4, "shards of one leaf pinned to one server"
+    assert reg.server_loads() == _live_load(reg)
+    # idempotent re-declaration: same contexts, load unchanged
+    again = reg.declare_shards("grad/w1", 2048, 8, DataType.FLOAT32)
+    assert [c.declared_key for c in again] == [c.declared_key
+                                              for c in ctxs]
+    assert reg.server_loads() == _live_load(reg)
+
+
+def test_free_retires_load_and_declaration_order():
+    reg = make_registry(key_hash_fn="mixed", num_servers=3)
+    reg.init_tensor("a", nbytes=8192)
+    reg.declare_shards("b", 4096, 4)
+    assert reg.server_loads() == _live_load(reg)
+    for k in range(4):
+        assert reg.free(TensorRegistry.shard_name("b", k, 4))
+    assert not reg.free("never-declared")
+    assert reg.server_loads() == _live_load(reg)
+    assert sum(reg.server_loads()) == 8192  # only "a" remains
+    # a freed name re-declares under a NEW key (monotonic, never reused)
+    nk = reg.declare(TensorRegistry.shard_name("b", 0, 4)).declared_key
+    assert nk == 5  # a=0, b shards 1..4, then the re-declaration
+
+
+def test_free_redeclare_balances_under_changed_server_count():
+    """The satellite's declare -> free -> re-declare audit: after an
+    elastic resume onto a DIFFERENT server count, the load table must
+    equal the live partition lengths exactly — no negative entries, no
+    stale load from freed shard subranges, no dropped retirements."""
+    reg = make_registry(key_hash_fn="mixed", num_servers=3,
+                        partition_bytes=4096)
+    reg.init_tensor("w", nbytes=12000)
+    reg.declare_shards("w#s", 4096, 6)
+    reg.init_tensor("v", nbytes=5000)
+    # free half the shard subranges (shard plan shrank)
+    for k in (0, 2, 4):
+        assert reg.free(TensorRegistry.shard_name("w#s", k, 6))
+    assert reg.server_loads() == _live_load(reg)
+    # elastic resume with FEWER servers: table resets + repartition
+    reg.redeclare_all(Config(num_servers=2, partition_bytes=4096))
+    loads = reg.server_loads()
+    assert loads == _live_load(reg)
+    assert all(v >= 0 for v in loads)
+    # freed names stayed freed across the redeclare
+    for k in (0, 2, 4):
+        assert not reg.is_declared(TensorRegistry.shard_name("w#s", k, 6))
+    # ... and more servers again, with churn on top
+    reg.redeclare_all(Config(num_servers=5, partition_bytes=4096))
+    reg.free("v")
+    reg.init_tensor("v", nbytes=7000)   # re-declare, new size
+    reg.init_tensor("w", nbytes=16000)  # resize (retire + reassign)
+    loads = reg.server_loads()
+    assert loads == _live_load(reg)
+    assert all(v >= 0 for v in loads)
+    assert sum(loads) == sum(_live_load(reg))
+
+
+def test_single_server_load_accounting_never_negative():
+    """The audit's single-server fix: _assign_server_locked used to skip
+    the load add for num_servers==1 while every retire path subtracted
+    unconditionally — re-init/free walked the accumulated load negative."""
+    reg = make_registry(num_servers=1, partition_bytes=4096)
+    reg.init_tensor("g", nbytes=10000)
+    assert reg.server_loads() == [10000]
+    reg.init_tensor("g", nbytes=6000)   # resize: retire + reassign
+    assert reg.server_loads() == [6000]
+    assert reg.free("g")
+    assert reg.server_loads() == [0]
