@@ -519,6 +519,85 @@ def phase_pushpull_throttled(total_bytes: int = 64 << 20,
             "throttle_mbps": throttle_mbps}
 
 
+def phase_churn_ab(n_tensors: int = 6, elems: int = 4096,
+                   rounds: int = 5, drop_rate: float = 0.25) -> dict:
+    """Idempotence-under-chaos A/B (docs/fault-tolerance.md): the SAME
+    deterministic push_pull schedule runs against (a) a server that
+    deterministically drops ``drop_rate`` of its aggregate replies
+    (BYTEPS_CHAOS_DROP_REPLY_RATE — every dropped reply forces a client
+    ticket timeout + an epoch-stamped retry) and (b) a clean server.
+    Evidence is exact, not wall-clock: every aggregation result must be
+    BITWISE identical across the two arms (a replayed push that
+    double-counted would read 2x), and the ``wire/retries`` counter must
+    be >0 in the chaos arm and ==0 in the clean arm — proof the chaos
+    actually exercised the replay path rather than silently not firing.
+    """
+    _force_cpu()
+    import numpy as np
+
+    # short ticket expiry so each dropped reply costs ~2s, not the 600s
+    # default; latched per process at first native use, which is why
+    # this runs in the phase child (fresh process), set before any
+    # client exists. Extra retry budget: with several keys in flight a
+    # retry's reply can itself be dropped by the deterministic
+    # accumulator, so give the budget headroom over the expectation.
+    # Scoped save/restore like phase_pushpull_throttled: an in-process
+    # caller running several phases must not leak the 2s timeout / 5x
+    # retry budget into measurements of the default config (the native
+    # timeout stays latched for THIS process either way, but the knob
+    # must not escape into spawned children or later Config reads).
+    _scoped = {"BYTEPS_CLIENT_TIMEOUT_S": "2", "BYTEPS_WIRE_RETRY": "5"}
+    _prior_env = {k: os.environ.get(k) for k in _scoped}
+    os.environ.update(_scoped)
+
+    def run_arm(rate: float):
+        prior = os.environ.get("BYTEPS_CHAOS_DROP_REPLY_RATE")
+        if rate > 0:
+            os.environ["BYTEPS_CHAOS_DROP_REPLY_RATE"] = str(rate)
+        try:
+            with _loopback_ps(1) as bps:
+                rng = np.random.RandomState(7)
+                grads = [rng.randn(elems).astype(np.float32)
+                         for _ in range(n_tensors)]
+                out = []
+                for r in range(rounds):
+                    hs = [bps.push_pull_async(g * (r + 1), f"churn_g{i}",
+                                              average=False)
+                          for i, g in enumerate(grads)]
+                    out.append([np.array(bps.synchronize(h, timeout=120))
+                                for h in hs])
+                snap = bps.get_metrics()
+                retries = int(snap["counters"].get("wire/retries", 0))
+                return out, retries
+        finally:
+            if prior is None:
+                os.environ.pop("BYTEPS_CHAOS_DROP_REPLY_RATE", None)
+            else:
+                os.environ["BYTEPS_CHAOS_DROP_REPLY_RATE"] = prior
+
+    try:
+        chaos_out, chaos_retries = run_arm(drop_rate)
+        clean_out, clean_retries = run_arm(0.0)
+    finally:
+        for k, v in _prior_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    identical = all(
+        np.array_equal(a, b)
+        for ra, rb in zip(chaos_out, clean_out) for a, b in zip(ra, rb))
+    return {"churn_ab_identical": bool(identical),
+            "churn_ab_chaos_retries": chaos_retries,
+            "churn_ab_clean_retries": clean_retries,
+            "churn_ab_drop_rate": drop_rate,
+            # the headline proof bit: chaos produced retries AND the
+            # aggregates stayed bitwise equal to the clean run
+            "churn_ab_idempotent_proof": bool(identical
+                                              and chaos_retries > 0
+                                              and clean_retries == 0)}
+
+
 def phase_arena_ab(steps: int = 6) -> dict:
     """A/B the persistent host staging arena (core/arena.py,
     BYTEPS_STAGING_ARENA) on the PS train step's steady state: the same
@@ -1231,6 +1310,7 @@ _PHASES = {
     "pushpull": phase_pushpull,
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
+    "churn_ab": phase_churn_ab,
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
@@ -1353,6 +1433,10 @@ def main() -> None:
         "shard_off_step_ms": None,
         "shard_reduction_ratio": None,
         "scaling_efficiency_2w": None,
+        "churn_ab_identical": None,
+        "churn_ab_chaos_retries": None,
+        "churn_ab_clean_retries": None,
+        "churn_ab_idempotent_proof": None,
     }
     errors = {}
     # per-attempt tunnel diagnostics: probe wall time, platform, errors —
@@ -1482,11 +1566,27 @@ def main() -> None:
     # wedge window capture nothing).
     try_device("start")
     _flush_partial()
-    for name, timeout_s in (("pushpull", 420.0),
-                            ("pushpull_2srv", 240.0),
+    # Schedule order: the keys that have never landed in a driver
+    # artifact run FIRST (pushpull_throttled_{1,2}srv_gbps and the
+    # scaling_spread / scaling_vs_cap_reps band were implemented and
+    # unit-tested for two rounds yet absent from every BENCH_r* file —
+    # they used to sit behind 660s of pushpull phases and were
+    # budget-gated out of partially-overrun rounds). The long raw
+    # pushpull phases, which have landed every round, moved behind them.
+    for name, timeout_s in (
                             # throttled pair: ~13s of timed work at the
                             # default 100MB/s cap + 3 server launches
                             ("pushpull_throttled", 180.0),
+                            # scaling deadline sized for 6 server+worker
+                            # launches (3 interleaved 1w/2w reps,
+                            # 200-step windows, best-of-3 per config)
+                            ("scaling", 900.0),
+                            # chaos idempotence A/B: reply-drop +
+                            # epoch-dedup'd retries vs clean, bitwise
+                            # equality + retry-counter proof
+                            ("churn_ab", 240.0),
+                            ("pushpull", 420.0),
+                            ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback
                             # train runs (arena on vs off)
                             ("arena_ab", 240.0),
@@ -1506,11 +1606,7 @@ def main() -> None:
                             # per-device shard export vs whole-leaf,
                             # with the per-device-bytes / local_size
                             # counter proof on an 8-device CPU mesh
-                            ("shard_ab", 240.0),
-                            # scaling deadline sized for 6 server+worker
-                            # launches (3 interleaved 1w/2w reps,
-                            # 200-step windows, best-of-3 per config)
-                            ("scaling", 900.0)):
+                            ("shard_ab", 240.0)):
         # budget-gate the CPU phases (the round-5 envelope bug: they ran
         # to their full deadlines regardless of remaining(), pushing the
         # worst case past the driver's window): skip when the budget is

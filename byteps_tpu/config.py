@@ -156,6 +156,19 @@ class Config:
     # identical either way). ---
     fused_pushpull: bool = True           # BYTEPS_FUSED_PUSHPULL
 
+    # --- fault tolerance (rebuild addition; docs/fault-tolerance.md).
+    # A failed wire exchange (fused PUSHPULL or two-op push/pull) no
+    # longer hard-fails the round: the scheduler retries the partition
+    # with exponential backoff, re-routing to a surviving server when
+    # the native client reports the assigned one dead (registry
+    # migrate_server). wire_retry = retry attempts AFTER the first
+    # (0 restores fail-on-first-error); wire_backoff_ms = initial
+    # backoff, doubling per attempt, capped at 2000ms. Replayed pushes
+    # are (round, attempt)-stamped so the server folds each round at
+    # most once per worker (idempotent retry). ---
+    wire_retry: int = 2                   # BYTEPS_WIRE_RETRY
+    wire_backoff_ms: float = 50.0         # BYTEPS_WIRE_BACKOFF_MS
+
     # --- async / elastic (server.cc:434-436) ---
     enable_async: bool = False            # BYTEPS_ENABLE_ASYNC
 
@@ -228,6 +241,9 @@ class Config:
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
             fused_pushpull=_env_bool("BYTEPS_FUSED_PUSHPULL", True),
+            wire_retry=_env_int("BYTEPS_WIRE_RETRY", 2),
+            wire_backoff_ms=float(
+                _env_str("BYTEPS_WIRE_BACKOFF_MS", "50")),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),

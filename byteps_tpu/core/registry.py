@@ -87,6 +87,13 @@ class TensorRegistry:
         # host staging arena (core/arena.py): re-partitioning a tensor
         # makes its staged slot sizes stale, so the registry drops them
         self._arena = None
+        # elastic fleet state: servers declared dead by migrate_server —
+        # masked out of every later assignment — and a monotonically
+        # increasing routing version (the migration fence: bumped once
+        # per migrate_server call, so routing-table readers can detect
+        # "the table changed under me" cheaply)
+        self._dead_servers: set = set()
+        self._routing_version = 0
 
     def attach_arena(self, arena) -> None:
         self._arena = arena
@@ -178,6 +185,9 @@ class TensorRegistry:
         with self._lock:
             self._config = new_config
             self._server_load = [0] * max(1, new_config.num_servers)
+            # a resume declares a NEW server topology: server indices
+            # renumber, so the old death verdicts no longer apply
+            self._dead_servers.clear()
             for name in self._declaration_order:
                 ctx = self._contexts[name]
                 ctx.initialized = False
@@ -281,17 +291,30 @@ class TensorRegistry:
             # reading server_loads()) rely on
             self._server_load[0] += length
             return 0
+        # dead servers (migrate_server) are masked out of every NEW
+        # assignment: the hashed functions re-map onto the surviving
+        # index list (identity when nothing is dead, so assignments are
+        # unchanged for healthy fleets), least-loaded picks among
+        # survivors. Deterministic across workers for the same observed
+        # death set.
+        alive = [s for s in range(num_servers)
+                 if s not in self._dead_servers]
+        if not alive:
+            alive = list(range(num_servers))  # all dead: fail at the wire
         fn_name = self._config.key_hash_fn
         if self._config.enable_mixed_mode:
+            # mixed MODE encodes a colocated/dedicated split by index:
+            # masking would break its ratio math, so it keeps the full
+            # range (a dead server there fails at the wire + migrates)
             server = self._hash_mixed_mode_locked(key)
         elif fn_name == "mixed":
             # "mixed" hash without mixed MODE: least-loaded assignment
             # (deterministic across workers — every worker declares
             # tensors in the same order, so the running loads agree)
-            server = min(range(num_servers), key=lambda s: self._server_load[s])
+            server = min(alive, key=lambda s: self._server_load[s])
         else:
             fn = _HASH_FNS.get(fn_name, _hash_djb2)
-            server = fn(str(key)) % num_servers
+            server = alive[fn(str(key)) % len(alive)]
         self._server_load[server] += length
         return server
 
@@ -327,6 +350,77 @@ class TensorRegistry:
     def server_loads(self) -> List[int]:
         with self._lock:
             return list(self._server_load)
+
+    # ------------------------------------------------------------------ #
+    # live key migration (elastic server fleet)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def routing_version(self) -> int:
+        """Monotonic migration fence: bumped once per migrate_server
+        call that moved at least one partition."""
+        with self._lock:
+            return self._routing_version
+
+    def dead_servers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead_servers)
+
+    def migrate_server(self, dead_server: int,
+                       keys: Optional[set] = None) -> List[int]:
+        """Live key migration: re-route every partition assigned to
+        ``dead_server`` (optionally restricted to ``keys``) onto the
+        least-loaded SURVIVING server, updating the per-server load
+        accounting, and mask the dead server out of all future
+        assignments.
+
+        The re-targeting mutates each ``Partition.server`` in place, so
+        in-flight retry state holding the Partition object re-routes
+        without re-plumbing — and it is DETERMINISTIC across workers:
+        every worker walks the same declaration order with the same
+        load table (both derived from the shared declaration history),
+        so independent workers observing the same death migrate every
+        key to the same survivor. The round fence is per key: the
+        adoptive server starts that key from a fresh store (re-init +
+        re-pushed round), never from a half-summed one — see
+        docs/fault-tolerance.md for why reset-and-re-push was chosen
+        over accumulator state transfer.
+
+        Returns the migrated partition keys (callers must invalidate
+        client-side init caches for them). Raises when no surviving
+        server remains — a permanently dead fleet must fail fast, not
+        re-route in a circle."""
+        with self._lock:
+            self._dead_servers.add(dead_server)
+            num = max(1, self._config.num_servers)
+            alive = [s for s in range(num) if s not in self._dead_servers]
+            if not alive:
+                raise RuntimeError(
+                    f"server {dead_server} is dead and no surviving "
+                    f"server remains ({num} declared, all dead) — the PS "
+                    f"fleet is gone")
+            migrated: List[int] = []
+            for name in self._declaration_order:
+                ctx = self._contexts[name]
+                for p in ctx.partitions:
+                    if p.server != dead_server:
+                        continue
+                    if keys is not None and p.key not in keys:
+                        continue
+                    target = min(alive,
+                                 key=lambda s: self._server_load[s])
+                    self._server_load[dead_server] -= p.length
+                    self._server_load[target] += p.length
+                    p.server = target
+                    migrated.append(p.key)
+            if migrated:
+                self._routing_version += 1
+                log.warning(
+                    "registry: migrated %d partition(s) off dead server "
+                    "%d (routing_version=%d, survivors=%s)",
+                    len(migrated), dead_server, self._routing_version,
+                    alive)
+            return migrated
 
 
 def decode_key(key: int) -> tuple:

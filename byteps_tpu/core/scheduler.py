@@ -188,7 +188,8 @@ class PartitionTask:
 
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
-                 "cmd_pull", "pull_len", "push_len", "lease", "enqueue_t")
+                 "cmd_pull", "pull_len", "push_len", "lease", "enqueue_t",
+                 "round_no", "attempt")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
                  group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
@@ -209,6 +210,19 @@ class PartitionTask:
         self.push_len = None       # actual pushed bytes (set by _do_push)
         self.lease = None          # arena lease for reply scratch (if any)
         self.enqueue_t = None      # admission-wait clock (metrics)
+        self.round_no = 0          # per-key submission ordinal (epoch stamp)
+        self.attempt = 0           # wire retries of this round so far
+
+    @property
+    def epoch(self) -> int:
+        """Wire replay-dedup stamp: (round << 16) | attempt. The server
+        folds each (key, sender, round) at most once, so a retried push
+        after a dropped reply never double-counts (native/ps.cc
+        IsReplay; docs/fault-tolerance.md). round_no == 0 (direct task
+        construction in tests/benches) sends 0 = unstamped."""
+        if not self.round_no:
+            return 0
+        return (self.round_no << 16) | (self.attempt & 0xFFFF)
 
     @property
     def key(self) -> int:
@@ -394,11 +408,16 @@ class PipelineScheduler:
 
     def __init__(self, client, num_threads: int = 8,
                  credit_bytes: int = 0, tracer=None, telemetry=None,
-                 config=None, arena=None, metrics=None, profiler=None):
+                 config=None, arena=None, metrics=None, profiler=None,
+                 registry=None):
         import concurrent.futures
         import os
 
         self._client = client
+        # tensor registry (core/registry.py) for live key migration on
+        # server death; None = no failover (re-routing needs the shared
+        # routing table)
+        self._registry = registry
         # Fused PUSHPULL (BYTEPS_FUSED_PUSHPULL, default on): PUSH and
         # PULL collapse into ONE non-blocking WIRE stage — submit the
         # fused op, return the thread to the pool, and run the finish
@@ -454,9 +473,55 @@ class PipelineScheduler:
         # key to first cross the export boundary gets ordinal n
         self._export_ordinal = 0
         self._export_order: Dict[int, int] = {}
+        # ---- fault tolerance (docs/fault-tolerance.md) ---------------- #
+        # bounded wire retry with exponential backoff: a failed wire
+        # exchange (fused PUSHPULL or two-op push/pull) is retried up to
+        # wire_retry times, its replayed push (round, attempt)-stamped so
+        # the server never double-counts; when the native client reports
+        # the partition's server dead, the retry first migrates the dead
+        # server's keys to survivors (registry.migrate_server) and
+        # re-inits them there. wire_retry = 0 restores fail-fast.
+        if config is not None:
+            self._retry_max = max(0, int(getattr(config, "wire_retry", 2)))
+            self._backoff_ms = max(
+                1.0, float(getattr(config, "wire_backoff_ms", 50.0)))
+        else:
+            self._retry_max = max(
+                0, int(os.environ.get("BYTEPS_WIRE_RETRY", "2")))
+            self._backoff_ms = max(1.0, float(
+                os.environ.get("BYTEPS_WIRE_BACKOFF_MS", "50")))
+        self._backoff_cap_ms = 2000.0
+        self._stopping = False
+        # per-declared-key submission ordinal: the ROUND half of the
+        # epoch stamp. Scheduler-owned (not the caller's `version`) so
+        # dedup never depends on callers passing monotonic versions.
+        self._round_seq: Dict[int, int] = {}
+        # pending backoff timers: task-id -> (timer, task); stop() fails
+        # them so no handle waits on a retry that will never fire
+        self._retry_mu = threading.Lock()
+        self._pending_retries: Dict[int, tuple] = {}
+        # servers already failed over (migrate once per death); the
+        # failover lock is held across a whole migration so concurrent
+        # failing partitions only ever see a fully-applied routing table
+        self._failover_mu = threading.Lock()
+        self._migrated_servers: set = set()
+        if metrics is not None:
+            # created eagerly (not on first event) so the observability
+            # schema resolves 0-valued counters on healthy fleets
+            self._m_retries = metrics.counter("wire/retries")
+            self._m_failovers = metrics.counter("wire/server_failovers")
+            self._m_migrations = metrics.counter("registry/migrations")
+        else:
+            self._m_retries = self._m_failovers = self._m_migrations = None
         self._dispatcher = threading.Thread(
             target=self._dispatch, name="bps-sched-dispatch", daemon=True)
         self._dispatcher.start()
+
+    def _next_round(self, ctx: TensorContext) -> int:
+        with self._prio_mu:
+            r = self._round_seq.get(ctx.declared_key, 0) + 1
+            self._round_seq[ctx.declared_key] = r
+            return r
 
     def production_priority(self, ctx: TensorContext,
                             parent: Optional[TensorContext] = None) -> int:
@@ -606,6 +671,144 @@ class PipelineScheduler:
         if prof is not None:
             prof.stage_sample(stage, dt)
 
+    # ---- bounded retry + server failover ------------------------------ #
+
+    @staticmethod
+    def _retryable(err: Exception) -> bool:
+        """Wire-layer failures retry (server error replies, dropped
+        replies / ticket timeouts, connection death, send failures);
+        programming errors (bad buffers, stale shapes -> ValueError
+        etc.) fail the round immediately."""
+        return isinstance(err, (RuntimeError, TimeoutError, OSError))
+
+    def _fail_or_retry(self, task: PartitionTask, err: Exception) -> None:
+        """A wire stage failed: retry the partition's whole exchange
+        with exponential backoff (the replayed push is epoch-stamped, so
+        the server folds it at most once), re-routing via the registry
+        when the assigned server is dead; after the retry budget, fail
+        the round with a clear bounded-time error."""
+        if (self._stopping or task.attempt >= self._retry_max
+                or not self._retryable(err)):
+            if task.attempt > 0 and self._retryable(err):
+                budget_ms = sum(
+                    min(self._backoff_ms * (2 ** a), self._backoff_cap_ms)
+                    for a in range(task.attempt))
+                err = RuntimeError(
+                    f"push_pull {task.ctx.name!r} key={task.key} failed "
+                    f"after {task.attempt + 1} attempts over "
+                    f"~{budget_ms:.0f}ms of backoff "
+                    f"(BYTEPS_WIRE_RETRY={self._retry_max}, "
+                    f"BYTEPS_WIRE_BACKOFF_MS={self._backoff_ms:g}): {err}")
+            self._finish(task, err)
+            return
+        task.attempt += 1
+        if self._m_retries is not None:
+            self._m_retries.inc()
+        # the reply scratch may be half-written garbage: abandon it so
+        # the retry checks out a fresh buffer (never recycle a slot a
+        # late writer could still touch)
+        if task.lease is not None:
+            task.lease.abandon()
+            task.lease = None
+        delay = min(self._backoff_ms * (2 ** (task.attempt - 1)),
+                    self._backoff_cap_ms) / 1000.0
+        log.warning(
+            "push_pull %r key=%d: wire attempt %d failed (%s); retrying "
+            "in %.0fms (%d/%d)", task.ctx.name, task.key, task.attempt,
+            err, delay * 1e3, task.attempt, self._retry_max)
+
+        def _fire():
+            with self._retry_mu:
+                if self._pending_retries.pop(id(task), None) is None:
+                    return  # stop() claimed it and failed the task
+            try:
+                self._prepare_retry(task)
+            except Exception as e:  # noqa: BLE001 - forwarded to waiter
+                self._finish(task, e)
+                return
+            entry = self._do_wire if self._fused else self._do_push
+            self._submit_stage(self._push_pool, entry, task)
+
+        timer = threading.Timer(delay, _fire)
+        timer.daemon = True
+        with self._retry_mu:
+            if self._stopping:
+                self._finish(task, RuntimeError(
+                    "scheduler stopped with the retry pending"))
+                return
+            self._pending_retries[id(task)] = (timer, task)
+        timer.start()
+
+    def _prepare_retry(self, task: PartitionTask) -> None:
+        """Pre-flight for a retry: when the native client reports the
+        partition's assigned server dead, migrate the dead server's keys
+        to survivors (once per death, shared routing table) and re-init
+        the re-homed keys there; the retried send then targets the
+        mutated Partition.server. Raises when no survivor exists — the
+        permanently-dead-fleet fail-fast."""
+        srv = task.partition.server
+        probe = getattr(self._client, "server_dead", None)
+        if probe is not None and probe(srv):
+            self._failover_server(srv)
+            if task.partition.server == srv:
+                # migrate_server raises when the whole fleet is dead;
+                # equal server here means migration was unavailable
+                raise RuntimeError(
+                    f"server {srv} is dead and key migration is "
+                    f"unavailable (no registry attached) — cannot "
+                    f"re-route key {task.key}")
+            if task.stack is not None:
+                # a host-compressed key's server-side codec (COMP_INIT)
+                # does not transfer: the adoptive server would reject
+                # the wire as a mode mismatch. Fail clearly instead of
+                # burning retries.
+                raise RuntimeError(
+                    f"server {srv} died holding host-compressed key "
+                    f"{task.key}; live migration of compressed keys is "
+                    f"not supported (the adoptive server has no "
+                    f"compressor state) — re-initialize compression for "
+                    f"this tensor")
+        # Seed any not-yet-initialized store on the (possibly re-homed)
+        # server before re-sending: INIT_PUSH doubles as the state sync
+        # (allocation + init barrier across workers; converges because
+        # every worker observes the same death on its own retry path).
+        # Unconditional — a SIBLING task's failover may have migrated
+        # this tensor's keys and invalidated their init cache while this
+        # task was backing off, in which case its partition already
+        # points at a survivor whose store doesn't exist yet (the probe
+        # above then reads "alive" and the dead-server branch never
+        # runs). A fully-cached tensor makes this a dict lookup.
+        ensure = getattr(self._client, "ensure_init", None)
+        if (ensure is not None and task.stack is None
+                and getattr(task.ctx, "nbytes", 0)
+                and task.ctx.nbytes == sum(p.length
+                                           for p in task.ctx.partitions)):
+            ensure(task.ctx, task.ctx.nbytes)
+
+    def _failover_server(self, srv: int) -> None:
+        # the lock is held across the WHOLE migration: a second failing
+        # partition of the same dead server blocks here until the
+        # routing table is fully re-targeted, so its post-call
+        # partition.server read never observes a half-applied migration
+        with self._failover_mu:
+            if srv in self._migrated_servers or self._registry is None:
+                return
+            migrated = self._registry.migrate_server(srv)
+            self._migrated_servers.add(srv)
+            if not migrated:
+                return
+            invalidate = getattr(self._client, "invalidate_init", None)
+            if invalidate is not None:
+                # the adoptive servers have no stores for the migrated
+                # keys: the next ensure_init must re-init-push them there
+                invalidate(migrated)
+            if self._m_failovers is not None:
+                self._m_failovers.inc()
+                self._m_migrations.inc(len(migrated))
+        log.warning(
+            "scheduler: server %d declared dead; %d key(s) migrated to "
+            "survivors, re-routing in-flight retries", srv, len(migrated))
+
     def _do_compress(self, task: PartitionTask) -> None:
         name = task.ctx.name
         span = self._span(task, "COMPRESS")
@@ -698,7 +901,11 @@ class PipelineScheduler:
                     f"fused pushpull reply for {name!r} key={task.key} is "
                     f"{got} bytes, expected {len(reply)}")
             if err is not None:
-                self._finish(task, err)
+                # a failed ticket no longer hard-fails the round: retry
+                # with backoff (epoch-stamped replay, so the server never
+                # double-counts), failing over to a surviving server when
+                # this one is dead
+                self._fail_or_retry(task, err)
                 return
             if task.stack is not None:
                 task.wire = reply[:got]  # variable-length wires (varint)
@@ -709,11 +916,25 @@ class PipelineScheduler:
 
         try:
             self._client.zpushpull_async(task.partition.server, task.key,
-                                         buf, reply, task.cmd, on_done)
+                                         buf, reply, task.cmd, on_done,
+                                         epoch=task.epoch)
+        except TypeError:
+            # client without the epoch kwarg (fake test clients, stale
+            # builds): legacy unstamped call — retries still bounded,
+            # idempotence falls back to the server's positional counting
+            try:
+                self._client.zpushpull_async(
+                    task.partition.server, task.key, buf, reply, task.cmd,
+                    on_done)
+            except Exception as e:  # noqa: BLE001
+                if self._tracer:
+                    self._tracer.end(name, span)
+                self._fail_or_retry(task, e)
+                return
         except Exception as e:  # noqa: BLE001
             if self._tracer:
                 self._tracer.end(name, span)
-            self._finish(task, e)
+            self._fail_or_retry(task, e)
             return
         # send wall only — the request is on the wire and this thread is
         # free; the aggregation wait shows up in the PULL sample above
@@ -742,11 +963,16 @@ class PipelineScheduler:
             # synchronization; per-key FIFO via the client's key-affine
             # conns). A server reject poisons the conn and surfaces as
             # the pull's error. The PUSH span therefore measures send
-            # time only; aggregation wait shows up in PULL.
-            self._client.zpush_async(task.partition.server, task.key, buf,
-                                     task.cmd)
+            # time only; aggregation wait shows up in PULL. The epoch
+            # stamp makes a retried push idempotent server-side.
+            try:
+                self._client.zpush_async(task.partition.server, task.key,
+                                         buf, task.cmd, epoch=task.epoch)
+            except TypeError:  # epoch-less client (fakes, stale builds)
+                self._client.zpush_async(task.partition.server, task.key,
+                                         buf, task.cmd)
         except Exception as e:  # noqa: BLE001
-            self._finish(task, e)
+            self._fail_or_retry(task, e)
             return
         finally:
             if self._tracer:
@@ -783,7 +1009,11 @@ class PipelineScheduler:
                                    task.out_view, task.cmd_pull,
                                    exact=task.pull_len is None)
         except Exception as e:  # noqa: BLE001
-            self._finish(task, e)
+            # retry replays the WHOLE exchange from the push stage: the
+            # epoch stamp dedups the replayed push, and a pull that
+            # failed because a peer departure aborted the round
+            # (pull_abort error-ACK) needs the re-push anyway
+            self._fail_or_retry(task, e)
             return
         finally:
             if self._tracer:
@@ -924,6 +1154,7 @@ class PipelineScheduler:
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
         priority = self._pin_priority(ctx, priority)
+        round_no = self._next_round(ctx)
         for i, p in enumerate(ctx.partitions):
             stack = comp.stacks[i] if comp is not None else None
             task = PartitionTask(
@@ -932,6 +1163,7 @@ class PipelineScheduler:
                 out_view[p.offset:p.offset + p.length],
                 group, cmd_comp if stack is not None else cmd,
                 stack=stack, step=step)
+            task.round_no = round_no
             try:
                 self._queue.add_task(task)
             except RuntimeError as e:
@@ -967,11 +1199,13 @@ class PipelineScheduler:
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
         priority = self._pin_priority(ctx, priority)
+        round_no = self._next_round(ctx)
         for i, p in enumerate(ctx.partitions):
             task = PartitionTask(
                 ctx, p, priority, version, None, replies[i], group,
                 cmds[i], wire=wires[i], cmd_pull=cmds[i],
                 pull_len=reply_lens[i])
+            task.round_no = round_no
             try:
                 self._queue.add_task(task)
             except RuntimeError as e:
@@ -1011,6 +1245,7 @@ class PipelineScheduler:
 
         group = TaskGroup(ctx, len(ctx.partitions), on_complete)
         priority = self._pin_priority(ctx, priority)
+        round_no = self._next_round(ctx)
         for p in ctx.partitions:
             try:
                 wire = build_rowsparse_payload(p, nz, host2d)
@@ -1021,6 +1256,7 @@ class PipelineScheduler:
                 ctx, p, priority, version, None,
                 out_view[p.offset:p.offset + p.length],
                 group, cmd_sparse, wire=wire, cmd_pull=cmd_dense)
+            task.round_no = round_no
             try:
                 self._queue.add_task(task)
             except RuntimeError as e:
@@ -1032,6 +1268,17 @@ class PipelineScheduler:
         # of waiting forever; then cancel not-yet-running stage work (the
         # done-callback fails their tasks) and give in-flight network calls
         # a bounded grace to drain before the caller frees the client.
+        self._stopping = True
+        # fail tasks parked in backoff timers: exactly one of {this pop,
+        # the timer's fire} claims each entry, so a racing fire either
+        # already removed it (and proceeds) or finds it gone (and exits)
+        with self._retry_mu:
+            pending = list(self._pending_retries.values())
+            self._pending_retries.clear()
+        for timer, task in pending:
+            timer.cancel()
+            self._finish(task, RuntimeError(
+                "scheduler stopped with the wire retry still pending"))
         self._queue.stop()
         self._dispatcher.join(timeout=5)
         for pool in (self._codec_pool, self._push_pool, self._pull_pool):

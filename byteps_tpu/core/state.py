@@ -293,7 +293,8 @@ class GlobalState:
                     credit_bytes=self.config.scheduling_credit,
                     tracer=self.tracer, telemetry=self.telemetry,
                     config=self.config, arena=self.arena,
-                    metrics=self.metrics, profiler=self.profiler)
+                    metrics=self.metrics, profiler=self.profiler,
+                    registry=self.registry)
                 self.handles = HandleManager()
             if self.config.metrics_port > 0 and self._metrics_server is None:
                 from .metrics import start_http_server

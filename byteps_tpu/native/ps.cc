@@ -9,7 +9,12 @@
 //
 // Protocol (little-endian, same-arch assumption documented in server/README):
 //   MsgHeader { magic u32; op u8; flags u8; sender u16; rid u32; key u64;
-//               cmd u32; len u32 }  -- 28 bytes, then len payload bytes.
+//               cmd u32; len u32; epoch u64 }  -- 36 bytes, then len payload
+//   bytes. epoch = (round << 16) | attempt stamps PUSH/PUSHPULL for
+//   idempotent replay (see "Replay dedup" below); 0 = unstamped (init
+//   pushes, legacy callers). The magic was bumped when epoch was added,
+//   so a version-skewed peer fails loudly on the first message instead
+//   of misparsing payload bytes as a header.
 // Ops: INIT_PUSH, PUSH, PULL, BARRIER, SHUTDOWN, IPC_HELLO from workers;
 //      ACK, PULL_REPLY from the server. Every request carries a worker-side
 //      request id (rid) echoed in the reply, so one connection multiplexes
@@ -81,7 +86,7 @@
 
 namespace bps {
 
-static constexpr uint32_t kMagic = 0xB17E5000;
+static constexpr uint32_t kMagic = 0xB17E5001;  // 5000 + epoch field
 
 enum Op : uint8_t {
   INIT_PUSH = 1,
@@ -127,10 +132,19 @@ struct MsgHeader {
   uint64_t key;
   uint32_t cmd;   // cantor(request_type, dtype) — common.cc:98-101
   uint32_t len;
+  // Replay-dedup stamp for PUSH/PUSHPULL: (round << 16) | attempt. The
+  // round is the worker-side per-key submission ordinal (monotonic);
+  // attempt counts wire retries of the same round. The server folds a
+  // given (key, sender, round) at most once — a retried push after a
+  // dropped reply must never double-count into the aggregation. 0 =
+  // unstamped (init pushes, pulls, blocking legacy callers): no dedup.
+  // Declared last so every aggregate-initialized reply header
+  // ({kMagic, ACK, ...}) zero-fills it.
+  uint64_t epoch;
 };
 #pragma pack(pop)
 
-static_assert(sizeof(MsgHeader) == 28, "header layout");
+static_assert(sizeof(MsgHeader) == 36, "header layout");
 
 // Inverse Cantor pairing (common.cc:98-101).
 static inline void decode_cmd(uint32_t cmd, uint32_t* req, uint32_t* dtype) {
@@ -1124,6 +1138,69 @@ class Throttle {
   std::chrono::steady_clock::time_point last_;
 };
 
+// BYTEPS_CHAOS_*: fault-injection knobs for the chaos harness
+// (docs/fault-tolerance.md). Read per-Server instance so chaos'd and
+// clean servers coexist in one test process:
+//   BYTEPS_CHAOS_KILL_AFTER_ROUNDS=N  — _exit(137) once N aggregation
+//     rounds completed on this server (the SIGKILL shape: no teardown,
+//     no flushes; subprocess servers only — the exit takes the whole
+//     process);
+//   BYTEPS_CHAOS_DROP_REPLY_RATE=R    — deterministically drop fraction
+//     R (0..1] of aggregate replies (PULL_REPLY / fused completions),
+//     via an error-free accumulator (no RNG: reruns drop the same
+//     replies). Forces client timeouts + retries, which the epoch
+//     replay-dedup must absorb without double-counting;
+//   BYTEPS_CHAOS_DELAY_MS=M           — sleep M ms before each
+//     aggregate reply (latency injection).
+class Chaos {
+ public:
+  Chaos() {
+    if (const char* e = ::getenv("BYTEPS_CHAOS_DROP_REPLY_RATE")) {
+      double v = std::atof(e);
+      if (v > 0) drop_rate_ = v > 1.0 ? 1.0 : v;
+    }
+    if (const char* e = ::getenv("BYTEPS_CHAOS_DELAY_MS"))
+      delay_ms_ = std::atol(e);
+    if (const char* e = ::getenv("BYTEPS_CHAOS_KILL_AFTER_ROUNDS"))
+      kill_rounds_ = std::atol(e);
+  }
+
+  // Called before an aggregate reply is sent: inject latency, then
+  // decide whether to drop it entirely.
+  bool swallow_reply() {
+    if (delay_ms_ > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms_));
+    if (drop_rate_ <= 0) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    acc_ += drop_rate_;
+    if (acc_ >= 1.0) {
+      acc_ -= 1.0;
+      dropped_++;
+      return true;
+    }
+    return false;
+  }
+
+  void round_completed() {
+    if (kill_rounds_ <= 0) return;
+    if (rounds_.fetch_add(1) + 1 >= kill_rounds_) {
+      std::fprintf(stderr,
+                   "[bps-server] CHAOS: kill-after-rounds reached (%ld); "
+                   "_exit(137)\n", kill_rounds_);
+      ::_exit(137);
+    }
+  }
+
+ private:
+  double drop_rate_ = 0;
+  long delay_ms_ = 0;
+  long kill_rounds_ = 0;
+  std::mutex mu_;
+  double acc_ = 0;
+  long dropped_ = 0;
+  std::atomic<long> rounds_{0};
+};
+
 struct Conn {
   int fd;
   // worker id observed on this connection's first message; -1 until then
@@ -1185,6 +1262,14 @@ struct KeyStore {
   uint32_t recv_count = 0;       // pushes folded this round
   uint64_t completed_rounds = 0;
   std::vector<uint64_t> worker_push_count;  // per worker
+  // Replay dedup: highest epoch ROUND folded per worker. A stamped push
+  // whose round is <= this is a retry of work already summed (the
+  // reply was dropped / the requester timed out) — it must be answered
+  // but NEVER folded again (the idempotence guarantee,
+  // docs/fault-tolerance.md). Reset to 0 per worker on re-init and on
+  // departure rollback, so a resumed/re-pushing worker's restarted
+  // round numbering folds normally.
+  std::vector<uint64_t> last_round;
   // set per worker when a departure aborts a round that worker had
   // already pushed: its next pull must error (retry) instead of being
   // served the PREVIOUS round's aggregate as if it were the new one
@@ -1219,6 +1304,7 @@ struct EngineMsg {
   uint32_t dtype;
   uint32_t rid;
   uint16_t sender;
+  uint64_t epoch = 0;            // (round << 16) | attempt; 0 = unstamped
   std::vector<uint8_t> payload;  // push data
   std::shared_ptr<Conn> conn;
 };
@@ -1380,6 +1466,7 @@ class Server {
       m.key = h.key;
       m.rid = h.rid;
       m.sender = h.sender;
+      m.epoch = h.epoch;
       m.conn = conn;
       uint32_t req, dtype;
       decode_cmd(h.cmd, &req, &dtype);
@@ -1478,6 +1565,8 @@ class Server {
         ks.wire_accum.clear();  // drop a half-summed randomk wire round
         if (ks.pull_abort.size() != ks.worker_push_count.size())
           ks.pull_abort.assign(ks.worker_push_count.size(), 0);
+        if (ks.last_round.size() != ks.worker_push_count.size())
+          ks.last_round.assign(ks.worker_push_count.size(), 0);
         for (size_t w = 0; w < ks.worker_push_count.size(); ++w) {
           if (ks.worker_push_count[w] > ks.completed_rounds) {
             // this worker already pushed the aborted round; its next
@@ -1485,6 +1574,9 @@ class Server {
             // aggregate (PullReady would say ready after the rollback)
             ks.pull_abort[w] = 1;
             ks.worker_push_count[w] = ks.completed_rounds;
+            // its re-push of the aborted round must FOLD, not dedup:
+            // the partial sum it contributed to was just dropped
+            ks.last_round[w] = 0;
           }
         }
       }
@@ -1620,6 +1712,37 @@ class Server {
     return stores_[key];
   }
 
+  // Replay dedup (call under ks.mu): true when this stamped push's round
+  // was already folded for this sender — the caller must SKIP the fold
+  // (but still answer: ACK for plain PUSH, FusedReply for PUSHPULL, so
+  // the retrying worker gets the round's aggregate it never received).
+  bool IsReplay(KeyStore& ks, const EngineMsg& m) {
+    uint64_t rnd = m.epoch >> 16;
+    if (!rnd) return false;  // unstamped: legacy semantics, no dedup
+    if (ks.last_round.size() != ks.worker_push_count.size())
+      ks.last_round.assign(ks.worker_push_count.size(), 0);
+    if (m.sender >= ks.last_round.size() ||
+        rnd > ks.last_round[m.sender])
+      return false;
+    std::fprintf(stderr,
+                 "[bps-server] dedup: replayed push key=%llu sender=%u "
+                 "round=%llu attempt=%llu (already folded)\n",
+                 (unsigned long long)m.key, (unsigned)m.sender,
+                 (unsigned long long)rnd,
+                 (unsigned long long)(m.epoch & 0xFFFF));
+    return true;
+  }
+
+  // Record a successful fold's round (call under ks.mu, next to the
+  // worker_push_count increment).
+  static void RecordRound(KeyStore& ks, const EngineMsg& m) {
+    uint64_t rnd = m.epoch >> 16;
+    if (!rnd) return;
+    if (ks.last_round.size() != ks.worker_push_count.size())
+      ks.last_round.assign(ks.worker_push_count.size(), 0);
+    if (m.sender < ks.last_round.size()) ks.last_round[m.sender] = rnd;
+  }
+
   void DoInit(EngineMsg& m) {
     // first push of a key allocates; reply withheld until every worker's
     // init push arrived (server.cc:266-295)
@@ -1668,6 +1791,7 @@ class Server {
         ks.pub = std::make_shared<std::vector<uint8_t>>(m.payload);
         ks.worker_push_count.assign(num_workers_, 0);
         ks.pull_abort.assign(num_workers_, 0);
+        ks.last_round.assign(num_workers_, 0);
         ks.recv_count = 0;
         ks.completed_rounds = 0;
         // a resize invalidates any compressor (stale n): workers must
@@ -1682,7 +1806,15 @@ class Server {
         // the cold-start barrier already completed for this store; a
         // same-length init is an idempotent re-declaration (elastic
         // reconnect after suspend or a peer's departure) — ACK now,
-        // survivors that never re-init must not be waited on
+        // survivors that never re-init must not be waited on. A
+        // re-initing worker restarts its round numbering (fresh client
+        // = fresh scheduler counters), so its dedup baseline resets:
+        // without this every post-resume stamped push would read as a
+        // replay of the pre-suspend rounds and be silently dropped.
+        if (ks.last_round.size() != ks.worker_push_count.size())
+          ks.last_round.assign(ks.worker_push_count.size(), 0);
+        if (m.sender < ks.last_round.size())
+          ks.last_round[m.sender] = 0;
         release.push_back({m.conn, m.rid, m.sender});
       } else {
         ks.init_count++;
@@ -1834,6 +1966,7 @@ class Server {
         m.conn->send_msg(r, nullptr);
         return;
       }
+      if (IsReplay(ks, m)) goto ack;  // fold at most once per round
       if (ks.comp.type == CompressorCfg::RANDOMK &&
           m.payload.size() == ks.comp.WireLen()) {
         // bounds-check indices, then try the O(k) wire-form aggregation
@@ -1857,6 +1990,7 @@ class Server {
           if (m.sender < ks.worker_push_count.size())
             ks.worker_push_count[m.sender]++;
           if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+          RecordRound(ks, m);
           ks.recv_count++;
           if ((int)ks.recv_count >= num_workers_) {
             // ALL_RECV: the wire accumulator IS the compressed
@@ -1872,6 +2006,7 @@ class Server {
             ks.pub_wire = std::move(w);
             ks.recv_count = 0;
             ks.completed_rounds++;
+            chaos_.round_completed();
             flush.swap(ks.parked_pulls);
           }
           goto ack;  // shared ACK + parked-pull flush tail
@@ -1909,12 +2044,14 @@ class Server {
           if (m.sender < ks.worker_push_count.size())
             ks.worker_push_count[m.sender]++;
           if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+          RecordRound(ks, m);
           DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
           auto w = std::make_shared<std::vector<uint8_t>>(
               std::move(m.payload));
           ks.pub = std::move(d);
           ks.pub_wire = std::move(w);
           ks.completed_rounds++;
+          chaos_.round_completed();
           flush.swap(ks.parked_pulls);
           goto ack;
         }
@@ -1938,6 +2075,7 @@ class Server {
       if (m.sender < ks.worker_push_count.size())
         ks.worker_push_count[m.sender]++;
       if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+      RecordRound(ks, m);
       DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
                  ks.comp.n * 4, F32);
       // defensive resize: accum can be moved-out empty after a dense
@@ -1979,6 +2117,7 @@ class Server {
         ks.pub_wire = std::move(w);
         ks.recv_count = 0;
         ks.completed_rounds++;
+        chaos_.round_completed();
         flush.swap(ks.parked_pulls);
       }
     }
@@ -2007,6 +2146,10 @@ class Server {
       std::lock_guard<std::mutex> lk(ks.mu);
       do {
         if (m.conn->dead.load()) break;  // fenced: see Conn::dead
+        if (IsReplay(ks, m)) {
+          ok = true;  // already folded: answer, don't double-count
+          break;
+        }
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
         if (m.payload.size() < 8) break;
@@ -2030,6 +2173,7 @@ class Server {
         if (m.sender < ks.worker_push_count.size())
           ks.worker_push_count[m.sender]++;
         if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+        RecordRound(ks, m);
         if (async_) {
           // async: fold rows straight into the authoritative weights
           float* w = (float*)ks.merged.data();
@@ -2037,6 +2181,7 @@ class Server {
             for (uint32_t j = 0; j < width; ++j)
               w[(size_t)ids[i] * width + j] += vals[(size_t)i * width + j];
           ks.completed_rounds++;
+          chaos_.round_completed();
           flush.swap(ks.parked_pulls);
           ok = true;
           break;
@@ -2060,6 +2205,7 @@ class Server {
           ks.pub = std::move(d);
           ks.recv_count = 0;
           ks.completed_rounds++;
+          chaos_.round_completed();
           flush.swap(ks.parked_pulls);
         }
         ok = true;
@@ -2126,43 +2272,53 @@ class Server {
         m.conn->send_msg(r, nullptr);
         return;
       }
-      ks.total_pushes++;
-      if (m.sender < ks.worker_push_count.size())
-        ks.worker_push_count[m.sender]++;
-      if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
-      if (async_) {
-        // async: sum straight into merged (server.cc:315-319)
-        sum_into(ks.merged.data(), m.payload.data(), m.payload.size(),
-                 ks.dtype);
-        ks.completed_rounds++;
-        flush.swap(ks.parked_pulls);
-      } else {
-        DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV", m.key,
-                   m.payload.data(), (uint32_t)m.payload.size(), ks.dtype);
-        if (ks.recv_count == 0) {
-          // first push of the round ADOPTS the payload buffer (no copy;
-          // the reference memcpys here, server.cc:329-333 — a buffer
-          // move is the TPU-host upgrade since the payload vector is
-          // already ours)
-          ks.accum = std::move(m.payload);
-        } else {
-          sum_into(ks.accum.data(), m.payload.data(), m.payload.size(),
+      if (!IsReplay(ks, m)) {
+        ks.total_pushes++;
+        if (m.sender < ks.worker_push_count.size())
+          ks.worker_push_count[m.sender]++;
+        if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
+        RecordRound(ks, m);
+        if (async_) {
+          // async: sum straight into merged (server.cc:315-319)
+          sum_into(ks.merged.data(), m.payload.data(), m.payload.size(),
                    ks.dtype);
-        }
-        ks.recv_count++;
-        if ((int)ks.recv_count >= num_workers_) {
-          // ALL_RECV: publish by MOVING the accumulator into the shared
-          // published slot (no copy); accum is left empty — the next
-          // round's first push adopts its own payload buffer anyway
-          auto d = std::make_shared<std::vector<uint8_t>>(
-              std::move(ks.accum));
-          DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
-          ks.pub = std::move(d);
-          ks.recv_count = 0;
           ks.completed_rounds++;
+          chaos_.round_completed();
           flush.swap(ks.parked_pulls);
+        } else {
+          DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV",
+                     m.key, m.payload.data(), (uint32_t)m.payload.size(),
+                     ks.dtype);
+          if (ks.recv_count == 0) {
+            // first push of the round ADOPTS the payload buffer (no
+            // copy; the reference memcpys here, server.cc:329-333 — a
+            // buffer move is the TPU-host upgrade since the payload
+            // vector is already ours)
+            ks.accum = std::move(m.payload);
+          } else {
+            sum_into(ks.accum.data(), m.payload.data(), m.payload.size(),
+                     ks.dtype);
+          }
+          ks.recv_count++;
+          if ((int)ks.recv_count >= num_workers_) {
+            // ALL_RECV: publish by MOVING the accumulator into the
+            // shared published slot (no copy); accum is left empty —
+            // the next round's first push adopts its own payload buffer
+            // anyway
+            auto d = std::make_shared<std::vector<uint8_t>>(
+                std::move(ks.accum));
+            DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
+            ks.pub = std::move(d);
+            ks.recv_count = 0;
+            ks.completed_rounds++;
+            chaos_.round_completed();
+            flush.swap(ks.parked_pulls);
+          }
         }
       }
+      // replay: nothing folded — the ACK / FusedReply tail below still
+      // answers, so the retrying worker gets the aggregate its dropped
+      // reply carried
     }
     if (!fused) {
       // ack the push (ZPush completion callback)
@@ -2182,6 +2338,15 @@ class Server {
   }
 
   void AnswerPull(KeyStore& ks, const ParkedPull& p) {
+    // chaos injection point: delay, then (deterministically) drop the
+    // aggregate reply — the requester times out and retries; the epoch
+    // dedup above guarantees the retry can't double-count
+    if (chaos_.swallow_reply()) {
+      std::fprintf(stderr,
+                   "[bps-server] CHAOS: dropped reply rid=%u sender=%u\n",
+                   p.rid, (unsigned)p.sender);
+      return;
+    }
     if (async_) {
       // async: merged mutates in place on every push; snapshot under the
       // key lock so the send reads a consistent weight vector
@@ -2274,6 +2439,7 @@ class Server {
   bool schedule_;
   int64_t debug_key_ = -1;
   Throttle throttle_;  // BYTEPS_SERVER_THROTTLE_MBPS, off by default
+  Chaos chaos_;        // BYTEPS_CHAOS_*, off by default
   int listen_fd_ = -1;
   std::atomic<bool> shutting_down_{false};
   std::atomic<int> shutdown_count_{0};
@@ -2469,7 +2635,7 @@ class ServerConn {
   // only synchronization (the reference's ps-lite ZPush is equally
   // async, its callback firing off the van thread).
   bool RequestAsync(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
-                    const void* data, uint32_t len) {
+                    const void* data, uint32_t len, uint64_t epoch = 0) {
     if (sticky_err_.load()) return false;
     auto w = std::make_shared<Waiter>();
     w->detached = true;
@@ -2485,7 +2651,7 @@ class ServerConn {
       if (sticky_err_.load()) return false;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch};
     std::lock_guard<std::mutex> lk(send_mu_);
     bool sent = chan_ ? chan_->send_msg(h, data)
                       : send_msg_iov(fd_, h, data);
@@ -2503,7 +2669,8 @@ class ServerConn {
   // caller raises; no record will ever surface for the ticket).
   bool RequestFused(uint64_t key, uint32_t cmd, uint16_t sender,
                     const void* data, uint32_t len, void* out,
-                    uint32_t out_len, uint64_t ticket) {
+                    uint32_t out_len, uint64_t ticket,
+                    uint64_t epoch = 0) {
     if (sticky_err_.load()) return false;
     auto w = std::make_shared<Waiter>();
     w->fused = true;
@@ -2520,7 +2687,7 @@ class ServerConn {
       if (sticky_err_.load()) return false;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, PUSHPULL, 0, sender, rid, key, cmd, len};
+    MsgHeader h{kMagic, PUSHPULL, 0, sender, rid, key, cmd, len, epoch};
     std::lock_guard<std::mutex> lk(send_mu_);
     bool sent = chan_ ? chan_->send_msg(h, data)
                       : send_msg_iov(fd_, h, data);
@@ -2590,10 +2757,16 @@ class ServerConn {
       if (cq_) cq_->push(r);
   }
 
+  // Whether this conn can never carry traffic again (recv loop exited
+  // on transport death, or a rejected async push poisoned it). When
+  // EVERY conn of a server's group reports dead, the server itself is
+  // presumed dead — the signal the worker-side failover consumes.
+  bool dead() const { return sticky_err_.load(); }
+
   // blocking request: returns got_len or ~0u on failure
   uint32_t Request(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
                    const void* data, uint32_t len, void* out,
-                   uint32_t out_len) {
+                   uint32_t out_len, uint64_t epoch = 0) {
     if (sticky_err_.load()) return ~0u;
     auto w = std::make_shared<Waiter>();
     w->out = out;
@@ -2608,7 +2781,7 @@ class ServerConn {
       if (sticky_err_.load()) return ~0u;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len};
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch};
     {
       std::lock_guard<std::mutex> lk(send_mu_);
       bool sent = chan_ ? chan_->send_msg(h, data)
@@ -2867,11 +3040,22 @@ class Client {
   // two-op push->pull pair, so server-side ordering is unchanged)
   int PushPull(int server, uint64_t key, const void* data, uint32_t len,
                uint32_t cmd, void* out, uint32_t out_len,
-               uint64_t ticket) {
+               uint64_t ticket, uint64_t epoch) {
     return pick(server, key)->RequestFused(key, cmd, worker_id_, data,
-                                           len, out, out_len, ticket)
+                                           len, out, out_len, ticket,
+                                           epoch)
                ? 0
                : -1;
+  }
+
+  // True when every striped connection to `server` is dead (transport
+  // EOF or poisoned): the worker-side server-death verdict that drives
+  // key migration. Out-of-range indices read as dead.
+  int ServerDead(int server) {
+    if (server < 0 || server >= (int)groups_.size()) return 1;
+    for (auto& c : groups_[server]->conns)
+      if (c && !c->dead()) return 0;
+    return 1;
   }
 
   // Reactor drain: blocks up to timeout_ms for completions, sweeping
@@ -2923,9 +3107,9 @@ class Client {
   }
 
   int Push(int server, uint64_t key, const void* data, uint32_t len,
-           uint32_t cmd) {
+           uint32_t cmd, uint64_t epoch) {
     uint32_t r = pick(server, key)->Request(PUSH, key, cmd, worker_id_,
-                                            data, len, nullptr, 0);
+                                            data, len, nullptr, 0, epoch);
     return r == ~0u ? -1 : 0;
   }
 
@@ -2934,9 +3118,9 @@ class Client {
   // rides the same key-affine conn, so per-key push->pull FIFO holds
   // end-to-end (conn stream -> server per-key engine queue).
   int PushAsync(int server, uint64_t key, const void* data, uint32_t len,
-                uint32_t cmd) {
+                uint32_t cmd, uint64_t epoch) {
     return pick(server, key)->RequestAsync(PUSH, key, cmd, worker_id_,
-                                           data, len) ? 0 : -1;
+                                           data, len, epoch) ? 0 : -1;
   }
 
   int Pull(int server, uint64_t key, void* out, uint32_t out_len,
@@ -3065,14 +3249,18 @@ int bps_client_comp_init(void* c, int server, uint64_t key,
   return ((bps::Client*)c)->CompInit(server, key, kwargs);
 }
 
+// `epoch` = (round << 16) | attempt replay-dedup stamp (0 = unstamped;
+// see MsgHeader::epoch). A retried push carrying the same round as an
+// already-folded one is answered but never double-counted.
 int bps_client_push(void* c, int server, uint64_t key, const void* data,
-                    uint32_t len, uint32_t cmd) {
-  return ((bps::Client*)c)->Push(server, key, data, len, cmd);
+                    uint32_t len, uint32_t cmd, uint64_t epoch) {
+  return ((bps::Client*)c)->Push(server, key, data, len, cmd, epoch);
 }
 
 int bps_client_push_async(void* c, int server, uint64_t key,
-                          const void* data, uint32_t len, uint32_t cmd) {
-  return ((bps::Client*)c)->PushAsync(server, key, data, len, cmd);
+                          const void* data, uint32_t len, uint32_t cmd,
+                          uint64_t epoch) {
+  return ((bps::Client*)c)->PushAsync(server, key, data, len, cmd, epoch);
 }
 
 int bps_client_pull(void* c, int server, uint64_t key, void* out,
@@ -3088,9 +3276,16 @@ int bps_client_pull(void* c, int server, uint64_t key, void* out,
 int bps_client_pushpull_async(void* c, int server, uint64_t key,
                               const void* data, uint32_t len, uint32_t cmd,
                               void* out, uint32_t out_len,
-                              uint64_t ticket) {
+                              uint64_t ticket, uint64_t epoch) {
   return ((bps::Client*)c)->PushPull(server, key, data, len, cmd, out,
-                                     out_len, ticket);
+                                     out_len, ticket, epoch);
+}
+
+// 1 when every striped connection to `server` is dead (transport EOF /
+// poisoned) — the worker-side server-death verdict consumed by the
+// scheduler's failover path (re-route the dead server's keys).
+int bps_client_server_dead(void* c, int server) {
+  return ((bps::Client*)c)->ServerDead(server);
 }
 
 // Drain up to max_n fused completions into the three parallel arrays;

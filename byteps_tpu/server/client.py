@@ -41,10 +41,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.bps_client_init_key.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p,
         ctypes.c_uint32, ctypes.c_uint32]
+    # push ops carry a trailing (round << 16 | attempt) epoch stamp for
+    # server-side replay dedup (idempotent retry; docs/fault-tolerance.md)
+    epoch_argtypes = lib.bps_client_init_key.argtypes + [ctypes.c_uint64]
     lib.bps_client_push.restype = ctypes.c_int
-    lib.bps_client_push.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_push.argtypes = epoch_argtypes
     lib.bps_client_push_async.restype = ctypes.c_int
-    lib.bps_client_push_async.argtypes = lib.bps_client_init_key.argtypes
+    lib.bps_client_push_async.argtypes = epoch_argtypes
     lib.bps_client_pull.restype = ctypes.c_int
     lib.bps_client_pull.argtypes = lib.bps_client_init_key.argtypes
     if hasattr(lib, "bps_client_pushpull_async"):
@@ -55,7 +58,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.bps_client_pushpull_async.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64]
         lib.bps_client_cq_poll.restype = ctypes.c_int
         lib.bps_client_cq_poll.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
@@ -68,6 +72,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.bps_client_comp_init.restype = ctypes.c_int
     lib.bps_client_comp_init.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
+    if hasattr(lib, "bps_client_server_dead"):
+        # guarded like the fused op: a stale .so predating the probe
+        # must still load (server_dead() then conservatively reports
+        # False and failover never triggers — the pre-elastic behavior)
+        lib.bps_client_server_dead.restype = ctypes.c_int
+        lib.bps_client_server_dead.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
     lib.bps_client_ipc_conns.argtypes = [ctypes.c_void_p]
@@ -268,6 +279,40 @@ class PSClient:
         return int(self._lib.bps_client_ipc_conns(self._handle))
 
     # ------------------------------------------------------------ #
+    # per-server health (the elastic/failover plane)
+    # ------------------------------------------------------------ #
+
+    def server_dead(self, server: int) -> bool:
+        """True when EVERY striped native connection to ``server`` is
+        dead (transport EOF after a crash/SIGKILL, or poisoned) — the
+        worker-side server-death verdict. Driven by the native recv
+        loops / completion reactor conn-death path, so it flips within
+        milliseconds of the TCP EOF (the shm-ring transport polls the
+        paired TCP fd for liveness at 5ms granularity). False for
+        in-range healthy servers and when the loaded native lib
+        predates the probe (version skew: failover simply never
+        triggers)."""
+        if self._closed or not 0 <= server < len(self._servers):
+            return True
+        if not hasattr(self._lib, "bps_client_server_dead"):
+            return False
+        return bool(self._lib.bps_client_server_dead(self._handle, server))
+
+    def dead_servers(self) -> List[int]:
+        """Indices of servers whose every connection is dead."""
+        return [s for s in range(len(self._servers)) if self.server_dead(s)]
+
+    def invalidate_init(self, keys) -> None:
+        """Forget that ``keys`` were init-pushed: after a key migrates to
+        a different server (registry ``migrate_server``), the adoptive
+        server has no store for it yet — the next ``ensure_init`` must
+        re-init-push there instead of trusting this client's cache (which
+        only records key→length, not which server holds the store)."""
+        with self._lock:
+            for k in keys:
+                self._inited_keys.pop(k, None)
+
+    # ------------------------------------------------------------ #
     # raw per-key ops (ZPush/ZPull)
     # ------------------------------------------------------------ #
 
@@ -281,11 +326,16 @@ class PSClient:
             raise RuntimeError(f"init_key failed key={key}")
 
     def zpush(self, server: int, key: int, data: np.ndarray,
-              cmd: int) -> None:
+              cmd: int, epoch: int = 0) -> None:
+        """``epoch``: optional (round << 16 | attempt) replay-dedup stamp
+        — the server folds a given (key, sender, round) at most once, so
+        a retried push after a dropped reply never double-counts
+        (docs/fault-tolerance.md). 0 = unstamped (legacy semantics)."""
         self._check_server(server)
         data = np.ascontiguousarray(data)  # .ctypes.data of a strided
         rc = self._lib.bps_client_push(   # view points at the base buffer
-            self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+            self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
+            epoch)
         if self._m_push_req is not None:
             self._m_push_req.inc()
             self._m_push_bytes.inc(data.nbytes)
@@ -295,18 +345,19 @@ class PSClient:
             raise RuntimeError(f"push failed key={key}")
 
     def zpush_async(self, server: int, key: int, data: np.ndarray,
-                    cmd: int) -> None:
+                    cmd: int, epoch: int = 0) -> None:
         """Fire-and-forget push: returns once the payload is on the wire
         (the native send copies it into the socket/ring, so ``data`` may
         be reused immediately). The ACK drains in the background; a
         server reject poisons the connection and surfaces on the paired
         zpull. Removes the ACK round-trip from the pipeline's critical
         path — the pull is the only synchronization, matching ps-lite's
-        asynchronous ZPush."""
+        asynchronous ZPush. ``epoch``: replay-dedup stamp (see zpush)."""
         self._check_server(server)
         data = np.ascontiguousarray(data)
         rc = self._lib.bps_client_push_async(
-            self._handle, server, key, data.ctypes.data, data.nbytes, cmd)
+            self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
+            epoch)
         if self._m_push_req is not None:
             self._m_push_req.inc()
             self._m_push_bytes.inc(data.nbytes)
@@ -370,7 +421,7 @@ class PSClient:
     def zpushpull_async(self, server: int, key: int, data: np.ndarray,
                         out: np.ndarray, cmd: int,
                         on_done: Callable[[int, Optional[Exception]], None],
-                        ) -> None:
+                        epoch: int = 0) -> None:
         """Fused push+pull in ONE wire round trip: push ``data``, and
         when the server's aggregation round completes, the aggregate
         lands in ``out`` and ``on_done(reply_len, error)`` runs on the
@@ -378,7 +429,10 @@ class PSClient:
         the moment the request is on the wire — no thread parks for the
         aggregation wait, so in-flight partitions are bounded by
         scheduling credit, not pool size. ``out`` must stay alive until
-        ``on_done`` fires (the registration table pins it)."""
+        ``on_done`` fires (the registration table pins it). ``epoch``:
+        replay-dedup stamp (see zpush) — a retried fused request with
+        the same round is answered from the round's aggregate without
+        re-folding the payload."""
         self._check_server(server)
         if not out.flags["C_CONTIGUOUS"]:
             raise ValueError(
@@ -396,7 +450,7 @@ class PSClient:
         self._inflight_add(1)
         rc = self._lib.bps_client_pushpull_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
-            out.ctypes.data, out.nbytes, ticket)
+            out.ctypes.data, out.nbytes, ticket, epoch)
         if self._m_pushpull_req is not None:
             self._m_pushpull_req.inc()
             self._m_push_bytes.inc(data.nbytes)

@@ -431,10 +431,9 @@ def _reduce_grads(grads: List, compression, sparse_as_dense: bool,
     broadcast_variables makes for startup, applied to the hot path).
 
     Graph mode batches EVERY dense gradient into a SINGLE py_function
-    that submits all, then drains all. One hop instead of one per
-    tensor: each py_function re-enters Python under the GIL, and on a
-    ResNet-50-shaped gradient set the per-tensor arrangement measured
-    +112% over the raw-scheduler floor vs +69% batched
+    that submits all, waits once, then drains all. One hop instead of
+    one per tensor: each py_function re-enters Python under the GIL —
+    measured numbers and the 1-core caveat live in docs/tensorflow.md
     (examples/benchmark_tf_hop.py; the reference avoids the hop
     entirely with a native AsyncOpKernel, ops.cc:167-231 — the batched
     boundary is this rebuild's equivalent, same shape as
@@ -478,22 +477,47 @@ def _reduce_grads(grads: List, compression, sparse_as_dense: bool,
 def _graph_batch_push_pull(named: List, compression) -> List:
     """ONE ``tf.py_function`` averaging a whole list of ``(name, dense
     symbolic tensor)`` pairs: the op body submits every tensor through
-    the scheduler, then drains — one Python/GIL hop per STEP instead of
-    per tensor (measured on a ResNet-50-shaped set: +112% over the
-    raw-scheduler floor per-tensor vs +69% batched,
-    examples/benchmark_tf_hop.py). Shared by the TF2 tape/optimizer
-    reduction and the TF1 ``compute_gradients`` override."""
+    the scheduler, parks once on a single batched GIL-releasing wait,
+    then converts — one Python/GIL hop per STEP instead of per tensor
+    (examples/benchmark_tf_hop.py measures this exact function;
+    numbers + the 1-core caveat in docs/tensorflow.md). Shared by the
+    TF2 tape/optimizer reduction and the TF1 ``compute_gradients``
+    override."""
     if not named:
         return []
     names = [nm for nm, _ in named]
 
     def _op(*tensors):
+        import threading
+
         subs = []
         try:
             for nm, t in zip(names, tensors):
                 wire, cctx = compression.compress(t.numpy())
                 subs.append((_submit(wire, nm, True, None), wire.shape,
                              cctx))
+            # ONE batched wait for the whole gradient set: every handle
+            # counts down a single event via its done-callback, and this
+            # thread parks on that event once — releasing the GIL for
+            # the full drain window — instead of the former serial
+            # wait-then-decompress loop, which re-took the GIL between
+            # every handle and serialized each decompress behind the
+            # NEXT handle's wait (the +69%-over-raw-floor hop,
+            # examples/benchmark_tf_hop.py). The decompress loop below
+            # then runs over already-resolved handles with zero waiting.
+            all_done = threading.Event()
+            pending = [len(subs)]
+            pending_mu = threading.Lock()
+
+            def _one_done():
+                with pending_mu:
+                    pending[0] -= 1
+                    if pending[0] == 0:
+                        all_done.set()
+
+            for h, _, _ in subs:
+                h.add_done_callback(_one_done)
+            all_done.wait(timeout=600)
             return [tf.constant(compression.decompress(
                         _handles.wait_and_clear(h.id).reshape(shape),
                         cctx))

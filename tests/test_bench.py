@@ -96,10 +96,24 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "shard_counter_proof": True}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
+        if name == "churn_ab":
+            return {"churn_ab_identical": True,
+                    "churn_ab_chaos_retries": 7,
+                    "churn_ab_clean_retries": 0,
+                    "churn_ab_drop_rate": 0.25,
+                    "churn_ab_idempotent_proof": True}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
     assert out["value"] == 100000.0
+    assert out["churn_ab_idempotent_proof"] is True
+    assert out["churn_ab_chaos_retries"] == 7
+    # never-landed driver keys run FIRST (the VERDICT next-round #3
+    # reorder): the throttled pair and scaling ahead of the long raw
+    # pushpull phases that used to starve them out of overrun rounds
+    cpu_calls = [c for c in calls
+                 if c not in ("probe", "train", "pushpull_tpu")]
+    assert cpu_calls[:3] == ["pushpull_throttled", "scaling", "churn_ab"]
     assert out["metrics_on_step_ms"] == 5.1
     assert out["metrics_overhead_pct"] == 2.0
     assert out["stream_on_step_ms"] == 4.0
@@ -155,6 +169,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
                     "shard_reduction_ratio": 8.0}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
+        if name == "churn_ab":
+            return {"churn_ab_identical": True,
+                    "churn_ab_chaos_retries": 5,
+                    "churn_ab_clean_retries": 0}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -170,13 +188,14 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    assert calls.count("probe") == 10 + n_final
+    # start + one attempt after each of the 10 CPU phases + finals
+    assert calls.count("probe") == 11 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
-        "start", "after_pushpull", "after_pushpull_2srv",
-        "after_pushpull_throttled", "after_arena_ab",
-        "after_metrics_ab", "after_stream_ab", "after_wire_ab",
-        "after_shard_ab", "after_scaling",
+        "start", "after_pushpull_throttled", "after_scaling",
+        "after_churn_ab", "after_pushpull", "after_pushpull_2srv",
+        "after_arena_ab", "after_metrics_ab", "after_stream_ab",
+        "after_wire_ab", "after_shard_ab",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     assert all(d.get("err") == "timeout" for d in probes)
     assert any(str(d.get("at", "")).startswith("final_wait")
@@ -296,8 +315,37 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
     skipped = {k: v for k, v in out["phase_errors"].items()
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
-                            "pushpull_throttled", "arena_ab", "metrics_ab",
-                            "stream_ab", "wire_ab", "shard_ab", "scaling"}
+                            "pushpull_throttled", "churn_ab", "arena_ab",
+                            "metrics_ab", "stream_ab", "wire_ab",
+                            "shard_ab", "scaling"}
+
+
+def test_multichip_envelope_bounded():
+    """MULTICHIP envelope guard (the BENCH_r05 class, applied to the
+    dryrun): the dryrun's worst case — every phase running to its full
+    per-phase timeout — must fit HALF the driver window, so phase growth
+    without budget fails here, in tier-1, instead of silently pushing a
+    future driver round past its kill deadline. Also pins the phase
+    list to the functions that actually exist (a renamed/removed phase
+    fn breaks the product silently otherwise)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__",
+        os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py"))
+    g = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(g)
+    phases = g._DRYRUN_PHASES
+    assert len(phases) >= 7  # the envelope covers the real suite
+    worst_case = len(phases) * g.DRYRUN_PHASE_TIMEOUT_S
+    assert worst_case <= g.DRYRUN_DRIVER_WINDOW_S / 2, (
+        f"{len(phases)} dryrun phases x {g.DRYRUN_PHASE_TIMEOUT_S:.0f}s "
+        f"= {worst_case:.0f}s worst case exceeds half the "
+        f"{g.DRYRUN_DRIVER_WINDOW_S:.0f}s driver window — trim a phase "
+        f"or grow the budget DELIBERATELY")
+    # the re-exec child's hard timeout mirrors the same half-window
+    for name, fn in phases:
+        assert callable(fn), name
 
 
 def test_partial_snapshots_survive_a_kill(bench, monkeypatch, capsys):

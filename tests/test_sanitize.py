@@ -46,6 +46,13 @@ def worker(w):
         c.init_tensor(ctx, np.zeros(3000, np.float32))
     ct = CompressedTensor(c, r.init_tensor("comp", 2048 * 4, DataType.FLOAT32),
                           {"compressor": "onebit", "ef": "vanilla"}, 2)
+    # dedicated keys for the fault-tolerance wire paths: epoch-stamped
+    # pushes with a deliberate REPLAY (server-side last_round dedup) and
+    # the fused PUSHPULL op carrying the same stamps
+    rctx = r.init_tensor("replay", 1024 * 4, DataType.FLOAT32)
+    c.init_tensor(rctx, np.zeros(1024, np.float32))
+    fctx = r.init_tensor("fusedep", 1024 * 4, DataType.FLOAT32)
+    c.init_tensor(fctx, np.zeros(1024, np.float32))
     for step in range(15):
         for ctx in ctxs:
             x = rng.randn(3000).astype(np.float32)
@@ -62,6 +69,26 @@ def worker(w):
         for p in actx.partitions:
             out = np.empty(p.length // 4, np.float32)
             c.zpull(p.server, p.key, out, CMD)
+        # replay/dedup path (round 6 fault-tolerance addition): each
+        # worker pushes its epoch-stamped contribution TWICE — the
+        # server must fold it once (last_round dedup) and both engine
+        # threads race on the same KeyStore's last_round vector
+        ep = (step + 1) << 16
+        rp = rctx.partitions[0]
+        rbuf = rng.randn(1024).astype(np.float32)
+        c.zpush(rp.server, rp.key, rbuf, CMD, epoch=ep)
+        c.zpush(rp.server, rp.key, rbuf, CMD, epoch=ep | 1)  # replay
+        rout = np.empty(1024, np.float32)
+        c.zpull(rp.server, rp.key, rout, CMD)
+        # fused PUSHPULL with the same stamp: parked fused replies +
+        # the completion reactor under the sanitizer
+        fp = fctx.partitions[0]
+        fdone = threading.Event()
+        fout = np.empty(1024 * 4, np.uint8)
+        c.zpushpull_async(fp.server, fp.key,
+                          rng.randn(1024).astype(np.float32), fout, CMD,
+                          lambda n, err, d=fdone: d.set(), epoch=ep)
+        assert fdone.wait(60), "fused completion never fired"
         c.barrier()
 
 threads = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
