@@ -598,6 +598,158 @@ def phase_churn_ab(n_tensors: int = 6, elems: int = 4096,
                                               and clean_retries == 0)}
 
 
+def _codec_train_run(bps, steps: int, layers: int = 4):
+    """One deterministic PS train run for the codec-plane A/B: mixed
+    4MB + bias leaves through make_ps_train_step, returning (params,
+    wire bytes moved, metrics snapshot). Same model/data on every call
+    — arm differences come only from env."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from byteps_tpu.core.state import get_state
+    from byteps_tpu.jax.train import make_ps_train_step
+
+    rng = np.random.RandomState(0)
+    params = {f"w{i}": _cpu_put(rng.randn(1024, 1024).astype(np.float32))
+              for i in range(layers)}
+    params.update({f"b{i}": _cpu_put(rng.randn(1024).astype(np.float32))
+                   for i in range(layers)})
+    batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+    def loss_fn(p, b):
+        h = b
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean(h * h)
+
+    tx = optax.sgd(1e-3)
+    opt = tx.init(params)
+    step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, batch)
+        float(loss)
+    snap = bps.get_metrics()
+    wire = (snap["counters"].get("wire/push_bytes", 0)
+            + snap["counters"].get("wire/pull_bytes", 0))
+    host = {k: np.asarray(v) for k, v in params.items()}
+    return host, wire, snap
+
+
+def phase_codec_adapt_ab(steps: int = 10) -> dict:
+    """Adaptive codec control plane A/B (core/codec_plane.py) with HARD
+    counter evidence, four arms on the loopback PS:
+
+    1. throttled (BYTEPS_SERVER_THROTTLE_MBPS) + BYTEPS_CODEC_ADAPT=1 —
+       the profiler classifies the steps PULL-bound, the plane walks the
+       ladder: ``codec/switches`` must be > 0 and the run's wire bytes
+       must undercut arm 2's;
+    2. throttled + adapt off — the dense wire-byte baseline;
+    3. unthrottled + adapt on — COMPUTE-bound steps: the plane must NOT
+       switch (zero ``codec/switches``);
+    4. BYTEPS_CODEC_PIN=lossless vs dense — identical seeds, final
+       params BITWISE equal: the lossless tier end-to-end proof.
+
+    Plus a codec-tag mismatch injected at the server (a push tagged
+    ``lossless`` against a dense store): must be rejected with a loud
+    error, and the store's aggregate must be untouched — never a silent
+    mis-fold."""
+    _force_cpu()
+    import numpy as np
+
+    scoped_keys = ("BYTEPS_CODEC_ADAPT", "BYTEPS_CODEC_PIN",
+                   "BYTEPS_SERVER_THROTTLE_MBPS", "BYTEPS_CODEC_UP_ROUNDS",
+                   "BYTEPS_CODEC_PULL_RATIO")
+    prior = {k: os.environ.get(k) for k in scoped_keys}
+
+    def run(adapt: bool, throttle_mbps: float = 0.0, pin: str = "",
+            n_steps: int = steps):
+        os.environ["BYTEPS_CODEC_ADAPT"] = "1" if adapt else "0"
+        if pin:
+            os.environ["BYTEPS_CODEC_PIN"] = pin
+        else:
+            os.environ.pop("BYTEPS_CODEC_PIN", None)
+        if throttle_mbps > 0:
+            os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle_mbps)
+        else:
+            os.environ.pop("BYTEPS_SERVER_THROTTLE_MBPS", None)
+        # escalate promptly in the short throttled window; the pull
+        # signal must dominate compute clearly before any switch
+        os.environ["BYTEPS_CODEC_UP_ROUNDS"] = "2"
+        os.environ["BYTEPS_CODEC_PULL_RATIO"] = "1.5"
+        with _loopback_ps(1) as bps:
+            params, wire, snap = _codec_train_run(bps, n_steps)
+            return (params, wire,
+                    int(snap["counters"].get("codec/switches", 0)),
+                    snap["counters"].get("codec/lossless_bytes_post", 0))
+
+    def tag_mismatch_probe() -> bool:
+        """Direct wire probe: a push tagged ``lossless`` against a dense
+        store must error-reply (LOUD) and leave the aggregate
+        untouched."""
+        with _loopback_ps(1) as bps:
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.core.types import (
+                DataType, RequestType, get_command_type)
+            state = get_state()
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   DataType.FLOAT32)
+            g = np.arange(512, dtype=np.float32)
+            out = np.asarray(bps.synchronize(
+                bps.push_pull_async(g, "tagprobe", average=False)))
+            ctx = state.registry.get("tagprobe")
+            p = ctx.partitions[0]
+            rejected = False
+            try:
+                state.ps_client.zpush(p.server, p.key, g * 7, cmd,
+                                      epoch=(99 << 16),
+                                      codec=(1 << 8) | 2)  # lossless tag
+            except RuntimeError:
+                rejected = True
+            buf = np.empty(512, np.float32)
+            state.ps_client.zpull(p.server, p.key, buf, cmd)
+            # the mis-tagged payload must NOT have folded: the published
+            # aggregate is still round 1's
+            return rejected and np.array_equal(buf, out)
+
+    try:
+        _, adapt_wire, adapt_switches, lossless_post = run(
+            True, throttle_mbps=60.0)
+        _, dense_wire, _, _ = run(False, throttle_mbps=60.0)
+        _, _, clean_switches, _ = run(True, throttle_mbps=0.0)
+        pin_params, _, _, _ = run(True, pin="lossless", n_steps=4)
+        dense_params, _, _, _ = run(False, n_steps=4)
+        bitwise = all(
+            pin_params[k].tobytes() == dense_params[k].tobytes()
+            for k in pin_params)
+        mismatch_rejected = tag_mismatch_probe()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    reduction = adapt_wire / dense_wire if dense_wire else None
+    return {
+        "codec_adapt_throttled_switches": adapt_switches,
+        "codec_adapt_unthrottled_switches": clean_switches,
+        "codec_adapt_wire_bytes": int(adapt_wire),
+        "codec_dense_wire_bytes": int(dense_wire),
+        "codec_adapt_wire_reduction": round(reduction, 4)
+        if reduction is not None else None,
+        "codec_lossless_bytes_post": int(lossless_post),
+        "codec_lossless_bitwise": bool(bitwise),
+        "codec_tag_mismatch_rejected": bool(mismatch_rejected),
+        # the headline proof bit: the plane escalated under throttle and
+        # cut wire bytes, held still unthrottled, the lossless tier is
+        # bitwise, and a mis-tagged fold is rejected loudly
+        "codec_adapt_proof": bool(
+            adapt_switches > 0 and clean_switches == 0
+            and reduction is not None and reduction < 0.9
+            and bitwise and mismatch_rejected),
+    }
+
+
 def phase_arena_ab(steps: int = 6) -> dict:
     """A/B the persistent host staging arena (core/arena.py,
     BYTEPS_STAGING_ARENA) on the PS train step's steady state: the same
@@ -1311,6 +1463,7 @@ _PHASES = {
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
     "churn_ab": phase_churn_ab,
+    "codec_adapt_ab": phase_codec_adapt_ab,
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
@@ -1437,6 +1590,12 @@ def main() -> None:
         "churn_ab_chaos_retries": None,
         "churn_ab_clean_retries": None,
         "churn_ab_idempotent_proof": None,
+        "codec_adapt_throttled_switches": None,
+        "codec_adapt_unthrottled_switches": None,
+        "codec_adapt_wire_reduction": None,
+        "codec_lossless_bitwise": None,
+        "codec_tag_mismatch_rejected": None,
+        "codec_adapt_proof": None,
     }
     errors = {}
     # per-attempt tunnel diagnostics: probe wall time, platform, errors —
@@ -1585,6 +1744,14 @@ def main() -> None:
                             # epoch-dedup'd retries vs clean, bitwise
                             # equality + retry-counter proof
                             ("churn_ab", 240.0),
+                            # adaptive-codec A/B: ladder escalation
+                            # under throttle (switch + wire-byte counter
+                            # proof), zero switches unthrottled,
+                            # lossless bitwise parity, loud tag-mismatch
+                            # rejection — in the runs-first group (a key
+                            # that has never landed in a driver
+                            # artifact)
+                            ("codec_adapt_ab", 300.0),
                             ("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback

@@ -83,6 +83,20 @@ class Config:
     # --- compression ---
     min_compress_bytes: int = DEFAULT_MIN_COMPRESS_BYTES
 
+    # --- adaptive codec control plane (rebuild addition;
+    # core/codec_plane.py — "Compressed Communication: Adaptive Methods
+    # and System", arxiv 2105.07829). On: leaves whose caller expressed
+    # no codec opinion have their wire codec resolved PER ROUND from the
+    # live StepReport signal, walking the dense -> lossless -> onebit
+    # ladder with hysteresis (escalate when PULL-bound, de-escalate when
+    # the wire recovers); every push carries a codec tag the server
+    # validates per round, so plan skew fails loudly instead of
+    # mis-folding. Off (default): the pre-plane static behavior. The
+    # plane's tuning knobs (BYTEPS_CODEC_LADDER / _UP_ROUNDS /
+    # _DOWN_ROUNDS / _PULL_RATIO / _PIN / _MIN_BYTES, docs/env.md) are
+    # read by the plane itself at construction. ---
+    codec_adapt: bool = False             # BYTEPS_CODEC_ADAPT
+
     # --- host staging arena (rebuild addition; the reference's cpubuff
     # discipline, operations.cc:283-414: staging buffers allocated once
     # at InitTensor and reused zero-copy). On: the PS train step's
@@ -230,6 +244,7 @@ class Config:
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
             enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE"),
             mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),
+            codec_adapt=_env_bool("BYTEPS_CODEC_ADAPT"),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES",
                                         DEFAULT_MIN_COMPRESS_BYTES),
             staging_arena=_env_bool("BYTEPS_STAGING_ARENA", True),

@@ -29,8 +29,14 @@ Three layers:
 Adaptive-compression systems (PAPERS.md: Compressed Communication for
 Distributed Training) and update-sharding work (Automatic Cross-Replica
 Sharding of Weight Update) drive their decisions from exactly this kind
-of per-stage timing and byte accounting — this module is what makes
-those ROADMAP directions measurable.
+of per-stage timing and byte accounting. The first in-tree consumer
+that ACTS on it is the adaptive codec control plane
+(``core/codec_plane.py``, ``BYTEPS_CODEC_ADAPT``): it derives per-round
+``RoundSignal``s from the StepReport ring (the same compute-vs-pull
+comparison ``classify_step`` prints) and walks each leaf's wire codec
+up and down the dense→lossless→onebit ladder, reporting back into this
+registry as the ``codec/*`` instrument family (switch counter, per-tier
+active gauges, lossless byte accounting — docs/observability.md).
 """
 
 from __future__ import annotations

@@ -94,6 +94,11 @@ class TensorRegistry:
         # "the table changed under me" cheaply)
         self._dead_servers: set = set()
         self._routing_version = 0
+        # adaptive codec plane: per-leaf plan state (core/codec_plane.py
+        # CodecPlan — active ladder rung, plan epoch, hysteresis
+        # streaks). Lives on the registry, not the plane, so plans
+        # survive scheduler teardown/rebuild the way declarations do.
+        self._codec_plans: Dict[str, object] = {}
 
     def attach_arena(self, arena) -> None:
         self._arena = arena
@@ -169,11 +174,33 @@ class TensorRegistry:
                 self._declaration_order.remove(name)
             except ValueError:
                 pass
+            # a retired leaf's adaptive plan retires with it (a later
+            # re-declaration is a NEW leaf and starts at the ladder base)
+            self._codec_plans.pop(name, None)
             return True
 
     def get(self, name: str) -> Optional[TensorContext]:
         with self._lock:
             return self._contexts.get(name)
+
+    # ------------------------------------------------------------------ #
+    # adaptive codec plan state (core/codec_plane.py)
+    # ------------------------------------------------------------------ #
+
+    def codec_plan(self, name: str):
+        """Get-or-create the leaf's adaptive codec plan. The plan object
+        is MUTABLE and owned by the codec plane (which serializes its
+        own mutations); the registry only provides stable storage."""
+        with self._lock:
+            plan = self._codec_plans.get(name)
+            if plan is None:
+                from .codec_plane import CodecPlan
+                plan = self._codec_plans[name] = CodecPlan()
+            return plan
+
+    def codec_plans(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._codec_plans)
 
     def contexts_in_order(self) -> List[TensorContext]:
         with self._lock:

@@ -177,6 +177,18 @@ class ScheduledQueue:
         with self._mu:
             return len(self._heap)
 
+    def keys_idle(self, keys) -> bool:
+        """True when none of ``keys`` is queued or in flight — the
+        quiescence probe the adaptive codec plane uses before
+        re-installing a leaf's server-side codec (a COMP_INIT racing an
+        in-flight round of the same key would reset the server's round
+        state under it)."""
+        with self._mu:
+            ks = set(keys)
+            if ks & self._inflight:
+                return False
+            return not any(item[1] in ks for item in self._heap)
+
 
 class PartitionTask:
     """One partition of one push_pull — the reference's TensorTableEntry
@@ -189,7 +201,7 @@ class PartitionTask:
     __slots__ = ("ctx", "partition", "priority", "version", "in_view",
                  "out_view", "group", "cmd", "stack", "step", "wire",
                  "cmd_pull", "pull_len", "push_len", "lease", "enqueue_t",
-                 "round_no", "attempt")
+                 "round_no", "attempt", "codec")
 
     def __init__(self, ctx, partition, priority, version, in_view, out_view,
                  group, cmd, stack=None, step=0, wire=None, cmd_pull=None,
@@ -212,6 +224,10 @@ class PartitionTask:
         self.enqueue_t = None      # admission-wait clock (metrics)
         self.round_no = 0          # per-key submission ordinal (epoch stamp)
         self.attempt = 0           # wire retries of this round so far
+        # adaptive-codec wire tag (plan_epoch << 8 | codec_id): the
+        # server latches the first fold's tag per round and loudly
+        # rejects disagreeing folds. 0 = untagged (static configs).
+        self.codec = 0
 
     @property
     def epoch(self) -> int:
@@ -449,8 +465,15 @@ class PipelineScheduler:
         if metrics is not None:
             self._comp_pre = metrics.counter("compress/bytes_pre")
             self._comp_post = metrics.counter("compress/bytes_post")
+            # lossless tier's own byte accounting (codec plane evidence:
+            # codec/lossless_ratio = post/pre; bench codec_adapt_ab)
+            self._lossless_pre = metrics.counter(
+                "codec/lossless_bytes_pre")
+            self._lossless_post = metrics.counter(
+                "codec/lossless_bytes_post")
         else:
             self._comp_pre = self._comp_post = None
+            self._lossless_pre = self._lossless_post = None
         # persistent host staging arena (core/arena.py): reply scratch
         # for compressed pulls checks out of it instead of np.empty per
         # round; None = allocate fresh (the pre-arena behavior)
@@ -513,9 +536,26 @@ class PipelineScheduler:
             self._m_migrations = metrics.counter("registry/migrations")
         else:
             self._m_retries = self._m_failovers = self._m_migrations = None
+        # adaptive codec plane (core/codec_plane.py), attached after
+        # construction by GlobalState.init when BYTEPS_CODEC_ADAPT is on
+        # (the plane needs a scheduler reference for its quiescence
+        # probe, so neither can own the other at construction time)
+        self._codec_plane = None
         self._dispatcher = threading.Thread(
             target=self._dispatch, name="bps-sched-dispatch", daemon=True)
         self._dispatcher.start()
+
+    def attach_codec_plane(self, plane) -> None:
+        self._codec_plane = plane
+
+    def keys_idle(self, keys) -> bool:
+        """Quiescence probe for the codec plane: no queued, in-flight,
+        or backoff-parked task touches any of ``keys``."""
+        with self._retry_mu:
+            if any(t.key in set(keys)
+                   for _, t in self._pending_retries.values()):
+                return False
+        return self._queue.keys_idle(keys)
 
     def _next_round(self, ctx: TensorContext) -> int:
         with self._prio_mu:
@@ -757,17 +797,6 @@ class PipelineScheduler:
                     f"server {srv} is dead and key migration is "
                     f"unavailable (no registry attached) — cannot "
                     f"re-route key {task.key}")
-            if task.stack is not None:
-                # a host-compressed key's server-side codec (COMP_INIT)
-                # does not transfer: the adoptive server would reject
-                # the wire as a mode mismatch. Fail clearly instead of
-                # burning retries.
-                raise RuntimeError(
-                    f"server {srv} died holding host-compressed key "
-                    f"{task.key}; live migration of compressed keys is "
-                    f"not supported (the adoptive server has no "
-                    f"compressor state) — re-initialize compression for "
-                    f"this tensor")
         # Seed any not-yet-initialized store on the (possibly re-homed)
         # server before re-sending: INIT_PUSH doubles as the state sync
         # (allocation + init barrier across workers; converges because
@@ -779,11 +808,24 @@ class PipelineScheduler:
         # above then reads "alive" and the dead-server branch never
         # runs). A fully-cached tensor makes this a dict lookup.
         ensure = getattr(self._client, "ensure_init", None)
-        if (ensure is not None and task.stack is None
+        if (ensure is not None
                 and getattr(task.ctx, "nbytes", 0)
                 and task.ctx.nbytes == sum(p.length
                                            for p in task.ctx.partitions)):
             ensure(task.ctx, task.ctx.nbytes)
+        if task.stack is not None:
+            # host-compressed key: the server-side codec (COMP_INIT
+            # state) died with the server — re-install it on the
+            # (possibly re-homed) store before replaying the wire, so
+            # compressed keys survive a server death exactly like dense
+            # keys (this used to be a hard "not supported" error).
+            # Idempotent when the store already has the same cfg (the
+            # server applies a matching COMP_INIT as a no-op), so the
+            # non-migrated retry paths pay one small RPC, not a reset.
+            comp_init = getattr(self._client, "comp_init", None)
+            if comp_init is not None:
+                comp_init(task.partition.server, task.key,
+                          task.stack.kwargs_wire())
 
     def _failover_server(self, srv: int) -> None:
         # the lock is held across the WHOLE migration: a second failing
@@ -915,22 +957,23 @@ class PipelineScheduler:
             self._submit_stage(self._pull_pool, _complete_dense, task)
 
         try:
-            self._client.zpushpull_async(task.partition.server, task.key,
-                                         buf, reply, task.cmd, on_done,
-                                         epoch=task.epoch)
-        except TypeError:
-            # client without the epoch kwarg (fake test clients, stale
-            # builds): legacy unstamped call — retries still bounded,
-            # idempotence falls back to the server's positional counting
             try:
                 self._client.zpushpull_async(
                     task.partition.server, task.key, buf, reply, task.cmd,
-                    on_done)
-            except Exception as e:  # noqa: BLE001
-                if self._tracer:
-                    self._tracer.end(name, span)
-                self._fail_or_retry(task, e)
-                return
+                    on_done, epoch=task.epoch, codec=task.codec)
+            except TypeError:
+                # client without the codec and/or epoch kwargs (fake
+                # test clients, stale builds): degrade one kwarg at a
+                # time — an untagged push just skips server validation,
+                # an unstamped one falls back to positional counting
+                try:
+                    self._client.zpushpull_async(
+                        task.partition.server, task.key, buf, reply,
+                        task.cmd, on_done, epoch=task.epoch)
+                except TypeError:
+                    self._client.zpushpull_async(
+                        task.partition.server, task.key, buf, reply,
+                        task.cmd, on_done)
         except Exception as e:  # noqa: BLE001
             if self._tracer:
                 self._tracer.end(name, span)
@@ -967,10 +1010,16 @@ class PipelineScheduler:
             # stamp makes a retried push idempotent server-side.
             try:
                 self._client.zpush_async(task.partition.server, task.key,
-                                         buf, task.cmd, epoch=task.epoch)
-            except TypeError:  # epoch-less client (fakes, stale builds)
-                self._client.zpush_async(task.partition.server, task.key,
-                                         buf, task.cmd)
+                                         buf, task.cmd, epoch=task.epoch,
+                                         codec=task.codec)
+            except TypeError:  # codec/epoch-less client (fakes, stale
+                try:           # builds): degrade one kwarg at a time
+                    self._client.zpush_async(
+                        task.partition.server, task.key, buf, task.cmd,
+                        epoch=task.epoch)
+                except TypeError:
+                    self._client.zpush_async(task.partition.server,
+                                             task.key, buf, task.cmd)
         except Exception as e:  # noqa: BLE001
             self._fail_or_retry(task, e)
             return
@@ -1085,6 +1134,9 @@ class PipelineScheduler:
                     # directions: post/pre is the achieved wire ratio
                     self._comp_pre.inc(task.nbytes * 2)
                     self._comp_post.inc(sent + recvd)
+                    if getattr(task.stack, "lossless", False):
+                        self._lossless_pre.inc(task.nbytes * 2)
+                        self._lossless_post.inc(sent + recvd)
             elif task.wire is not None:
                 # prebuilt payload up; reply is dense unless pull_len says
                 # otherwise (device-compressed pulls are wire-sized)
@@ -1123,6 +1175,15 @@ class PipelineScheduler:
         """
         from .types import DataType, RequestType, get_command_type
 
+        # adaptive codec plane: when the caller expressed no codec
+        # opinion and a plane is attached, the wire codec is resolved
+        # HERE — per round, at wire-stage entry — from the leaf's live
+        # plan (core/codec_plane.py). The returned tags ride the wire
+        # header so the server can reject cross-worker plan skew loudly.
+        tag_comp = tag_dense = 0
+        if comp is None and self._codec_plane is not None:
+            comp, tag_comp, tag_dense = self._codec_plane.resolve(
+                ctx, flat_in)
         if comp is not None:
             step = comp.begin_round()  # installs codecs on first call
             flat_in = np.ascontiguousarray(flat_in, np.float32)
@@ -1164,6 +1225,9 @@ class PipelineScheduler:
                 group, cmd_comp if stack is not None else cmd,
                 stack=stack, step=step)
             task.round_no = round_no
+            # plane-governed rounds tag every partition (sub-floor
+            # partitions of a compressed leaf stay dense and say so)
+            task.codec = tag_dense if stack is None else tag_comp
             try:
                 self._queue.add_task(task)
             except RuntimeError as e:

@@ -159,6 +159,7 @@ class GlobalState:
         self.ps_client = None        # set by server.client when PS configured
         self.scheduler = None        # PipelineScheduler over ps_client
         self.handles = None          # HandleManager for the async API
+        self.codec_plane = None      # adaptive codec plane (codec_plane.py)
         # persistent host staging arena (core/arena.py); replaced with an
         # enabled instance at init() when BYTEPS_STAGING_ARENA is on —
         # a disabled arena hands out fresh buffers with identical
@@ -202,6 +203,11 @@ class GlobalState:
             self.metrics = MetricsRegistry(enabled=self.config.metrics_on)
             self.telemetry.attach_metrics(self.metrics)
             self.metrics.section("arena", self.telemetry.arena_stats)
+            # codec-plane instruments exist on every deployment (the
+            # docs/observability.md schema guard resolves them), whether
+            # or not the adaptive plane itself is enabled below
+            from .codec_plane import register_codec_metrics
+            register_codec_metrics(self.metrics)
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.
@@ -296,6 +302,21 @@ class GlobalState:
                     metrics=self.metrics, profiler=self.profiler,
                     registry=self.registry)
                 self.handles = HandleManager()
+                if self.config.codec_adapt:
+                    # adaptive codec control plane: resolves each
+                    # eligible leaf's wire codec per round from the
+                    # StepReport signal (core/codec_plane.py)
+                    from .codec_plane import CodecPlane
+                    self.codec_plane = CodecPlane(
+                        self.ps_client, self.registry, self.metrics,
+                        self.profiler, self.config.num_workers,
+                        scheduler=self.scheduler, config=self.config)
+                    self.scheduler.attach_codec_plane(self.codec_plane)
+                    # live plan table in the snapshot (name -> tier/
+                    # epoch/rung); absent when the plane is off — the
+                    # schema guard only pins the codec/* instruments
+                    self.metrics.section(
+                        "codec_plans", self.codec_plane.plan_snapshot)
             if self.config.metrics_port > 0 and self._metrics_server is None:
                 from .metrics import start_http_server
                 try:
@@ -377,6 +398,9 @@ class GlobalState:
                 pass
             self.scheduler = None
             self.handles = None
+        # the plane holds client/scheduler refs; plan STATE stays on the
+        # registry so a resume continues where the ladder left off
+        self.codec_plane = None
 
     # ------------------------------------------------------------------ #
     # identity (communicator.cc:60-96)

@@ -21,8 +21,10 @@ CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
 # shm_open/sem_* live in librt on glibc < 2.34 (a no-op stub after): a
 # binary linked on a new-glibc host dlopens with "undefined symbol:
 # shm_open" on an older one, so always link it (dropped as a last
-# resort for toolchains without librt).
-LDFLAGS = ["-lrt"]
+# resort for toolchains without librt). -lz: the lossless wire tier's
+# entropy stage (ps.cc CompressorCfg LOSSLESS) — zlib ships with every
+# glibc-era toolchain, so it stays in the last-resort attempt too.
+LDFLAGS = ["-lrt", "-lz"]
 
 
 def _sanitizer_flags() -> list:
@@ -89,7 +91,7 @@ def build(verbose: bool = False) -> str:
             attempts = (
                 [*flags, "-march=native", _SRC, "-o", tmp, *LDFLAGS],
                 [*flags, _SRC, "-o", tmp, *LDFLAGS],
-                [*flags, _SRC, "-o", tmp],
+                [*flags, _SRC, "-o", tmp, "-lz"],  # librt-less toolchain
             )
             proc = None
             for args in attempts:

@@ -9,12 +9,18 @@
 //
 // Protocol (little-endian, same-arch assumption documented in server/README):
 //   MsgHeader { magic u32; op u8; flags u8; sender u16; rid u32; key u64;
-//               cmd u32; len u32; epoch u64 }  -- 36 bytes, then len payload
-//   bytes. epoch = (round << 16) | attempt stamps PUSH/PUSHPULL for
-//   idempotent replay (see "Replay dedup" below); 0 = unstamped (init
-//   pushes, legacy callers). The magic was bumped when epoch was added,
-//   so a version-skewed peer fails loudly on the first message instead
-//   of misparsing payload bytes as a header.
+//               cmd u32; len u32; epoch u64; codec u32 }  -- 40 bytes, then
+//   len payload bytes. epoch = (round << 16) | attempt stamps PUSH/PUSHPULL
+//   for idempotent replay (see "Replay dedup" below); 0 = unstamped (init
+//   pushes, legacy callers). codec = (plan_epoch << 8) | codec_id tags a
+//   push with the wire codec the sender's adaptive plan chose for this
+//   round (0 = untagged/static config, no validation): the server latches
+//   the first fold's tag per round and LOUDLY rejects any disagreeing fold
+//   — cross-worker plan skew must fail the round, never silently mis-sum
+//   dense bytes with codec payloads. The magic was bumped when epoch was
+//   added, and again for the codec tag, so a version-skewed peer fails
+//   loudly on the first message instead of misparsing payload bytes as a
+//   header.
 // Ops: INIT_PUSH, PUSH, PULL, BARRIER, SHUTDOWN, IPC_HELLO from workers;
 //      ACK, PULL_REPLY from the server. Every request carries a worker-side
 //      request id (rid) echoed in the reply, so one connection multiplexes
@@ -50,6 +56,7 @@
 #include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
+#include <zlib.h>  // lossless wire tier's entropy stage (build.py links -lz)
 #if defined(__linux__)
 #include <linux/futex.h>
 #include <sys/syscall.h>
@@ -86,7 +93,75 @@
 
 namespace bps {
 
-static constexpr uint32_t kMagic = 0xB17E5001;  // 5000 + epoch field
+static constexpr uint32_t kMagic = 0xB17E5002;  // 5001 + codec-tag field
+
+// TSAN-visible mutex/condvar with EXPLICIT pthread init/destroy. glibc's
+// std::mutex / std::condition_variable are zero-initialized (no
+// pthread_*_init call), so TSAN cannot distinguish a fresh instance from
+// whatever previously occupied the same heap address — any heap block
+// landing where a destroyed lock once lived (a reaped CPython condition,
+// an earlier native object) then reports "double lock of a destroyed
+// mutex" on first use, the PR-6 sanitizer finding (tests/
+// test_sanitize.py). pthread_mutex_init / pthread_cond_init ARE
+// TSAN-intercepted and reset the sync-object state at construction, so
+// every native mutex/cv goes through these wrappers. Cv waits run on
+// CLOCK_MONOTONIC (wall-clock jumps must not stretch timeouts).
+class Mu {
+ public:
+  Mu() { pthread_mutex_init(&m_, nullptr); }
+  ~Mu() { pthread_mutex_destroy(&m_); }
+  Mu(const Mu&) = delete;
+  Mu& operator=(const Mu&) = delete;
+  void lock() { pthread_mutex_lock(&m_); }
+  void unlock() { pthread_mutex_unlock(&m_); }
+  pthread_mutex_t* native() { return &m_; }
+ private:
+  pthread_mutex_t m_;
+};
+
+class Cv {
+ public:
+  Cv() {
+    pthread_condattr_t a;
+    pthread_condattr_init(&a);
+    pthread_condattr_setclock(&a, CLOCK_MONOTONIC);
+    pthread_cond_init(&c_, &a);
+    pthread_condattr_destroy(&a);
+  }
+  ~Cv() { pthread_cond_destroy(&c_); }
+  Cv(const Cv&) = delete;
+  Cv& operator=(const Cv&) = delete;
+  void notify_one() { pthread_cond_signal(&c_); }
+  void notify_all() { pthread_cond_broadcast(&c_); }
+  void wait(std::unique_lock<Mu>& lk) {
+    pthread_cond_wait(&c_, lk.mutex()->native());
+  }
+  template <typename Pred>
+  void wait(std::unique_lock<Mu>& lk, Pred p) {
+    while (!p()) wait(lk);
+  }
+  // std::condition_variable::wait_for(pred) semantics: returns pred()
+  // at exit (true = predicate satisfied, false = timed out).
+  template <typename Pred>
+  bool wait_for_ms(std::unique_lock<Mu>& lk, long ms, Pred p) {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    ts.tv_sec += ms / 1000;
+    ts.tv_nsec += (ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (!p()) {
+      if (pthread_cond_timedwait(&c_, lk.mutex()->native(), &ts) ==
+          ETIMEDOUT)
+        return p();
+    }
+    return true;
+  }
+ private:
+  pthread_cond_t c_;
+};
 
 enum Op : uint8_t {
   INIT_PUSH = 1,
@@ -116,6 +191,19 @@ enum ReqType : uint32_t {
   kCompressedPushPull = 2,
 };
 
+// Wire codec ids for the adaptive-plan tag (MsgHeader::codec low byte).
+// Values are wire contract — byteps_tpu.core.codec_plane.WIRE_CODEC_IDS
+// mirrors them. 0 = untagged (static per-config codecs, no validation).
+enum WireCodec : uint8_t {
+  kCodecUntagged = 0,
+  kCodecDense = 1,
+  kCodecLossless = 2,
+  kCodecOnebit = 3,
+  kCodecTopk = 4,
+  kCodecRandomk = 5,
+  kCodecDithering = 6,
+};
+
 // DataType codes match byteps_tpu.core.types.DataType (mshadow order).
 enum DType : uint32_t {
   F32 = 0, F64 = 1, F16 = 2, U8 = 3, I32 = 4, I8 = 5, I64 = 6,
@@ -138,13 +226,20 @@ struct MsgHeader {
   // given (key, sender, round) at most once — a retried push after a
   // dropped reply must never double-count into the aggregation. 0 =
   // unstamped (init pushes, pulls, blocking legacy callers): no dedup.
-  // Declared last so every aggregate-initialized reply header
-  // ({kMagic, ACK, ...}) zero-fills it.
   uint64_t epoch;
+  // Adaptive-codec plan tag: (plan_epoch << 8) | WireCodec id. The first
+  // fold of a round latches it; a later fold of the SAME round carrying a
+  // different tag (codec id OR plan epoch) is rejected with a loud error
+  // reply — the aggregation-safety net for cross-worker plan skew
+  // (docs/compression.md). 0 = untagged: static-config traffic, no
+  // validation. Trailing fields are declared last so every
+  // aggregate-initialized reply header ({kMagic, ACK, ...}) zero-fills
+  // them.
+  uint32_t codec;
 };
 #pragma pack(pop)
 
-static_assert(sizeof(MsgHeader) == 36, "header layout");
+static_assert(sizeof(MsgHeader) == 40, "header layout");
 
 // Inverse Cantor pairing (common.cc:98-101).
 static inline void decode_cmd(uint32_t cmd, uint32_t* req, uint32_t* dtype) {
@@ -696,7 +791,7 @@ static inline float uniform_at(uint32_t i, uint32_t base) {
 }
 
 struct CompressorCfg {
-  enum Type { NONE = 0, ONEBIT, TOPK, RANDOMK, DITHERING };
+  enum Type { NONE = 0, ONEBIT, TOPK, RANDOMK, DITHERING, LOSSLESS };
   int type = NONE;
   uint32_t n = 0;       // uncompressed f32 element count
   uint32_t k = 0;       // topk/randomk
@@ -707,16 +802,27 @@ struct CompressorCfg {
   bool l2 = false;      // dithering normalize
   bool varint = false;  // dithering sparse index coding (delta+LEB128)
 
+  // Lossless byte-plane wire header (little-endian, mirrored bit-for-bit
+  // by ops/compression/lossless.py — the wire has three producers like
+  // the lossy codecs): [u32 n][u8 mode][u8 nplanes=4][u16 rsvd]
+  // [u32 plane_len[4]][plane bytes...]. mode 1 = zlib-deflated planes
+  // (self-describing stream — producers need not emit identical bytes,
+  // only decodable ones); mode 0 = raw passthrough when deflate did not
+  // help, capping the wire at header + 4n.
+  static constexpr uint32_t kLosslessHdr = 8 + 4 * 4;
+
   // Upper bound on a wire payload. Fixed formats use it exactly; the
-  // varint dithering wire is variable-length up to this bound (worst
-  // case all-nonzero: n 1-byte gaps + n levels, plus slack for the rare
-  // multi-byte gaps, whose count is bounded by sum(gaps) <= n).
+  // varint dithering wire and the lossless byte-plane wire are
+  // variable-length up to this bound (dithering worst case all-nonzero:
+  // n 1-byte gaps + n levels + multi-byte-gap slack; lossless worst
+  // case: raw-passthrough planes).
   uint32_t WireLen() const {
     switch (type) {
       case ONEBIT: return ((n + 31) / 32) * 4 + 4;
       case TOPK: case RANDOMK: return k * 8;
       case DITHERING:
         return varint ? 2 * n + n / 64 + 16 : n + 4;
+      case LOSSLESS: return kLosslessHdr + 4 * n;
       default: return 0;
     }
   }
@@ -724,6 +830,8 @@ struct CompressorCfg {
   bool ValidLen(size_t len) const {
     if (type == DITHERING && varint)
       return len >= 8 && len <= WireLen();
+    if (type == LOSSLESS)
+      return len >= kLosslessHdr && len <= WireLen();
     return len == WireLen();
   }
 
@@ -764,6 +872,13 @@ struct CompressorCfg {
     else if (name == "topk") c.type = TOPK;
     else if (name == "randomk") c.type = RANDOMK;
     else if (name == "dithering") c.type = DITHERING;
+    else if (name == "lossless") c.type = LOSSLESS;
+    // "none" = explicit codec CLEAR: the adaptive plane de-escalating a
+    // key back to dense sends COMP_INIT with compressor=none so later
+    // dense pushes pass the mode gate (DoPush) instead of erroring
+    // against a stale compressed cfg. n still validated against the
+    // store like any other cfg.
+    else if (name == "none") c.type = NONE;
     else return false;
     if (c.n == 0) return false;
     if ((c.type == TOPK || c.type == RANDOMK) &&
@@ -894,6 +1009,38 @@ struct CompressorCfg {
           }
           float sgn = (l > 0) - (l < 0);
           out[i] = sgn * mag * norm;
+        }
+        return true;
+      }
+      case LOSSLESS: {
+        // byte-plane split + zlib inflate, bitwise-exact reconstruction
+        // (ZipCCL's exponent/mantissa byte-plane observation, arxiv
+        // 2604.27844). Bounds-checked: untrusted input.
+        uint32_t wn;
+        std::memcpy(&wn, in, 4);
+        uint8_t mode = in[4], nplanes = in[5];
+        if (wn != n || nplanes != 4 || mode > 1) return false;
+        uint32_t plens[4];
+        std::memcpy(plens, in + 8, 16);
+        uint64_t total = 0;
+        for (int j = 0; j < 4; ++j) total += plens[j];
+        if (kLosslessHdr + total != len) return false;
+        uint8_t* dst = (uint8_t*)out;
+        std::vector<uint8_t> plane(n);
+        size_t pos = kLosslessHdr;
+        for (int j = 0; j < 4; ++j) {
+          const uint8_t* src = in + pos;
+          if (mode == 0) {
+            if (plens[j] != n) return false;
+            for (uint32_t i = 0; i < n; ++i) dst[i * 4 + j] = src[i];
+          } else {
+            uLongf dl = n;
+            if (uncompress(plane.data(), &dl, src, plens[j]) != Z_OK ||
+                dl != n)
+              return false;
+            for (uint32_t i = 0; i < n; ++i) dst[i * 4 + j] = plane[i];
+          }
+          pos += plens[j];
         }
         return true;
       }
@@ -1076,6 +1223,47 @@ struct CompressorCfg {
         std::memcpy(out + gap_pos + nnz, &norm, 4);
         return (uint32_t)(gap_pos + nnz + 4);
       }
+      case LOSSLESS: {
+        // byte-plane split (plane j = byte j of every f32) + zlib
+        // deflate per plane; raw passthrough (mode 0) when deflate does
+        // not pay, so the wire never exceeds WireLen(). Level 1: the
+        // tier trades a cheap entropy pass for wire bytes — gradient
+        // sign/exponent planes carry most of the redundancy and
+        // compress well even at the fastest level, while higher levels
+        // burn compress wall for little extra ratio on mantissa noise.
+        const uint8_t* src = (const uint8_t*)in;
+        std::vector<uint8_t> plane(n);
+        std::vector<uint8_t> packed[4];
+        uint64_t total = 0;
+        bool deflated = true;
+        for (int j = 0; j < 4 && deflated; ++j) {
+          for (uint32_t i = 0; i < n; ++i) plane[i] = src[i * 4 + j];
+          packed[j].resize(compressBound(n));
+          uLongf dl = packed[j].size();
+          if (compress2(packed[j].data(), &dl, plane.data(), n, 1)
+              != Z_OK)
+            deflated = false;
+          packed[j].resize(dl);
+          total += dl;
+        }
+        uint8_t mode = (deflated && total < 4ull * n) ? 1 : 0;
+        std::memcpy(out, &n, 4);
+        out[4] = mode;
+        out[5] = 4;  // nplanes
+        out[6] = out[7] = 0;
+        size_t pos = kLosslessHdr;
+        for (int j = 0; j < 4; ++j) {
+          uint32_t pl = mode ? (uint32_t)packed[j].size() : n;
+          std::memcpy(out + 8 + 4 * j, &pl, 4);
+          if (mode) {
+            std::memcpy(out + pos, packed[j].data(), pl);
+          } else {
+            for (uint32_t i = 0; i < n; ++i) out[pos + i] = src[i * 4 + j];
+          }
+          pos += pl;
+        }
+        return (uint32_t)pos;
+      }
       default: return 0;
     }
   }
@@ -1116,7 +1304,7 @@ class Throttle {
     if (rate_ <= 0 || nbytes == 0) return;
     double wait = 0;
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<Mu> lk(mu_);
       auto now = std::chrono::steady_clock::now();
       tokens_ = std::min(
           burst_, tokens_ + rate_ * std::chrono::duration<double>(
@@ -1133,7 +1321,7 @@ class Throttle {
  private:
   double rate_ = 0;
   double burst_ = 0;
-  std::mutex mu_;
+  Mu mu_;
   double tokens_ = 0;
   std::chrono::steady_clock::time_point last_;
 };
@@ -1171,7 +1359,7 @@ class Chaos {
     if (delay_ms_ > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms_));
     if (drop_rate_ <= 0) return false;
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<Mu> lk(mu_);
     acc_ += drop_rate_;
     if (acc_ >= 1.0) {
       acc_ -= 1.0;
@@ -1195,7 +1383,7 @@ class Chaos {
   double drop_rate_ = 0;
   long delay_ms_ = 0;
   long kill_rounds_ = 0;
-  std::mutex mu_;
+  Mu mu_;
   double acc_ = 0;
   long dropped_ = 0;
   std::atomic<long> rounds_{0};
@@ -1216,7 +1404,7 @@ struct Conn {
   ~Conn() {
     if (fd >= 0) ::close(fd);  // last ref (conn thread or parked pull) drops
   }
-  std::mutex write_mu;
+  Mu write_mu;
   // shm transport after a COMMITTED IPC upgrade; null = plain TCP
   std::unique_ptr<IpcChan> ipc;
   // mapped at IPC_HELLO, promoted to `ipc` only by the client's
@@ -1228,7 +1416,7 @@ struct Conn {
     // charge OUTSIDE write_mu: a sleeping throttle must not also block
     // the other engine threads replying on this connection
     if (thr) thr->charge(h.len);
-    std::lock_guard<std::mutex> lk(write_mu);
+    std::lock_guard<Mu> lk(write_mu);
     if (ipc) return ipc->send_msg(h, payload);
     return send_msg_iov(fd, h, payload);
   }
@@ -1246,7 +1434,7 @@ struct ParkedPull {
 };
 
 struct KeyStore {
-  std::mutex mu;                 // per-key lock: sums/copies of different
+  Mu mu;                 // per-key lock: sums/copies of different
                                  // keys must not serialize each other
   std::vector<uint8_t> accum;    // receiving buffer for the current round
   std::vector<uint8_t> merged;   // async-mode authoritative weights
@@ -1281,6 +1469,12 @@ struct KeyStore {
   std::atomic<uint64_t> total_pushes{0};  // for priority scheduling
   // compression mirror (server.cc:92-118): set by COMP_INIT
   CompressorCfg comp;
+  // Codec tag latched by the current round's FIRST fold (MsgHeader::
+  // codec; 0 = round opened untagged). A later fold of the same round
+  // carrying a different tag — codec id OR plan epoch — is rejected
+  // loudly instead of summed: the adaptive plane's aggregation-safety
+  // net. Reset at every ALL_RECV / rollback / re-init.
+  uint32_t round_codec = 0;
   std::vector<int32_t> round_idx;     // randomk: this round's indices
   std::vector<float> scratch;         // decompress buffer
   // randomk homomorphic fast path: the round's aggregate in WIRE form
@@ -1305,6 +1499,7 @@ struct EngineMsg {
   uint32_t rid;
   uint16_t sender;
   uint64_t epoch = 0;            // (round << 16) | attempt; 0 = unstamped
+  uint32_t codec = 0;            // (plan_epoch << 8) | codec id; 0 = untagged
   std::vector<uint8_t> payload;  // push data
   std::shared_ptr<Conn> conn;
 };
@@ -1315,14 +1510,14 @@ class EngineQueue {
 
   void push(EngineMsg&& m, uint64_t prio) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<Mu> lk(mu_);
       q_.push({prio, seq_++, std::move(m)});
     }
     cv_.notify_one();
   }
 
   bool wait_pop(EngineMsg* out) {
-    std::unique_lock<std::mutex> lk(mu_);
+    std::unique_lock<Mu> lk(mu_);
     cv_.wait(lk, [&] { return stop_ || !q_.empty(); });
     if (q_.empty()) return false;
     // const_cast is safe: we pop immediately after moving
@@ -1333,7 +1528,7 @@ class EngineQueue {
 
   void stop() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<Mu> lk(mu_);
       stop_ = true;
     }
     cv_.notify_all();
@@ -1350,8 +1545,8 @@ class EngineQueue {
     }
   };
   bool priority_;
-  std::mutex mu_;
-  std::condition_variable cv_;
+  Mu mu_;
+  Cv cv_;
   std::priority_queue<Item> q_;
   uint64_t seq_ = 0;
   bool stop_ = false;
@@ -1408,12 +1603,12 @@ class Server {
       // its decrement (the Server may be destroyed right after Join()).
       auto trk = conn_tracker_;
       {
-        std::lock_guard<std::mutex> lk(trk->mu);
+        std::lock_guard<Mu> lk(trk->mu);
         trk->live++;
       }
       std::thread([this, conn, trk] {
         ConnLoop(conn);
-        std::lock_guard<std::mutex> lk(trk->mu);
+        std::lock_guard<Mu> lk(trk->mu);
         trk->live--;
         trk->cv.notify_all();
       }).detach();
@@ -1426,7 +1621,7 @@ class Server {
     for (auto& q : queues_) q->stop();
     for (auto& t : engine_threads_)
       if (t.joinable()) t.join();
-    std::unique_lock<std::mutex> lk(conn_tracker_->mu);
+    std::unique_lock<Mu> lk(conn_tracker_->mu);
     conn_tracker_->cv.wait(lk, [this] { return conn_tracker_->live == 0; });
   }
 
@@ -1434,7 +1629,7 @@ class Server {
   int ThreadForKey(uint64_t key, uint32_t len) {
     // assign new keys to the least-loaded engine by accumulated bytes
     // (reference: server.h:154-178)
-    std::lock_guard<std::mutex> lk(assign_mu_);
+    std::lock_guard<Mu> lk(assign_mu_);
     auto it = key_thread_.find(key);
     if (it != key_thread_.end()) return it->second;
     int best = 0;
@@ -1454,7 +1649,7 @@ class Server {
       }
       if (conn->sender.load() < 0) {
         conn->sender.store((int)h.sender);
-        std::lock_guard<std::mutex> lk(worker_conns_mu_);
+        std::lock_guard<Mu> lk(worker_conns_mu_);
         worker_conns_[(int)h.sender]++;
         // a reconnect (elastic resume) clears the clean-exit mark; stale
         // messages from before the death are fenced by their own (dead)
@@ -1467,6 +1662,7 @@ class Server {
       m.rid = h.rid;
       m.sender = h.sender;
       m.epoch = h.epoch;
+      m.codec = h.codec;
       m.conn = conn;
       uint32_t req, dtype;
       decode_cmd(h.cmd, &req, &dtype);
@@ -1487,7 +1683,7 @@ class Server {
         // so a late ACK cannot split the transport (client on TCP,
         // server on shm). write_mu: engine threads read `ipc` in
         // send_msg.
-        std::lock_guard<std::mutex> lk(conn->write_mu);
+        std::lock_guard<Mu> lk(conn->write_mu);
         if (conn->ipc_pending) conn->ipc = std::move(conn->ipc_pending);
         continue;
       }
@@ -1508,7 +1704,7 @@ class Server {
       }
       uint64_t prio = 0;
       if (schedule_) {
-        std::lock_guard<std::mutex> lk(stores_mu_);
+        std::lock_guard<Mu> lk(stores_mu_);
         auto it = stores_.find(h.key);
         // fewer completed pushes -> earlier (queue.h:31-105)
         prio = it == stores_.end()
@@ -1529,7 +1725,7 @@ class Server {
     if (snd >= 0) {
       bool departed = false;
       {
-        std::lock_guard<std::mutex> lk(worker_conns_mu_);
+        std::lock_guard<Mu> lk(worker_conns_mu_);
         if (--worker_conns_[snd] == 0) {
           worker_conns_.erase(snd);
           // a worker that announced SHUTDOWN is exiting cleanly: its
@@ -1547,10 +1743,10 @@ class Server {
                  "closed); failing parked requests\n", sender);
     std::vector<ParkedPull> victims;
     {
-      std::lock_guard<std::mutex> lk(stores_mu_);
+      std::lock_guard<Mu> lk(stores_mu_);
       for (auto& [key, ks] : stores_) {
         (void)key;
-        std::lock_guard<std::mutex> lk2(ks.mu);
+        std::lock_guard<Mu> lk2(ks.mu);
         for (auto& p : ks.parked_pulls) victims.push_back(p);
         for (auto& p : ks.parked_inits) victims.push_back(p);
         ks.parked_pulls.clear();
@@ -1562,6 +1758,7 @@ class Server {
         // when they retry after elastic resume.
         ks.init_count = 0;
         ks.recv_count = 0;
+        ks.round_codec = 0;
         ks.wire_accum.clear();  // drop a half-summed randomk wire round
         if (ks.pull_abort.size() != ks.worker_push_count.size())
           ks.pull_abort.assign(ks.worker_push_count.size(), 0);
@@ -1582,7 +1779,7 @@ class Server {
       }
     }
     {
-      std::lock_guard<std::mutex> lk(barrier_mu_);
+      std::lock_guard<Mu> lk(barrier_mu_);
       for (auto& p : barrier_waiters_) victims.push_back(p);
       barrier_waiters_.clear();
     }
@@ -1641,7 +1838,7 @@ class Server {
   void HandleBarrier(EngineMsg&& m) {
     std::vector<ParkedPull> release;
     {
-      std::lock_guard<std::mutex> lk(barrier_mu_);
+      std::lock_guard<Mu> lk(barrier_mu_);
       barrier_waiters_.push_back({m.conn, m.rid, m.sender});
       // release on DISTINCT workers, not message count: a worker whose
       // threads barrier concurrently sends duplicates, and counting
@@ -1662,7 +1859,7 @@ class Server {
     {
       // clean exit: the stripe conns of this worker will close right
       // after the ACK; that must not read as a failure
-      std::lock_guard<std::mutex> lk(worker_conns_mu_);
+      std::lock_guard<Mu> lk(worker_conns_mu_);
       clean_exit_.insert((int)m.sender);
     }
     MsgHeader r{kMagic, ACK, 0, 0, m.rid, 0, 0, 0};
@@ -1708,7 +1905,7 @@ class Server {
 
   KeyStore& store_of(uint64_t key) {
     // unordered_map guarantees reference stability across rehash
-    std::lock_guard<std::mutex> lk(stores_mu_);
+    std::lock_guard<Mu> lk(stores_mu_);
     return stores_[key];
   }
 
@@ -1730,6 +1927,51 @@ class Server {
                  (unsigned long long)m.key, (unsigned)m.sender,
                  (unsigned long long)rnd,
                  (unsigned long long)(m.epoch & 0xFFFF));
+    return true;
+  }
+
+  // Codec-tag gate (call under ks.mu, after IsReplay, before folding):
+  // a tagged push must match (a) the store's ACTIVE codec — a dense
+  // payload summed into a compressed accumulator (or vice versa) is
+  // silent corruption — and (b) the tag that OPENED this round, codec
+  // id and plan epoch alike, so cross-worker adaptive-plan skew fails
+  // the fold loudly instead of mis-summing. Untagged pushes (codec=0,
+  // static configs / legacy callers) skip validation entirely.
+  bool CodecTagOk(KeyStore& ks, const EngineMsg& m) {
+    if (m.codec == 0) return true;
+    uint8_t id = (uint8_t)(m.codec & 0xFF);
+    uint8_t want = kCodecDense;
+    switch (ks.comp.type) {
+      case CompressorCfg::ONEBIT: want = kCodecOnebit; break;
+      case CompressorCfg::TOPK: want = kCodecTopk; break;
+      case CompressorCfg::RANDOMK: want = kCodecRandomk; break;
+      case CompressorCfg::DITHERING: want = kCodecDithering; break;
+      case CompressorCfg::LOSSLESS: want = kCodecLossless; break;
+      default: break;
+    }
+    if (id != want) {
+      std::fprintf(stderr,
+                   "[bps-server] codec tag mismatch key=%llu sender=%u: "
+                   "push tagged codec=%u but the store's active codec is "
+                   "%u — refusing to fold (plan skew / missing "
+                   "COMP_INIT)\n",
+                   (unsigned long long)m.key, (unsigned)m.sender,
+                   (unsigned)id, (unsigned)want);
+      return false;
+    }
+    if (!async_) {
+      if (ks.recv_count == 0) {
+        ks.round_codec = m.codec;
+      } else if (ks.round_codec != 0 && m.codec != ks.round_codec) {
+        std::fprintf(stderr,
+                     "[bps-server] codec tag mismatch key=%llu sender=%u: "
+                     "round opened with tag 0x%x, this push carries 0x%x "
+                     "(worker codec plans disagree) — refusing to fold\n",
+                     (unsigned long long)m.key, (unsigned)m.sender,
+                     ks.round_codec, m.codec);
+        return false;
+      }
+    }
     return true;
   }
 
@@ -1761,7 +2003,7 @@ class Server {
     std::vector<ParkedPull> stale;  // parked under the OLD length: error out
     {
       KeyStore& ks = store_of(m.key);
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
@@ -1793,6 +2035,7 @@ class Server {
         ks.pull_abort.assign(num_workers_, 0);
         ks.last_round.assign(num_workers_, 0);
         ks.recv_count = 0;
+        ks.round_codec = 0;
         ks.completed_rounds = 0;
         // a resize invalidates any compressor (stale n): workers must
         // re-send COMP_INIT for the new length
@@ -1843,7 +2086,7 @@ class Server {
     KeyStore& ks = store_of(m.key);
     bool ok = false;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
@@ -1868,19 +2111,29 @@ class Server {
           // scatter writes); drop it and restart the round count
           ks.wire_accum.clear();
           ks.recv_count = 0;
+          ks.round_codec = 0;
           // the dense ALL_RECV publishes by MOVING accum out; a key that
           // ran dense rounds before COMP_INIT arrives here with an empty
           // accum, and the compressed first-recv memcpys into it — make
           // sure it is full-size again
           if (ks.accum.size() != ks.len) ks.accum.assign(ks.len, 0);
-          // publish a compressed view of the current aggregate so a pull
-          // that precedes the first compressed round is answerable
-          auto w = std::make_shared<std::vector<uint8_t>>(cfg.WireLen());
-          uint32_t wl = ks.comp.Compress((const float*)ks.pub->data(),
-                                         w->data(), ks.completed_rounds,
-                                         ks.round_idx);
-          w->resize(wl);  // varint wires are variable-length
-          ks.pub_wire = std::move(w);
+          if (cfg.type == CompressorCfg::NONE) {
+            // explicit codec CLEAR (compressor=none): the adaptive
+            // plane de-escalated this key to dense — drop the
+            // compressed published view so a stale wire can never
+            // answer a later compressed pull as if it were current
+            ks.pub_wire.reset();
+          } else {
+            // publish a compressed view of the current aggregate so a
+            // pull that precedes the first compressed round is
+            // answerable
+            auto w = std::make_shared<std::vector<uint8_t>>(cfg.WireLen());
+            uint32_t wl = ks.comp.Compress((const float*)ks.pub->data(),
+                                           w->data(), ks.completed_rounds,
+                                           ks.round_idx);
+            w->resize(wl);  // varint wires are variable-length
+            ks.pub_wire = std::move(w);
+          }
         }
       }
     }
@@ -1949,7 +2202,7 @@ class Server {
   void FusedReply(KeyStore& ks, EngineMsg& m, bool compressed) {
     bool ready;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       ready = PullReady(ks, m.sender);
       if (!ready)
         ks.parked_pulls.push_back({m.conn, m.rid, m.sender, compressed});
@@ -1960,13 +2213,18 @@ class Server {
   void DoPushCompressed(EngineMsg& m, KeyStore& ks, bool fused) {
     std::vector<ParkedPull> flush;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
         return;
       }
       if (IsReplay(ks, m)) goto ack;  // fold at most once per round
+      if (!CodecTagOk(ks, m)) {
+        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        m.conn->send_msg(r, nullptr);
+        return;
+      }
       if (ks.comp.type == CompressorCfg::RANDOMK &&
           m.payload.size() == ks.comp.WireLen()) {
         // bounds-check indices, then try the O(k) wire-form aggregation
@@ -2005,6 +2263,7 @@ class Server {
             ks.pub = std::move(d);
             ks.pub_wire = std::move(w);
             ks.recv_count = 0;
+            ks.round_codec = 0;
             ks.completed_rounds++;
             chaos_.round_completed();
             flush.swap(ks.parked_pulls);
@@ -2050,6 +2309,8 @@ class Server {
               std::move(m.payload));
           ks.pub = std::move(d);
           ks.pub_wire = std::move(w);
+          ks.round_codec = 0;  // round completed without recv_count ever
+                               // incrementing (single-worker publish)
           ks.completed_rounds++;
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
@@ -2116,6 +2377,7 @@ class Server {
         ks.pub = std::move(d);
         ks.pub_wire = std::move(w);
         ks.recv_count = 0;
+        ks.round_codec = 0;
         ks.completed_rounds++;
         chaos_.round_completed();
         flush.swap(ks.parked_pulls);
@@ -2143,13 +2405,14 @@ class Server {
     std::vector<ParkedPull> flush;
     bool ok = false;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       do {
         if (m.conn->dead.load()) break;  // fenced: see Conn::dead
         if (IsReplay(ks, m)) {
           ok = true;  // already folded: answer, don't double-count
           break;
         }
+        if (!CodecTagOk(ks, m)) break;  // rowsparse rides the dense mode
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
         if (m.payload.size() < 8) break;
@@ -2204,6 +2467,7 @@ class Server {
           DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
           ks.pub = std::move(d);
           ks.recv_count = 0;
+          ks.round_codec = 0;
           ks.completed_rounds++;
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
@@ -2233,7 +2497,7 @@ class Server {
       return;
     }
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       bool has_comp = ks.comp.type != CompressorCfg::NONE;
       bool is_comp = m.req == kCompressedPushPull;
       if (has_comp != is_comp) {
@@ -2253,7 +2517,7 @@ class Server {
       return;
     }
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
@@ -2273,6 +2537,11 @@ class Server {
         return;
       }
       if (!IsReplay(ks, m)) {
+        if (!CodecTagOk(ks, m)) {
+          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          m.conn->send_msg(r, nullptr);
+          return;
+        }
         ks.total_pushes++;
         if (m.sender < ks.worker_push_count.size())
           ks.worker_push_count[m.sender]++;
@@ -2310,6 +2579,7 @@ class Server {
             DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
             ks.pub = std::move(d);
             ks.recv_count = 0;
+            ks.round_codec = 0;
             ks.completed_rounds++;
             chaos_.round_completed();
             flush.swap(ks.parked_pulls);
@@ -2352,7 +2622,7 @@ class Server {
       // key lock so the send reads a consistent weight vector
       std::vector<uint8_t> snapshot;
       {
-        std::lock_guard<std::mutex> lk(ks.mu);
+        std::lock_guard<Mu> lk(ks.mu);
         snapshot = ks.merged;
       }
       MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
@@ -2367,7 +2637,7 @@ class Server {
     // response buffers, server.cc:39-80)
     std::shared_ptr<const std::vector<uint8_t>> snap;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       snap = p.compressed ? ks.pub_wire : ks.pub;
     }
     if (!snap) {  // defensive: pull answered before any init
@@ -2386,7 +2656,7 @@ class Server {
     bool uninit = false;
     bool comp = m.req == kCompressedPushPull;
     {
-      std::lock_guard<std::mutex> lk(ks.mu);
+      std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
         MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
         m.conn->send_msg(r, nullptr);
@@ -2448,22 +2718,22 @@ class Server {
   std::vector<std::thread> engine_threads_;
   std::vector<uint64_t> engine_bytes_;
   std::unordered_map<uint64_t, int> key_thread_;
-  std::mutex assign_mu_;
+  Mu assign_mu_;
 
   std::unordered_map<uint64_t, KeyStore> stores_;
-  std::mutex stores_mu_;  // guards only the map itself; data ops take the
+  Mu stores_mu_;  // guards only the map itself; data ops take the
                           // per-key KeyStore::mu (finer than the
                           // reference's single handle_mu_, server.cc:208)
 
   struct ConnTracker {
-    std::mutex mu;
-    std::condition_variable cv;
+    Mu mu;
+    Cv cv;
     int live = 0;
   };
   std::shared_ptr<ConnTracker> conn_tracker_ =
       std::make_shared<ConnTracker>();
 
-  std::mutex barrier_mu_;
+  Mu barrier_mu_;
   std::vector<ParkedPull> barrier_waiters_;
 
   // failure detection: live connection count per worker id, workers
@@ -2471,7 +2741,7 @@ class Server {
   // a stale push landing in a re-armed round would corrupt it), and
   // workers that announced a clean SHUTDOWN (their conn closures are
   // graceful, not failures)
-  std::mutex worker_conns_mu_;
+  Mu worker_conns_mu_;
   std::unordered_map<int, int> worker_conns_;
   std::unordered_set<int> clean_exit_;
 };
@@ -2498,7 +2768,7 @@ class CompletionQueue {
  public:
   void push(const CompletionRec& r) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<Mu> lk(mu_);
       if (closed_) return;  // teardown: nobody will read it
       q_.push_back(r);
     }
@@ -2508,9 +2778,9 @@ class CompletionQueue {
   // Blocks up to timeout_ms for >=1 record; returns the batch size,
   // 0 on timeout, -1 once closed AND drained (reactor exit signal).
   int pop_batch(CompletionRec* out, int max_n, int timeout_ms) {
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                 [&] { return closed_ || !q_.empty(); });
+    std::unique_lock<Mu> lk(mu_);
+    cv_.wait_for_ms(lk, timeout_ms,
+                    [&] { return closed_ || !q_.empty(); });
     if (q_.empty()) return closed_ ? -1 : 0;
     int n = 0;
     while (n < max_n && !q_.empty()) {
@@ -2521,28 +2791,50 @@ class CompletionQueue {
   }
 
   int depth() {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<Mu> lk(mu_);
     return (int)q_.size();
   }
 
   void close() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<Mu> lk(mu_);
       closed_ = true;
     }
     cv_.notify_all();
   }
 
  private:
-  std::mutex mu_;
-  std::condition_variable cv_;
+  Mu mu_;
+  Cv cv_;
   std::deque<CompletionRec> q_;
   bool closed_ = false;
 };
 
 struct Waiter {
-  std::mutex mu;
-  std::condition_variable cv;
+  // Raw pthread primitives with EXPLICIT init/destroy — not std::mutex.
+  // glibc's Mu is zero-initialized and never calls
+  // pthread_mutex_init, so TSAN cannot distinguish a fresh mutex from
+  // whatever previously lived at the same heap address: once any
+  // destroyed lock (a reaped CPython Future's condition, say) occupied
+  // the block, every later Waiter there reports "double lock of a
+  // destroyed mutex" (the PR-6 finding's second half; the first half —
+  // mid-life Waiter churn — is fixed by the conn's Waiter pool). The
+  // explicit pthread_mutex_init/cond_init are TSAN-intercepted and
+  // reset the sync-object state at construction.
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  Waiter() {
+    pthread_mutex_init(&mu, nullptr);
+    pthread_condattr_t a;
+    pthread_condattr_init(&a);
+    pthread_condattr_setclock(&a, CLOCK_MONOTONIC);
+    pthread_cond_init(&cv, &a);
+    pthread_condattr_destroy(&a);
+  }
+  ~Waiter() {
+    pthread_mutex_destroy(&mu);
+    pthread_cond_destroy(&cv);
+  }
   bool done = false;
   void* out = nullptr;
   uint32_t out_len = 0;
@@ -2559,6 +2851,23 @@ struct Waiter {
   uint64_t ticket = 0;
   std::chrono::steady_clock::time_point sent_at;
 };
+
+// Wait until w->done or `timeout_s` elapses (<=0 = infinite); caller
+// holds w->mu. Returns the done flag (false = timed out).
+static bool waiter_wait_done(Waiter* w, long timeout_s) {
+  if (timeout_s <= 0) {
+    while (!w->done) pthread_cond_wait(&w->cv, &w->mu);
+    return true;
+  }
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  ts.tv_sec += timeout_s;
+  while (!w->done) {
+    if (pthread_cond_timedwait(&w->cv, &w->mu, &ts) == ETIMEDOUT)
+      return w->done;
+  }
+  return true;
+}
 
 class ServerConn {
  public:
@@ -2626,6 +2935,55 @@ class ServerConn {
     }
   }
 
+  // ---- Waiter pool ---------------------------------------------------
+  // Waiters are RECYCLED through a per-conn free list, never freed while
+  // the connection lives. Heap-churning them was the PR-6 TSAN finding
+  // ("double lock of a destroyed Waiter mutex", tests/test_sanitize.py):
+  // a completed Waiter's block is freed the instant the last shared_ptr
+  // drops, the allocator hands the same address to the next request's
+  // make_shared, and the new Mu at that address begins life with
+  // no init call (glibc's Mu is zero-initialized) while a
+  // straggling notify_one from the previous occupant may still be in
+  // flight on the old cv. Pooling keeps every mutex/cv alive for the
+  // conn's lifetime, so the worst case is a benign spurious wakeup that
+  // the wait predicates absorb — and the per-request allocation on the
+  // wire hot path disappears with it. Pool size is bounded by peak
+  // request concurrency (scheduling credit / pool threads).
+  std::shared_ptr<Waiter> AcquireWaiter() {
+    std::shared_ptr<Waiter> w;
+    {
+      std::lock_guard<Mu> lk(waiters_mu_);
+      if (!waiter_pool_.empty()) {
+        w = std::move(waiter_pool_.back());
+        waiter_pool_.pop_back();
+      }
+    }
+    if (!w) w = std::make_shared<Waiter>();
+    // reset under w->mu: orders the re-arm after any straggler from the
+    // previous occupancy (a late notify / final predicate read)
+    pthread_mutex_lock(&w->mu);
+    w->done = false;
+    w->out = nullptr;
+    w->out_len = 0;
+    w->got_len = 0;
+    w->ok = true;
+    w->detached = false;
+    w->fused = false;
+    w->ticket = 0;
+    pthread_mutex_unlock(&w->mu);
+    return w;
+  }
+
+  // Return a waiter whose operation FULLY completed (its rid is out of
+  // waiters_ and exactly one thread — the completer — calls this). Never
+  // called on conn-death paths: those waiters just stay alive in the
+  // Python-side refs until teardown, which is fine — the pool exists to
+  // prevent mid-life address reuse, not to reclaim a dying conn.
+  void RecycleWaiter(std::shared_ptr<Waiter> w) {
+    std::lock_guard<Mu> lk(waiters_mu_);
+    waiter_pool_.push_back(std::move(w));
+  }
+
   // fire-and-forget request (async push): sends and returns immediately.
   // The reply is drained by RecvLoop (detached waiter); an error reply
   // poisons the conn. Per-key ordering with the paired pull comes from
@@ -2635,13 +2993,16 @@ class ServerConn {
   // only synchronization (the reference's ps-lite ZPush is equally
   // async, its callback firing off the van thread).
   bool RequestAsync(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
-                    const void* data, uint32_t len, uint64_t epoch = 0) {
+                    const void* data, uint32_t len, uint64_t epoch = 0,
+                    uint32_t codec = 0) {
     if (sticky_err_.load()) return false;
-    auto w = std::make_shared<Waiter>();
+    auto w = AcquireWaiter();
+    pthread_mutex_lock(&w->mu);
     w->detached = true;
+    pthread_mutex_unlock(&w->mu);
     uint32_t rid = next_rid_.fetch_add(1);
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       // re-check under the sweep's mutex: a poison landing between the
       // entry check and this insert has already run the fail-all sweep,
       // so a waiter registered now would never be completed. sticky is
@@ -2651,13 +3012,20 @@ class ServerConn {
       if (sticky_err_.load()) return false;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch};
-    std::lock_guard<std::mutex> lk(send_mu_);
-    bool sent = chan_ ? chan_->send_msg(h, data)
-                      : send_msg_iov(fd_, h, data);
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch, codec};
+    bool sent;
+    {
+      std::lock_guard<Mu> lk(send_mu_);
+      sent = chan_ ? chan_->send_msg(h, data)
+                   : send_msg_iov(fd_, h, data);
+    }
     if (!sent) {
-      std::lock_guard<std::mutex> lk2(waiters_mu_);
-      waiters_.erase(rid);
+      bool ours;
+      {
+        std::lock_guard<Mu> lk2(waiters_mu_);
+        ours = waiters_.erase(rid) != 0;
+      }
+      if (ours) RecycleWaiter(std::move(w));
     }
     return sent;
   }
@@ -2670,37 +3038,46 @@ class ServerConn {
   bool RequestFused(uint64_t key, uint32_t cmd, uint16_t sender,
                     const void* data, uint32_t len, void* out,
                     uint32_t out_len, uint64_t ticket,
-                    uint64_t epoch = 0) {
+                    uint64_t epoch = 0, uint32_t codec = 0) {
     if (sticky_err_.load()) return false;
-    auto w = std::make_shared<Waiter>();
+    auto w = AcquireWaiter();
+    pthread_mutex_lock(&w->mu);
     w->fused = true;
     w->ticket = ticket;
     w->out = out;
     w->out_len = out_len;
     w->sent_at = std::chrono::steady_clock::now();
+    pthread_mutex_unlock(&w->mu);
     uint32_t rid = next_rid_.fetch_add(1);
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       // same re-check-under-lock as RequestAsync: a poison landing
       // between the entry check and this insert already ran the
       // fail-all sweep, which would never complete this waiter
       if (sticky_err_.load()) return false;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, PUSHPULL, 0, sender, rid, key, cmd, len, epoch};
-    std::lock_guard<std::mutex> lk(send_mu_);
-    bool sent = chan_ ? chan_->send_msg(h, data)
-                      : send_msg_iov(fd_, h, data);
+    MsgHeader h{kMagic, PUSHPULL, 0, sender, rid, key, cmd, len, epoch,
+                codec};
+    bool sent;
+    {
+      std::lock_guard<Mu> lk(send_mu_);
+      sent = chan_ ? chan_->send_msg(h, data)
+                   : send_msg_iov(fd_, h, data);
+    }
     if (!sent) {
-      std::lock_guard<std::mutex> lk2(waiters_mu_);
-      if (waiters_.erase(rid) == 0) {
-        // the recv loop's fail-all sweep already claimed this waiter
-        // and pushed its failure record: report success here so the
-        // ticket fails through the completion queue ONCE — returning
-        // false too would double-fail the request (caller raise AND
-        // reactor callback)
-        return true;
+      {
+        std::lock_guard<Mu> lk2(waiters_mu_);
+        if (waiters_.erase(rid) == 0) {
+          // the recv loop's fail-all sweep already claimed this waiter
+          // and pushed its failure record: report success here so the
+          // ticket fails through the completion queue ONCE — returning
+          // false too would double-fail the request (caller raise AND
+          // reactor callback)
+          return true;
+        }
       }
+      RecycleWaiter(std::move(w));
     }
     return sent;
   }
@@ -2717,11 +3094,15 @@ class ServerConn {
                   std::chrono::seconds(timeout_s);
     std::vector<CompletionRec> expired;
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       for (auto it = waiters_.begin(); it != waiters_.end();) {
         auto& w = it->second;
         if (w->fused && w->sent_at < cutoff) {
           expired.push_back({w->ticket, -2, 0});
+          // claimed by this sweep (erased before the record is pushed,
+          // so a late reply drains as unknown-rid junk): the sweep is
+          // the completer — recycle straight back to the pool
+          waiter_pool_.push_back(std::move(it->second));
           it = waiters_.erase(it);
         } else {
           ++it;
@@ -2743,7 +3124,7 @@ class ServerConn {
   void AbortFused() {
     std::vector<CompletionRec> victims;
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       for (auto it = waiters_.begin(); it != waiters_.end();) {
         if (it->second->fused) {
           victims.push_back({it->second->ticket, -1, 0});
@@ -2766,14 +3147,17 @@ class ServerConn {
   // blocking request: returns got_len or ~0u on failure
   uint32_t Request(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
                    const void* data, uint32_t len, void* out,
-                   uint32_t out_len, uint64_t epoch = 0) {
+                   uint32_t out_len, uint64_t epoch = 0,
+                   uint32_t codec = 0) {
     if (sticky_err_.load()) return ~0u;
-    auto w = std::make_shared<Waiter>();
+    auto w = AcquireWaiter();
+    pthread_mutex_lock(&w->mu);
     w->out = out;
     w->out_len = out_len;
+    pthread_mutex_unlock(&w->mu);
     uint32_t rid = next_rid_.fetch_add(1);
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       // same re-check-under-lock as RequestAsync: close the window
       // between the entry check and the insert, where the fail-all
       // sweep may already have run (a stranded waiter here would block
@@ -2781,14 +3165,18 @@ class ServerConn {
       if (sticky_err_.load()) return ~0u;
       waiters_[rid] = w;
     }
-    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch};
+    MsgHeader h{kMagic, op, 0, sender, rid, key, cmd, len, epoch, codec};
     {
-      std::lock_guard<std::mutex> lk(send_mu_);
+      std::lock_guard<Mu> lk(send_mu_);
       bool sent = chan_ ? chan_->send_msg(h, data)
                         : send_msg_iov(fd_, h, data);
       if (!sent) {
-        std::lock_guard<std::mutex> lk2(waiters_mu_);
-        waiters_.erase(rid);
+        bool ours;
+        {
+          std::lock_guard<Mu> lk2(waiters_mu_);
+          ours = waiters_.erase(rid) != 0;
+        }
+        if (ours) RecycleWaiter(std::move(w));
         return ~0u;
       }
     }
@@ -2801,36 +3189,39 @@ class ServerConn {
       const char* e = ::getenv("BYTEPS_CLIENT_TIMEOUT_S");
       return e && *e ? std::atol(e) : 600L;
     }();
-    std::unique_lock<std::mutex> lk(w->mu);
-    bool done;
-    if (timeout_s > 0) {
-      done = w->cv.wait_for(lk, std::chrono::seconds(timeout_s),
-                            [&] { return w->done; });
-    } else {
-      w->cv.wait(lk, [&] { return w->done; });
-      done = true;
-    }
+    pthread_mutex_lock(&w->mu);
+    bool done = waiter_wait_done(w.get(), timeout_s);
     if (!done) {
       // abandon the request. Lock order: never take waiters_mu_ while
       // holding w->mu (RecvLoop takes them in the other order).
-      lk.unlock();
+      pthread_mutex_unlock(&w->mu);
       bool still_ours;
       {
-        std::lock_guard<std::mutex> lk2(waiters_mu_);
+        std::lock_guard<Mu> lk2(waiters_mu_);
         still_ours = waiters_.erase(rid) != 0;
       }
-      lk.lock();
+      pthread_mutex_lock(&w->mu);
       if (still_ours) {
         std::fprintf(stderr, "[bps-client] request timeout op=%u key=%llu "
                      "after %lds\n", op, (unsigned long long)key, timeout_s);
-        return ~0u;  // a late reply drains as unknown-rid junk
+        // a late reply drains as unknown-rid junk; this thread claimed
+        // the waiter by winning the erase, so it recycles it
+        pthread_mutex_unlock(&w->mu);
+        RecycleWaiter(std::move(w));
+        return ~0u;
       }
       // RecvLoop claimed the waiter concurrently: the reply is being
       // filled into `out` right now — must wait for done (imminent; a
       // dying connection also sets it via fail-all).
-      w->cv.wait(lk, [&] { return w->done; });
+      waiter_wait_done(w.get(), 0);
     }
-    return w->ok ? w->got_len : ~0u;
+    // the blocking path's completer is THIS thread: read the verdict,
+    // release the lock, recycle. RecvLoop's only later touch can be a
+    // straggling signal, which a pooled (never-destroyed) cv absorbs.
+    uint32_t rc = w->ok ? w->got_len : ~0u;
+    pthread_mutex_unlock(&w->mu);
+    RecycleWaiter(std::move(w));
+    return rc;
   }
 
  private:
@@ -2898,7 +3289,7 @@ class ServerConn {
     while (rx(&h, sizeof(h))) {
       std::shared_ptr<Waiter> w;
       {
-        std::lock_guard<std::mutex> lk(waiters_mu_);
+        std::lock_guard<Mu> lk(waiters_mu_);
         auto it = waiters_.find(h.rid);
         if (it != waiters_.end()) {
           w = it->second;
@@ -2935,6 +3326,7 @@ class ServerConn {
                      (ok && !server_err && !len_mismatch) ? 0 : -1,
                      h.len});
         if (!ok) break;  // transport died mid-payload: fail-all below
+        RecycleWaiter(std::move(w));  // record pushed: rid done for good
         continue;
       }
       if (w->detached) {
@@ -2949,15 +3341,15 @@ class ServerConn {
                        (unsigned long long)h.key);
           break;  // drop to the fail-all tail below
         }
+        RecycleWaiter(std::move(w));  // silent success: nobody else waits
         continue;
       }
-      {
-        std::lock_guard<std::mutex> lk(w->mu);
-        w->got_len = h.len;
-        w->ok = ok && !server_err && !len_mismatch;
-        w->done = true;
-      }
-      w->cv.notify_one();
+      pthread_mutex_lock(&w->mu);
+      w->got_len = h.len;
+      w->ok = ok && !server_err && !len_mismatch;
+      w->done = true;
+      pthread_mutex_unlock(&w->mu);
+      pthread_cond_signal(&w->cv);
       if (!ok) break;
     }
     // connection dead: poison first (nothing will ever read a reply off
@@ -2966,13 +3358,14 @@ class ServerConn {
     // the recv thread is gone), then fail all waiters
     sticky_err_.store(true);
     {
-      std::lock_guard<std::mutex> lk(waiters_mu_);
+      std::lock_guard<Mu> lk(waiters_mu_);
       for (auto& [rid, w] : waiters_) {
         if (w->fused) continue;  // reported via the cq below
-        std::lock_guard<std::mutex> lk2(w->mu);
+        pthread_mutex_lock(&w->mu);
         w->ok = false;
         w->done = true;
-        w->cv.notify_one();
+        pthread_mutex_unlock(&w->mu);
+        pthread_cond_signal(&w->cv);
       }
       for (auto& [rid, w] : waiters_) {
         if (w->fused && cq_) cq_->push({w->ticket, -1, 0});
@@ -2984,10 +3377,14 @@ class ServerConn {
   int fd_ = -1;
   std::unique_ptr<IpcChan> chan_;  // set before recv_thread_ spawns
   CompletionQueue* cq_ = nullptr;  // Client-owned; set before Connect
-  std::mutex send_mu_;
+  Mu send_mu_;
   std::thread recv_thread_;
-  std::mutex waiters_mu_;
+  Mu waiters_mu_;
   std::unordered_map<uint32_t, std::shared_ptr<Waiter>> waiters_;
+  // free list for the Waiter pool (see AcquireWaiter): recycled, never
+  // freed while the conn lives — the TSAN-verified fix for the
+  // destroyed-mutex address-reuse report
+  std::vector<std::shared_ptr<Waiter>> waiter_pool_;
   std::atomic<uint32_t> next_rid_{1};
   // set by a rejected detached (async) push: the conn is poisoned —
   // every later Request fails fast instead of wedging on a round the
@@ -3037,13 +3434,14 @@ class Client {
   }
 
   // fused PUSHPULL over the key-affine conn (same FIFO stream as the
-  // two-op push->pull pair, so server-side ordering is unchanged)
+  // two-op push->pull pair, so server-side ordering is unchanged).
+  // `codec`: adaptive-plan wire tag, 0 = untagged (MsgHeader::codec).
   int PushPull(int server, uint64_t key, const void* data, uint32_t len,
                uint32_t cmd, void* out, uint32_t out_len,
-               uint64_t ticket, uint64_t epoch) {
+               uint64_t ticket, uint64_t epoch, uint32_t codec = 0) {
     return pick(server, key)->RequestFused(key, cmd, worker_id_, data,
                                            len, out, out_len, ticket,
-                                           epoch)
+                                           epoch, codec)
                ? 0
                : -1;
   }
@@ -3107,9 +3505,10 @@ class Client {
   }
 
   int Push(int server, uint64_t key, const void* data, uint32_t len,
-           uint32_t cmd, uint64_t epoch) {
+           uint32_t cmd, uint64_t epoch, uint32_t codec = 0) {
     uint32_t r = pick(server, key)->Request(PUSH, key, cmd, worker_id_,
-                                            data, len, nullptr, 0, epoch);
+                                            data, len, nullptr, 0, epoch,
+                                            codec);
     return r == ~0u ? -1 : 0;
   }
 
@@ -3118,9 +3517,11 @@ class Client {
   // rides the same key-affine conn, so per-key push->pull FIFO holds
   // end-to-end (conn stream -> server per-key engine queue).
   int PushAsync(int server, uint64_t key, const void* data, uint32_t len,
-                uint32_t cmd, uint64_t epoch) {
+                uint32_t cmd, uint64_t epoch, uint32_t codec = 0) {
     return pick(server, key)->RequestAsync(PUSH, key, cmd, worker_id_,
-                                           data, len, epoch) ? 0 : -1;
+                                           data, len, epoch, codec)
+               ? 0
+               : -1;
   }
 
   int Pull(int server, uint64_t key, void* out, uint32_t out_len,
@@ -3252,15 +3653,21 @@ int bps_client_comp_init(void* c, int server, uint64_t key,
 // `epoch` = (round << 16) | attempt replay-dedup stamp (0 = unstamped;
 // see MsgHeader::epoch). A retried push carrying the same round as an
 // already-folded one is answered but never double-counted.
+// `codec` = (plan_epoch << 8) | codec-id adaptive-plan wire tag (0 =
+// untagged, no server-side validation; see MsgHeader::codec and
+// docs/compression.md).
 int bps_client_push(void* c, int server, uint64_t key, const void* data,
-                    uint32_t len, uint32_t cmd, uint64_t epoch) {
-  return ((bps::Client*)c)->Push(server, key, data, len, cmd, epoch);
+                    uint32_t len, uint32_t cmd, uint64_t epoch,
+                    uint32_t codec) {
+  return ((bps::Client*)c)->Push(server, key, data, len, cmd, epoch,
+                                 codec);
 }
 
 int bps_client_push_async(void* c, int server, uint64_t key,
                           const void* data, uint32_t len, uint32_t cmd,
-                          uint64_t epoch) {
-  return ((bps::Client*)c)->PushAsync(server, key, data, len, cmd, epoch);
+                          uint64_t epoch, uint32_t codec) {
+  return ((bps::Client*)c)->PushAsync(server, key, data, len, cmd, epoch,
+                                      codec);
 }
 
 int bps_client_pull(void* c, int server, uint64_t key, void* out,
@@ -3276,9 +3683,10 @@ int bps_client_pull(void* c, int server, uint64_t key, void* out,
 int bps_client_pushpull_async(void* c, int server, uint64_t key,
                               const void* data, uint32_t len, uint32_t cmd,
                               void* out, uint32_t out_len,
-                              uint64_t ticket, uint64_t epoch) {
+                              uint64_t ticket, uint64_t epoch,
+                              uint32_t codec) {
   return ((bps::Client*)c)->PushPull(server, key, data, len, cmd, out,
-                                     out_len, ticket, epoch);
+                                     out_len, ticket, epoch, codec);
 }
 
 // 1 when every striped connection to `server` is dead (transport EOF /

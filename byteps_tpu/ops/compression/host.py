@@ -422,8 +422,16 @@ def make_host_codec(kwargs: Dict[str, str], n: int):
     from . import parse_bool_kwarg
 
     name = kwargs.get("compressor")
-    if name == "onebit":
-        codec: HostCodec = HostOnebit(
+    if name == "lossless":
+        # byte-plane + entropy tier (lossless.py): bitwise round-trip,
+        # so EF/momentum stacking is pointless (the error is always 0)
+        # but harmless; the numpy+zlib tier IS the host implementation —
+        # the wire is self-describing, so no native bit-parity tier is
+        # needed (module-top contract does not apply)
+        from .lossless import HostLossless
+        codec: HostCodec = HostLossless(n=n)
+    elif name == "onebit":
+        codec = HostOnebit(
             n=n, scaled=parse_bool_kwarg(kwargs, "scaling", "true"))
     elif name == "topk":
         if parse_bool_kwarg(kwargs, "approx") and not _warned_approx:

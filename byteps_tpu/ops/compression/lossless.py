@@ -1,0 +1,164 @@
+"""Lossless byte-plane wire codec: exponent/mantissa plane split + zlib.
+
+The lossless tier of the adaptive codec ladder (core/codec_plane.py;
+ZipCCL, arxiv 2604.27844): a float tensor's bytes are transposed into
+per-byte *planes* — plane j holds byte j of every element — so the
+low-entropy sign/exponent bytes (which cluster tightly for gradients)
+sit contiguously and deflate far better than the interleaved stream,
+while the high-entropy mantissa-noise planes cost ~nothing extra. The
+entropy stage is zlib level 1: the stream is self-describing, so the
+three wire producers (this numpy tier, the C++ server mirror in
+native/ps.cc CompressorCfg LOSSLESS, and any future device tier) need
+only produce *decodable* bytes, not identical ones — unlike the lossy
+codecs there is no cross-implementation bit-parity constraint on the
+wire, only on the reconstruction, which is bitwise exact by
+construction (NaN payloads, -0.0, subnormals and inf round-trip
+untouched).
+
+Wire layout (little-endian, mirrored by ps.cc kLosslessHdr):
+
+    [u32 n_elems][u8 mode][u8 nplanes][u16 reserved]
+    [u32 plane_len[nplanes]][plane bytes ...]
+
+mode 1 = deflated planes; mode 0 = raw passthrough chosen when deflate
+does not pay, capping the wire at header + raw bytes — ``wire_bytes()``
+is therefore a hard allocation bound like the varint dithering wire.
+
+``plane_split``/``plane_join`` are dtype-agnostic (fp32 = 4 planes,
+bf16/f16 = 2) so the property suite proves the byte-plane transform on
+bf16 payloads directly; the PS wire tier (``HostLossless``) is f32 like
+every other host codec (the compressed PS path upcasts, host.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+
+import numpy as np
+
+# header: [u32 n][u8 mode][u8 nplanes][u16 rsvd] + u32 len per plane
+_HDR = struct.Struct("<IBBH")
+# zlib level 1: the tier trades one cheap entropy pass for wire bytes;
+# gradient sign/exponent planes compress well even at the fastest level
+# (ps.cc uses the same level for the server-side recompress)
+_LEVEL = 1
+
+
+def plane_split(raw: np.ndarray, itemsize: int) -> list:
+    """Byte planes of a flat uint8 buffer of ``itemsize``-wide elements:
+    plane j = byte j of every element, each C-contiguous."""
+    if raw.size % itemsize:
+        raise ValueError(f"buffer of {raw.size} bytes is not a whole "
+                         f"number of {itemsize}-byte elements")
+    mat = raw.reshape(-1, itemsize)
+    return [np.ascontiguousarray(mat[:, j]) for j in range(itemsize)]
+
+
+def plane_join(planes: list, itemsize: int) -> np.ndarray:
+    """Inverse of :func:`plane_split`: re-interleave planes into the
+    element byte stream (uint8)."""
+    n = len(planes[0])
+    out = np.empty((n, itemsize), np.uint8)
+    for j, p in enumerate(planes):
+        out[:, j] = p
+    return out.reshape(-1)
+
+
+def encode_planes(raw: np.ndarray, itemsize: int) -> bytes:
+    """One buffer -> the self-describing byte-plane wire (see module
+    docstring). ``raw``: flat uint8 view of the element bytes."""
+    planes = plane_split(np.ascontiguousarray(raw, np.uint8), itemsize)
+    n = len(planes[0]) if planes else 0
+    packed = [zlib.compress(p.tobytes(), _LEVEL) for p in planes]
+    mode = 1 if sum(len(b) for b in packed) < raw.size else 0
+    if mode == 0:
+        packed = [p.tobytes() for p in planes]
+    head = _HDR.pack(n, mode, itemsize, 0)
+    lens = struct.pack(f"<{itemsize}I", *[len(b) for b in packed])
+    return head + lens + b"".join(packed)
+
+
+def decode_planes(buf, itemsize: int) -> np.ndarray:
+    """Wire -> flat uint8 element bytes; validates the header hard
+    (wire parsers face untrusted input)."""
+    buf = bytes(buf)
+    if len(buf) < _HDR.size:
+        raise ValueError("lossless wire: truncated header")
+    n, mode, nplanes, _rsvd = _HDR.unpack_from(buf)
+    if nplanes != itemsize or mode > 1:
+        raise ValueError(
+            f"lossless wire: bad header (mode={mode} nplanes={nplanes}, "
+            f"expected {itemsize} planes)")
+    off = _HDR.size + 4 * nplanes
+    if len(buf) < off:
+        raise ValueError("lossless wire: truncated plane table")
+    lens = struct.unpack_from(f"<{nplanes}I", buf, _HDR.size)
+    if off + sum(lens) != len(buf):
+        raise ValueError("lossless wire: plane lengths disagree with "
+                         "payload size")
+    planes = []
+    for ln in lens:
+        chunk = buf[off:off + ln]
+        if mode:
+            chunk = zlib.decompress(chunk)
+        if len(chunk) != n:
+            raise ValueError("lossless wire: plane inflated to "
+                             f"{len(chunk)} bytes, expected {n}")
+        planes.append(np.frombuffer(chunk, np.uint8))
+        off += ln
+    if n == 0:
+        return np.zeros(0, np.uint8)
+    return plane_join(planes, itemsize)
+
+
+@dataclasses.dataclass
+class LosslessCodec:
+    """Bitwise round-trip codec over raw element bytes of any width —
+    the dtype-agnostic core (fp32 = 4 planes, bf16 = 2) used by the
+    property suite and by HostLossless below."""
+
+    itemsize: int = 4
+
+    def compress_bytes(self, raw: np.ndarray) -> bytes:
+        return encode_planes(raw, self.itemsize)
+
+    def decompress_bytes(self, buf) -> np.ndarray:
+        return decode_planes(buf, self.itemsize)
+
+
+class HostLossless:
+    """PS wire tier: the :class:`~.host.HostCodec` surface over f32
+    partitions (compress(x, step) -> bytes; decompress(buf) -> f32[n]).
+    ``lossless = True`` marks tasks for the scheduler's
+    ``codec/lossless_bytes_*`` accounting."""
+
+    lossless = True
+
+    def __init__(self, n: int):
+        self.n = n
+        self._codec = LosslessCodec(itemsize=4)
+
+    def compress(self, x: np.ndarray, step: int = 0) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        if x.size != self.n:
+            raise ValueError(f"lossless codec sized for {self.n} elems, "
+                             f"got {x.size}")
+        return self._codec.compress_bytes(x.view(np.uint8).reshape(-1))
+
+    def decompress(self, buf) -> np.ndarray:
+        raw = self._codec.decompress_bytes(buf)
+        out = raw.view(np.float32)
+        if out.size != self.n:
+            raise ValueError(f"lossless wire decoded {out.size} elems, "
+                             f"expected {self.n}")
+        return out
+
+    def wire_bytes(self) -> int:
+        # allocation BOUND (mode-0 raw passthrough worst case), exactly
+        # ps.cc's WireLen(): header + plane table + 4n raw bytes
+        return _HDR.size + 4 * 4 + 4 * self.n
+
+    def kwargs_wire(self) -> str:
+        return f"compressor=lossless;n={self.n}"

@@ -43,7 +43,10 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint32]
     # push ops carry a trailing (round << 16 | attempt) epoch stamp for
     # server-side replay dedup (idempotent retry; docs/fault-tolerance.md)
-    epoch_argtypes = lib.bps_client_init_key.argtypes + [ctypes.c_uint64]
+    # plus a (plan_epoch << 8 | codec_id) adaptive-codec wire tag the
+    # server validates per round (0 = untagged; docs/compression.md)
+    epoch_argtypes = lib.bps_client_init_key.argtypes + [
+        ctypes.c_uint64, ctypes.c_uint32]
     lib.bps_client_push.restype = ctypes.c_int
     lib.bps_client_push.argtypes = epoch_argtypes
     lib.bps_client_push_async.restype = ctypes.c_int
@@ -59,7 +62,7 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
-            ctypes.c_uint64]
+            ctypes.c_uint64, ctypes.c_uint32]
         lib.bps_client_cq_poll.restype = ctypes.c_int
         lib.bps_client_cq_poll.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
@@ -326,16 +329,20 @@ class PSClient:
             raise RuntimeError(f"init_key failed key={key}")
 
     def zpush(self, server: int, key: int, data: np.ndarray,
-              cmd: int, epoch: int = 0) -> None:
+              cmd: int, epoch: int = 0, codec: int = 0) -> None:
         """``epoch``: optional (round << 16 | attempt) replay-dedup stamp
         — the server folds a given (key, sender, round) at most once, so
         a retried push after a dropped reply never double-counts
-        (docs/fault-tolerance.md). 0 = unstamped (legacy semantics)."""
+        (docs/fault-tolerance.md). 0 = unstamped (legacy semantics).
+        ``codec``: optional (plan_epoch << 8 | codec_id) adaptive-codec
+        wire tag — the server latches the first fold's tag per round and
+        loudly rejects disagreeing folds (docs/compression.md). 0 =
+        untagged, no validation."""
         self._check_server(server)
         data = np.ascontiguousarray(data)  # .ctypes.data of a strided
         rc = self._lib.bps_client_push(   # view points at the base buffer
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
-            epoch)
+            epoch, codec)
         if self._m_push_req is not None:
             self._m_push_req.inc()
             self._m_push_bytes.inc(data.nbytes)
@@ -345,19 +352,20 @@ class PSClient:
             raise RuntimeError(f"push failed key={key}")
 
     def zpush_async(self, server: int, key: int, data: np.ndarray,
-                    cmd: int, epoch: int = 0) -> None:
+                    cmd: int, epoch: int = 0, codec: int = 0) -> None:
         """Fire-and-forget push: returns once the payload is on the wire
         (the native send copies it into the socket/ring, so ``data`` may
         be reused immediately). The ACK drains in the background; a
         server reject poisons the connection and surfaces on the paired
         zpull. Removes the ACK round-trip from the pipeline's critical
         path — the pull is the only synchronization, matching ps-lite's
-        asynchronous ZPush. ``epoch``: replay-dedup stamp (see zpush)."""
+        asynchronous ZPush. ``epoch``: replay-dedup stamp, ``codec``:
+        adaptive wire tag (see zpush)."""
         self._check_server(server)
         data = np.ascontiguousarray(data)
         rc = self._lib.bps_client_push_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
-            epoch)
+            epoch, codec)
         if self._m_push_req is not None:
             self._m_push_req.inc()
             self._m_push_bytes.inc(data.nbytes)
@@ -421,7 +429,7 @@ class PSClient:
     def zpushpull_async(self, server: int, key: int, data: np.ndarray,
                         out: np.ndarray, cmd: int,
                         on_done: Callable[[int, Optional[Exception]], None],
-                        epoch: int = 0) -> None:
+                        epoch: int = 0, codec: int = 0) -> None:
         """Fused push+pull in ONE wire round trip: push ``data``, and
         when the server's aggregation round completes, the aggregate
         lands in ``out`` and ``on_done(reply_len, error)`` runs on the
@@ -432,7 +440,8 @@ class PSClient:
         ``on_done`` fires (the registration table pins it). ``epoch``:
         replay-dedup stamp (see zpush) — a retried fused request with
         the same round is answered from the round's aggregate without
-        re-folding the payload."""
+        re-folding the payload. ``codec``: adaptive wire tag (see
+        zpush)."""
         self._check_server(server)
         if not out.flags["C_CONTIGUOUS"]:
             raise ValueError(
@@ -450,7 +459,7 @@ class PSClient:
         self._inflight_add(1)
         rc = self._lib.bps_client_pushpull_async(
             self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
-            out.ctypes.data, out.nbytes, ticket, epoch)
+            out.ctypes.data, out.nbytes, ticket, epoch, codec)
         if self._m_pushpull_req is not None:
             self._m_pushpull_req.inc()
             self._m_push_bytes.inc(data.nbytes)

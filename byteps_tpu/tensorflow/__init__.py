@@ -178,6 +178,7 @@ def _to_numpy(value) -> np.ndarray:
     return value.numpy() if hasattr(value, "numpy") else np.asarray(value)
 
 
+@tf.autograph.experimental.do_not_convert
 def push_pull_async(tensor, name: str, average: bool = True,
                     priority: Optional[int] = None) -> int:
     """Submit an async push_pull of an eager tensor/ndarray; returns an
@@ -203,6 +204,7 @@ def _push_pull_dense(host: np.ndarray, name: str, average: bool,
     return compression.decompress(out, cctx)
 
 
+@tf.autograph.experimental.do_not_convert
 def push_pull(tensor, scope: str = "", average: bool = True,
               name: Optional[str] = None, priority: Optional[int] = None,
               compression=Compression.none, sparse_as_dense: bool = False):
@@ -360,6 +362,14 @@ class _TapeWrapper:
     def __getattr__(self, item):
         return getattr(self._tape, item)
 
+    # do_not_convert: the reduce chain below is pure HOST python (numpy
+    # transport, scheduler handles, py_function nodes for graph-mode
+    # tensors) — AutoGraph gains nothing converting it, and letting it
+    # descend is fragile: whole-suite runs have seen it mis-convert the
+    # bound next_version() deep in the chain into a nullary call
+    # ("tf__next_version() missing 2 required positional arguments"),
+    # failing the trace. Pinning the boundary here stops the descent.
+    @tf.autograph.experimental.do_not_convert
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
         if size() <= 1:

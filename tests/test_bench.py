@@ -102,6 +102,16 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "churn_ab_clean_retries": 0,
                     "churn_ab_drop_rate": 0.25,
                     "churn_ab_idempotent_proof": True}, None
+        if name == "codec_adapt_ab":
+            return {"codec_adapt_throttled_switches": 2,
+                    "codec_adapt_unthrottled_switches": 0,
+                    "codec_adapt_wire_bytes": 100,
+                    "codec_dense_wire_bytes": 400,
+                    "codec_adapt_wire_reduction": 0.25,
+                    "codec_lossless_bytes_post": 12345,
+                    "codec_lossless_bitwise": True,
+                    "codec_tag_mismatch_rejected": True,
+                    "codec_adapt_proof": True}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -113,7 +123,13 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:3] == ["pushpull_throttled", "scaling", "churn_ab"]
+    assert cpu_calls[:4] == ["pushpull_throttled", "scaling", "churn_ab",
+                             "codec_adapt_ab"]
+    assert out["codec_adapt_proof"] is True
+    assert out["codec_adapt_throttled_switches"] == 2
+    assert out["codec_adapt_unthrottled_switches"] == 0
+    assert out["codec_lossless_bitwise"] is True
+    assert out["codec_tag_mismatch_rejected"] is True
     assert out["metrics_on_step_ms"] == 5.1
     assert out["metrics_overhead_pct"] == 2.0
     assert out["stream_on_step_ms"] == 4.0
@@ -173,6 +189,11 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"churn_ab_identical": True,
                     "churn_ab_chaos_retries": 5,
                     "churn_ab_clean_retries": 0}, None
+        if name == "codec_adapt_ab":
+            return {"codec_adapt_throttled_switches": 1,
+                    "codec_adapt_unthrottled_switches": 0,
+                    "codec_adapt_wire_reduction": 0.5,
+                    "codec_adapt_proof": True}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -188,12 +209,13 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 10 CPU phases + finals
-    assert calls.count("probe") == 11 + n_final
+    # start + one attempt after each of the 11 CPU phases + finals
+    assert calls.count("probe") == 12 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
-        "after_churn_ab", "after_pushpull", "after_pushpull_2srv",
+        "after_churn_ab", "after_codec_adapt_ab", "after_pushpull",
+        "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_stream_ab",
         "after_wire_ab", "after_shard_ab",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
@@ -315,7 +337,8 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
     skipped = {k: v for k, v in out["phase_errors"].items()
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
-                            "pushpull_throttled", "churn_ab", "arena_ab",
+                            "pushpull_throttled", "churn_ab",
+                            "codec_adapt_ab", "arena_ab",
                             "metrics_ab", "stream_ab", "wire_ab",
                             "shard_ab", "scaling"}
 

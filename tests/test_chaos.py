@@ -418,18 +418,32 @@ def test_server_churn_failover_numerics():
 
         rng = np.random.RandomState(11)
         grads = [rng.randn(2048).astype(np.float32) for _ in range(8)]
+        # host-compressed leaf riding the same churn (PR-6 limitation
+        # closed: COMP_INIT state used to die with the server and
+        # compressed keys failed over with a hard error; the retry path
+        # now re-installs the compressor on the adoptive server).
+        # lossless tier: failover numerics stay BITWISE comparable.
+        from byteps_tpu.server.compressed import CompressedRegistry
+        comp_reg = CompressedRegistry(state.ps_client, 1,
+                                      {"compressor": "lossless"})
+        cgrad = rng.randn(4096).astype(np.float32)
 
         def run_round(r):
             hs = [bps.push_pull_async(g * (r + 1), f"churn{i}",
                                       average=False)
                   for i, g in enumerate(grads)]
-            return [np.array(bps.synchronize(h, timeout=120)) for h in hs]
+            ch = comp_reg.push_pull_async(state, "churn_comp",
+                                          cgrad * (r + 1), average=False)
+            out = [np.array(bps.synchronize(h, timeout=120)) for h in hs]
+            cout = np.array(bps.synchronize(ch, timeout=120))
+            return out, cout
 
         # warm rounds: declare keys, init barrier, steady state
         for r in range(2):
-            res = run_round(r)
+            res, cres = run_round(r)
             for g, o in zip(grads, res):
                 np.testing.assert_array_equal(o, g * (r + 1))
+            np.testing.assert_array_equal(cres, cgrad * (r + 1))
 
         # pick a victim that actually owns keys, and confirm BOTH
         # servers hold some (otherwise the kill proves nothing)
@@ -439,20 +453,28 @@ def test_server_churn_failover_numerics():
         assert owners == {0, 1}, f"keys not spread: {owners}"
         victim = 1
 
-        # mid-round kill: submit first, SIGKILL while in flight
+        # mid-round kill: submit first (compressed leaf included),
+        # SIGKILL while in flight
         hs = [bps.push_pull_async(g * 3.0, f"churn{i}", average=False)
               for i, g in enumerate(grads)]
+        ch = comp_reg.push_pull_async(state, "churn_comp", cgrad * 3.0,
+                                      average=False)
         os.kill(procs[victim].pid, signal.SIGKILL)
         procs[victim].wait(timeout=10)
         for g, h in zip(grads, hs):
             np.testing.assert_array_equal(
                 np.array(bps.synchronize(h, timeout=120)), g * 3.0)
+        # the compressed leaf survives the death like the dense ones:
+        # its retry re-init-pushes AND re-COMP_INITs on the survivor
+        np.testing.assert_array_equal(
+            np.array(bps.synchronize(ch, timeout=120)), cgrad * 3.0)
 
         # training continues: later rounds all route to the survivor
         for r in range(3, 5):
-            res = run_round(r)
+            res, cres = run_round(r)
             for g, o in zip(grads, res):
                 np.testing.assert_array_equal(o, g * (r + 1))
+            np.testing.assert_array_equal(cres, cgrad * (r + 1))
 
         snap = bps.get_metrics()
         assert snap["counters"]["wire/server_failovers"] >= 1

@@ -1,0 +1,400 @@
+"""Adaptive codec control plane + lossless byte-plane wire tier.
+
+Three layers of evidence (docs/compression.md):
+
+- lossless codec property suite: BITWISE round-trip over fp32 (NaN
+  payloads, inf, subnormals, -0.0, odd lengths, empty) and bf16 byte
+  planes — "compressed" must not mean "lossy";
+- controller determinism: the ladder walker is a pure function of
+  (plan state, signal) — two instances fed identical round signals
+  emit identical plans, the invariant server-side folding relies on;
+- end-to-end plane behavior on the loopback PS: pinned-lossless rounds
+  bitwise-equal dense rounds, signal-driven escalation/de-escalation
+  re-installs the server codec only at quiescent boundaries, and a
+  mis-tagged fold is rejected loudly, never silently mis-summed.
+"""
+
+import contextlib
+import threading
+
+import numpy as np
+import pytest
+
+from byteps_tpu.config import Config
+from byteps_tpu.core.codec_plane import (
+    CodecController, CodecPlane, CodecPlan, RoundSignal, WIRE_CODEC_IDS,
+)
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.scheduler import HandleManager, PipelineScheduler
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.ops.compression.lossless import (
+    HostLossless, LosslessCodec, decode_planes, encode_planes,
+)
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+
+
+# --------------------------------------------------------------------- #
+# lossless codec property suite
+# --------------------------------------------------------------------- #
+
+
+def _nasty_f32(n: int, seed: int = 0) -> np.ndarray:
+    """fp32 payloads that break anything not bitwise: quiet/signaling
+    NaN bit patterns, +-inf, subnormals, -0.0, huge/tiny magnitudes."""
+    rng = np.random.RandomState(seed)
+    x = (rng.randn(n) * 10.0 ** rng.randint(-40, 38, n)).astype(np.float32)
+    specials = np.array([
+        np.float32(np.nan), np.float32(-np.nan), np.inf, -np.inf,
+        -0.0, 0.0, np.float32(1e-42), np.float32(-1e-42),
+        np.finfo(np.float32).max, np.finfo(np.float32).min,
+        np.finfo(np.float32).tiny,
+    ], np.float32)
+    for i, v in enumerate(specials):
+        if i < n:
+            x[i] = v
+    if n > len(specials):
+        # a non-canonical (signaling) NaN bit pattern must survive
+        # byte-for-byte — float round-trips through compute would
+        # quiet it, byte planes must not
+        x.view(np.uint32)[len(specials)] = 0x7F800001
+    return x
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 31, 255, 1000, 4096, 65537])
+def test_lossless_roundtrip_bitwise_fp32(n):
+    x = _nasty_f32(n, seed=n)
+    c = HostLossless(n)
+    wire = c.compress(x)
+    assert len(wire) <= c.wire_bytes(), "wire exceeded the declared bound"
+    back = c.decompress(np.frombuffer(wire, np.uint8))
+    assert back.tobytes() == x.tobytes()
+
+
+def test_lossless_roundtrip_bitwise_bf16():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    for n in (1, 5, 1000):
+        b = jnp.asarray(rng.randn(n) * 1e3, jnp.bfloat16)
+        raw = np.asarray(b).view(np.uint8).reshape(-1)
+        c = LosslessCodec(itemsize=2)
+        assert bytes(c.decompress_bytes(c.compress_bytes(raw))) \
+            == raw.tobytes()
+
+
+def test_lossless_empty_and_wire_validation():
+    c = LosslessCodec(itemsize=4)
+    empty = c.compress_bytes(np.zeros(0, np.uint8))
+    assert bytes(c.decompress_bytes(empty)) == b""
+    # truncated / corrupted wires must raise, not misparse
+    x = np.arange(64, dtype=np.float32)
+    wire = bytearray(HostLossless(64).compress(x))
+    with pytest.raises(ValueError):
+        decode_planes(bytes(wire[:10]), 4)
+    wire[5] = 3  # nplanes=3 on an fp32 wire
+    with pytest.raises(ValueError):
+        decode_planes(bytes(wire), 4)
+
+
+def test_lossless_compresses_low_entropy_planes():
+    # gradient-shaped data: tightly clustered exponents, noisy mantissa
+    # — the sign/exponent plane must shrink the wire below dense
+    rng = np.random.RandomState(2)
+    x = (rng.randn(65536) * 1e-3).astype(np.float32)
+    wire = HostLossless(65536).compress(x)
+    assert len(wire) < x.nbytes, "lossless tier failed to compress"
+    # incompressible worst case: the raw-passthrough mode caps the wire
+    noise = rng.randint(0, 2 ** 32, 4096, np.uint32).view(np.float32)
+    c = HostLossless(4096)
+    assert len(c.compress(noise)) <= c.wire_bytes()
+    assert c.decompress(np.frombuffer(c.compress(noise), np.uint8)
+                        ).tobytes() == noise.tobytes()
+
+
+def test_lossless_plane_transform_inverse():
+    raw = np.arange(48, dtype=np.uint8)
+    assert bytes(decode_planes(encode_planes(raw, 4), 4)) == raw.tobytes()
+    assert bytes(decode_planes(encode_planes(raw, 2), 2)) == raw.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# controller determinism + hysteresis
+# --------------------------------------------------------------------- #
+
+
+def _signals(pattern, ratio_hi=100.0, ratio_lo=0.1):
+    """PULL-bound ('P') / COMPUTE-bound ('C') signal sequence."""
+    out = []
+    for i, ch in enumerate(pattern):
+        pull = ratio_hi if ch == "P" else ratio_lo
+        out.append(RoundSignal(step=i + 1, compute_ms=1.0, pull_ms=pull))
+    return out
+
+
+def test_controller_hysteresis_ladder():
+    c = CodecController(up_rounds=3, down_rounds=5)
+    plan = CodecPlan()
+    tiers = [c.decide(plan, s) for s in _signals("PP")]
+    assert tiers == [None, None], "escalated before the streak filled"
+    assert c.decide(plan, _signals("P")[0]) == "lossless"
+    # streak resets after a switch: two more PULL-bound rounds hold
+    tiers = [c.decide(plan, s) for s in _signals("PP")]
+    assert tiers == [None, None]
+    assert c.decide(plan, _signals("P")[0]) == "onebit"
+    # at the top of the ladder: further pressure holds
+    assert all(c.decide(plan, s) is None for s in _signals("PPPP"))
+    # recovery: down_rounds consecutive COMPUTE-bound rounds per rung
+    tiers = [c.decide(plan, s) for s in _signals("CCCCC")]
+    assert tiers[:4] == [None] * 4 and tiers[4] == "lossless"
+    tiers = [c.decide(plan, s) for s in _signals("CCCCC")]
+    assert tiers[4] == "dense" and plan.rung == 0
+    # a PULL-bound blip resets the de-escalation streak
+    c.decide(plan, _signals("P")[0])
+    plan2 = CodecPlan(rung=1)
+    mixed = [c.decide(plan2, s) for s in _signals("CCCCPCCCC")]
+    assert all(t is None for t in mixed), "blip failed to reset streak"
+
+
+def test_controller_determinism_identical_signal_streams():
+    """The aggregation-safety invariant: two independent controllers
+    (two workers) fed the same round signals walk identical plans."""
+    sigs = _signals("PPPPPCCPPPCCCCCCCCCCPPPPPP")
+    a, b = (CodecController(up_rounds=2, down_rounds=4) for _ in range(2))
+    pa, pb = CodecPlan(), CodecPlan()
+    trace_a = [(a.decide(pa, s), pa.rung) for s in sigs]
+    trace_b = [(b.decide(pb, s), pb.rung) for s in sigs]
+    assert trace_a == trace_b
+    assert dataclass_tuple(pa) == dataclass_tuple(pb)
+
+
+def dataclass_tuple(p: CodecPlan):
+    return (p.rung, p.epoch, p.up_streak, p.down_streak, p.applied)
+
+
+def test_wire_codec_ids_are_stable():
+    # wire contract with native/ps.cc enum WireCodec — renumbering
+    # breaks rolling upgrades
+    assert WIRE_CODEC_IDS == {"dense": 1, "lossless": 2, "onebit": 3,
+                              "topk": 4, "randomk": 5, "dithering": 6}
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: plane + scheduler + loopback server
+# --------------------------------------------------------------------- #
+
+
+_PORT = [24310]
+
+
+@contextlib.contextmanager
+def _stack(monkeypatch=None, num_workers=1, **plane_env):
+    """Loopback server + client + scheduler + plane, manually wired the
+    way GlobalState.init does it."""
+    import os
+    port = _PORT[0]
+    _PORT[0] += 1
+    cfg = Config(num_workers=num_workers, num_servers=1)
+    t = threading.Thread(target=run_server, args=(port, cfg), daemon=True)
+    t.start()
+    client = PSClient([f"127.0.0.1:{port}"], worker_id=0)
+    reg = TensorRegistry(cfg)
+    sched = PipelineScheduler(client, registry=reg)
+    prior = {k: os.environ.get(k) for k in plane_env}
+    os.environ.update(plane_env)
+    try:
+        plane = CodecPlane(client, reg, None, None, num_workers,
+                           scheduler=sched)
+        sched.attach_codec_plane(plane)
+        handles = HandleManager()
+        yield client, reg, sched, plane, handles
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        sched.stop()
+        client.close()
+        t.join(timeout=10)
+
+
+def _round(reg, sched, handles, name, x, timeout=60):
+    ctx = reg.init_tensor(name, x.nbytes, DataType.FLOAT32)
+    h = handles.allocate(name)
+    sched.submit(ctx, x, h, False, 1)
+    return h.wait(timeout)
+
+
+def test_plane_pinned_lossless_is_bitwise_dense():
+    n = 32768  # >= BYTEPS_CODEC_MIN_BYTES
+    with _stack(BYTEPS_CODEC_PIN="lossless") as (c, reg, sched, plane,
+                                                 handles):
+        for r in range(3):
+            xr = _nasty_f32(n, seed=100 + r)
+            out = _round(reg, sched, handles, "pin", xr)
+            assert out.tobytes() == xr.tobytes()
+        snap = plane.plan_snapshot()
+        assert snap["pin"]["tier"] == "lossless"
+        assert snap["pin"]["epoch"] >= 1
+
+
+def test_plane_small_and_non_f32_leaves_stay_dense():
+    with _stack(BYTEPS_CODEC_PIN="lossless") as (c, reg, sched, plane,
+                                                 handles):
+        small = np.arange(64, dtype=np.float32)       # < min bytes
+        out = _round(reg, sched, handles, "small", small)
+        np.testing.assert_array_equal(out, small)
+        ints = np.arange(32768, dtype=np.int32)       # not f32
+        out = _round(reg, sched, handles, "ints", ints)
+        np.testing.assert_array_equal(out, ints)
+        assert "small" not in plane.plan_snapshot()
+        assert "ints" not in plane.plan_snapshot()
+
+
+@pytest.mark.slow
+def test_plane_signal_driven_escalation_and_recovery():
+    """The adaptive loop end-to-end: injected PULL-bound signals walk a
+    live leaf dense -> lossless -> onebit (server re-installed at each
+    quiescent boundary, numerics correct for each tier), COMPUTE-bound
+    signals walk it back down, and the de-escalated key folds dense
+    again (the compressor=none clear path)."""
+    n = 32768
+    rng = np.random.RandomState(3)
+    x = rng.randn(n).astype(np.float32)
+    with _stack(BYTEPS_CODEC_UP_ROUNDS="2", BYTEPS_CODEC_DOWN_ROUNDS="3") \
+            as (c, reg, sched, plane, handles):
+        out = _round(reg, sched, handles, "leaf", x)       # dense round
+        assert out.tobytes() == x.tobytes()
+        step = [0]
+
+        def push_signals(kind, count):
+            for _ in range(count):
+                step[0] += 1
+                plane.observe(RoundSignal(
+                    step=step[0], compute_ms=1.0,
+                    pull_ms=100.0 if kind == "P" else 0.1))
+
+        push_signals("P", 2)
+        out = _round(reg, sched, handles, "leaf", x * 2)   # lossless now
+        assert out.tobytes() == (x * 2).tobytes()
+        assert plane.plan_snapshot()["leaf"]["tier"] == "lossless"
+
+        push_signals("P", 2)
+        out = np.asarray(_round(reg, sched, handles, "leaf", x * 3))
+        assert plane.plan_snapshot()["leaf"]["tier"] == "onebit"
+        # onebit semantics: sign * mean|x| (scaled), not identity
+        expect = np.sign(x * 3).astype(np.float32) * np.float32(
+            np.mean(np.abs((x * 3).astype(np.float32))))
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+        push_signals("C", 6)  # two rungs down: onebit -> lossless -> dense
+        out = _round(reg, sched, handles, "leaf", x * 4)
+        assert plane.plan_snapshot()["leaf"]["tier"] == "dense"
+        assert out.tobytes() == (x * 4).tobytes()
+
+
+def test_two_plane_instances_identical_plans():
+    """Two independent scheduler+plane stacks (two 'workers') fed the
+    same submissions and the same round signals emit identical codec
+    plans — the cross-worker determinism the wire tag enforces."""
+    n = 32768
+    x = np.arange(n, dtype=np.float32)
+    sigs = _signals("PPP" + "CCCC")
+    snaps = []
+    for _ in range(2):
+        with _stack(BYTEPS_CODEC_UP_ROUNDS="2",
+                    BYTEPS_CODEC_DOWN_ROUNDS="3") \
+                as (c, reg, sched, plane, handles):
+            trace = []
+            _round(reg, sched, handles, "det", x)
+            for s in sigs:
+                plane.observe(s)
+                _round(reg, sched, handles, "det", x)
+                trace.append(plane.plan_snapshot()["det"])
+            snaps.append(trace)
+    assert snaps[0] == snaps[1]
+
+
+def test_server_rejects_mistagged_fold_loudly():
+    """A push whose codec tag disagrees with the store's active codec
+    must error-reply (the client raises) and must NOT fold — the
+    published aggregate stays the previous round's."""
+    with _stack() as (client, reg, sched, plane, handles):
+        x = np.arange(1024, dtype=np.float32)
+        ctx = reg.init_tensor("tag", x.nbytes, DataType.FLOAT32)
+        client.ensure_init(ctx, x.nbytes)
+        p = ctx.partitions[0]
+        client.zpush(p.server, p.key, x, CMD_F32, epoch=(1 << 16),
+                     codec=(0 << 8) | WIRE_CODEC_IDS["dense"])
+        out = np.empty(1024, np.float32)
+        client.zpull(p.server, p.key, out, CMD_F32)
+        np.testing.assert_array_equal(out, x)
+        with pytest.raises(RuntimeError):
+            client.zpush(p.server, p.key, x * 9, CMD_F32,
+                         epoch=(2 << 16),
+                         codec=(0 << 8) | WIRE_CODEC_IDS["lossless"])
+        client.zpull(p.server, p.key, out, CMD_F32)
+        np.testing.assert_array_equal(
+            out, x), "mis-tagged payload silently folded"
+
+
+def test_comp_init_none_clears_server_codec():
+    with _stack() as (client, reg, sched, plane, handles):
+        x = np.arange(2048, dtype=np.float32)
+        ctx = reg.init_tensor("clr", x.nbytes, DataType.FLOAT32)
+        client.ensure_init(ctx, x.nbytes)
+        p = ctx.partitions[0]
+        client.comp_init(p.server, p.key, "compressor=lossless;n=2048")
+        # dense push against a compressed store: mode gate rejects
+        with pytest.raises(RuntimeError):
+            client.zpush(p.server, p.key, x, CMD_F32, epoch=(1 << 16))
+        client.comp_init(p.server, p.key, "compressor=none;n=2048")
+        client.zpush(p.server, p.key, x, CMD_F32, epoch=(2 << 16))
+        out = np.empty(2048, np.float32)
+        client.zpull(p.server, p.key, out, CMD_F32)
+        np.testing.assert_array_equal(out, x)
+
+
+def test_lossless_two_workers_exact_sum():
+    """Multi-worker lossless fold: decode-then-fold of exact payloads
+    is the exact f32 sum — identical to what the dense path produces
+    for the same arrival order (1 partition, 2 workers: sum of two
+    floats is order-free)."""
+    port = _PORT[0]
+    _PORT[0] += 1
+    cfg = Config(num_workers=2, num_servers=1)
+    t = threading.Thread(target=run_server, args=(port, cfg), daemon=True)
+    t.start()
+    addr = [f"127.0.0.1:{port}"]
+    from byteps_tpu.server.compressed import CompressedTensor
+    c0, c1 = PSClient(addr, 0), PSClient(addr, 1)
+    rng = np.random.RandomState(5)
+    x0 = rng.randn(4096).astype(np.float32)
+    x1 = rng.randn(4096).astype(np.float32)
+
+    def reg_ctx():
+        return TensorRegistry(cfg).init_tensor("two", x0.nbytes,
+                                               DataType.FLOAT32)
+    ct0 = CompressedTensor(c0, reg_ctx(), {"compressor": "lossless"}, 2)
+    ct1 = CompressedTensor(c1, reg_ctx(), {"compressor": "lossless"}, 2)
+    res = {}
+    th = threading.Thread(
+        target=lambda: res.setdefault("w1", ct1.push_pull(x1,
+                                                          average=False)),
+        daemon=True)
+    th.start()
+    res["w0"] = ct0.push_pull(x0, average=False)
+    th.join(timeout=30)
+    assert not th.is_alive()
+    expect = x0 + x1
+    assert res["w0"].tobytes() == expect.tobytes()
+    assert res["w1"].tobytes() == expect.tobytes()
+    # both workers announce SHUTDOWN so the server exits promptly (a
+    # single shutdown of a 2-worker server leaves it listening and the
+    # join below would burn its full timeout)
+    c0.close()
+    c1.close()
+    t.join(timeout=10)
+    assert not t.is_alive()

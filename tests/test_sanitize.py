@@ -89,6 +89,20 @@ def worker(w):
                           rng.randn(1024).astype(np.float32), fout, CMD,
                           lambda n, err, d=fdone: d.set(), epoch=ep)
         assert fdone.wait(60), "fused completion never fired"
+        # Waiter-lifecycle burst (the PR-6 TSAN finding's minimal
+        # repro, promoted): tight concurrent BLOCKING request loops on
+        # shared striped conns churn Waiter completions across threads
+        # — before the per-conn Waiter pool + explicitly-initialized
+        # pthread primitives, heap/address reuse of completed Waiters
+        # reported "double lock of a destroyed mutex" within seconds
+        bctx = ctxs[(step + 1) % len(ctxs)]
+        for bp in bctx.partitions:
+            for _ in range(3):
+                c.zpush(bp.server, bp.key,
+                        rng.randn(bp.length // 4).astype(np.float32),
+                        CMD)
+                small = np.empty(bp.length // 4, np.float32)
+                c.zpull(bp.server, bp.key, small, CMD)
         c.barrier()
 
 threads = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
