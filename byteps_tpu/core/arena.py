@@ -115,17 +115,21 @@ class StagingArena:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._mu = threading.Lock()
-        self._slots: Dict[str, _Slot] = {}
-        # counters (see module docstring)
-        self._slot_allocs = 0       # tracked slots created (incl. resizes)
-        self._allocs_avoided = 0    # checkouts served from an existing slot
-        self._conflicts = 0         # slot busy -> fresh fallback
-        self._fresh = 0             # untracked allocations handed out
-        self._resizes = 0           # slot dropped for a size change
+        self._slots: Dict[str, _Slot] = {}  # guarded-by: _mu
+        # counters (see module docstring); all guarded by _mu:
+        # slot_allocs = tracked slots created (incl. resizes),
+        # allocs_avoided = checkouts served from an existing slot,
+        # conflicts = slot busy -> fresh fallback, fresh = untracked
+        # allocations handed out, resizes = slot dropped for a size change
+        self._slot_allocs = 0       # guarded-by: _mu
+        self._allocs_avoided = 0    # guarded-by: _mu
+        self._conflicts = 0         # guarded-by: _mu
+        self._fresh = 0             # guarded-by: _mu
+        self._resizes = 0           # guarded-by: _mu
         # per-stage checkout counters (tag="export": the streamed-export
         # round's result-slot leases, jax/train.py) — proves which pipeline
         # stage the staged bytes serve
-        self._tag_checkouts: Dict[str, int] = {}
+        self._tag_checkouts: Dict[str, int] = {}  # guarded-by: _mu
 
     # ------------------------------------------------------------------ #
 

@@ -201,9 +201,9 @@ class CodecPlane:
         self._ingest_mu = threading.Lock()  # one-shot report ingestion
         # (name, tier) -> CompressedTensor (codec stacks persist across
         # re-escalations so randomk seeds / step counters stay stable)
-        self._tensors: Dict[tuple, object] = {}
-        self._adaptive_names: set = set()
-        self._last_signal_step = 0
+        self._tensors: Dict[tuple, object] = {}  # guarded-by: _mu
+        self._adaptive_names: set = set()        # guarded-by: _mu
+        self._last_signal_step = 0         # guarded-by: _ingest_mu
         self._metrics = metrics
         if metrics is not None:
             register_codec_metrics(metrics)
@@ -343,7 +343,7 @@ class CodecPlane:
             return True
         return idle([p.key for p in ctx.partitions])
 
-    def _tensor_locked(self, ctx, tier):
+    def _tensor_locked(self, ctx, tier):  # caller-holds: _mu
         ct = self._tensors.get((ctx.name, tier))
         if ct is not None and (ct.ctx is not ctx
                                or len(ct.stacks) != len(ctx.partitions)):
@@ -358,6 +358,7 @@ class CodecPlane:
             self._tensors[(ctx.name, tier)] = ct
         return ct
 
+    # caller-holds: _mu
     def _apply_locked(self, ctx, plan: CodecPlan, tier: str) -> None:
         """Install ``tier``'s server-side codec for every partition of
         ``ctx`` (COMP_INIT; ``compressor=none`` clears for dense) and

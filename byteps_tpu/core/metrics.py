@@ -67,7 +67,7 @@ class Counter:
 
     def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None):
         self.name = name
-        self._v = 0
+        self._v = 0                    # guarded-by: _mu
         self._mu = threading.Lock()
         self._reg = reg
 
@@ -92,8 +92,8 @@ class Gauge:
 
     def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None):
         self.name = name
-        self._v = 0.0
-        self._fn: Optional[Callable[[], float]] = None
+        self._v = 0.0                  # guarded-by: _mu
+        self._fn: Optional[Callable[[], float]] = None  # guarded-by: _mu
         self._mu = threading.Lock()
         self._reg = reg
 
@@ -144,11 +144,11 @@ class Histogram:
                  reg: Optional["MetricsRegistry"] = None):
         self.name = name
         self.unit = unit
-        self._counts = [0] * HIST_BUCKETS
-        self._count = 0
-        self._sum = 0
-        self._min = None
-        self._max = None
+        self._counts = [0] * HIST_BUCKETS  # guarded-by: _mu
+        self._count = 0                    # guarded-by: _mu
+        self._sum = 0                      # guarded-by: _mu
+        self._min = None                   # guarded-by: _mu
+        self._max = None                   # guarded-by: _mu
         self._mu = threading.Lock()
         self._reg = reg
 
@@ -211,12 +211,13 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._mu = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._hists: Dict[str, Histogram] = {}
+        self._counters: Dict[str, Counter] = {}    # guarded-by: _mu
+        self._gauges: Dict[str, Gauge] = {}        # guarded-by: _mu
+        self._hists: Dict[str, Histogram] = {}     # guarded-by: _mu
         # sections collected live at snapshot time (name -> dict fn):
         # how the staging arena / export counters surface without a
         # registry write on their own hot paths
+        # guarded-by: _mu
         self._sections: Dict[str, Callable[[], dict]] = {}
 
     # -- instrument get-or-create ------------------------------------- #
@@ -375,9 +376,12 @@ class _StepBuilder:
         self.step = step
         self.t0 = time.perf_counter()
         self._mu = threading.Lock()
-        self.stage_samples: Dict[str, List[float]] = {}
-        self.queue_peak = 0
-        self.credit_stalls = 0
+        # stage samples / queue peak / stalls arrive from scheduler pool
+        # threads; marks and pull_wait_s are train-thread-only by
+        # contract (see class docstring), so they stay unguarded
+        self.stage_samples: Dict[str, List[float]] = {}  # guarded-by: _mu
+        self.queue_peak = 0                              # guarded-by: _mu
+        self.credit_stalls = 0                           # guarded-by: _mu
         self.marks: Dict[str, float] = {}
         self.pull_wait_s = 0.0
 
@@ -417,9 +421,9 @@ class StepProfiler:
         self.stall_diag = stall_diag
         self._tracer = tracer
         self._mu = threading.Lock()
-        self._reports = collections.deque(maxlen=max(1, window))
-        self._current: Optional[_StepBuilder] = None
-        self._step_no = 0
+        self._reports = collections.deque(maxlen=max(1, window))  # guarded-by: _mu
+        self._current: Optional[_StepBuilder] = None  # guarded-by: _mu
+        self._step_no = 0                             # guarded-by: _mu
 
     def begin_step(self) -> Optional[_StepBuilder]:
         if not self.enabled:
@@ -432,8 +436,9 @@ class StepProfiler:
     def current(self) -> Optional[_StepBuilder]:
         # racy read by design: scheduler threads sample whatever step is
         # open right now; a stale builder reference still collects into
-        # a consistent (that step's) report
-        return self._current
+        # a consistent (that step's) report — taking the lock here would
+        # put it on every stage completion for no correctness gain
+        return self._current  # bps-lint: disable=guarded-by
 
     def end_step(self, b: Optional[_StepBuilder], ttfp_ms=None,
                  streamed: int = 0, fallback: int = 0) -> Optional[StepReport]:
@@ -495,8 +500,10 @@ class StepProfiler:
             return self._reports[-1] if self._reports else None
 
     def snapshot(self) -> dict:
-        reports = self.reports()
-        out = {"window": self._reports.maxlen, "count": len(reports),
+        with self._mu:
+            reports = list(self._reports)
+            window = self._reports.maxlen
+        out = {"window": window, "count": len(reports),
                "last": reports[-1].as_dict() if reports else None}
         if reports:
             out["last_diagnosis"] = classify_step(reports[-1])

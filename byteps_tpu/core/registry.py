@@ -78,12 +78,13 @@ class TensorRegistry:
     def __init__(self, config: Config):
         self._config = config
         self._lock = threading.Lock()
-        self._contexts: Dict[str, TensorContext] = {}
-        self._next_key = 0
+        self._contexts: Dict[str, TensorContext] = {}  # guarded-by: _lock
+        self._next_key = 0                             # guarded-by: _lock
         # Per-server accumulated bytes, for load-balanced assignment
         # (global.cc:628-677).
+        # guarded-by: _lock
         self._server_load: List[int] = [0] * max(1, config.num_servers)
-        self._declaration_order: List[str] = []
+        self._declaration_order: List[str] = []        # guarded-by: _lock
         # host staging arena (core/arena.py): re-partitioning a tensor
         # makes its staged slot sizes stale, so the registry drops them
         self._arena = None
@@ -92,13 +93,13 @@ class TensorRegistry:
         # increasing routing version (the migration fence: bumped once
         # per migrate_server call, so routing-table readers can detect
         # "the table changed under me" cheaply)
-        self._dead_servers: set = set()
-        self._routing_version = 0
+        self._dead_servers: set = set()                # guarded-by: _lock
+        self._routing_version = 0                      # guarded-by: _lock
         # adaptive codec plane: per-leaf plan state (core/codec_plane.py
         # CodecPlan — active ladder rung, plan epoch, hysteresis
         # streaks). Lives on the registry, not the plane, so plans
         # survive scheduler teardown/rebuild the way declarations do.
-        self._codec_plans: Dict[str, object] = {}
+        self._codec_plans: Dict[str, object] = {}      # guarded-by: _lock
 
     def attach_arena(self, arena) -> None:
         self._arena = arena

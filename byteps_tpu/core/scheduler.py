@@ -51,23 +51,26 @@ class ScheduledQueue:
 
     def __init__(self, credit_bytes: int = 0, metrics=None, profiler=None):
         # credit_bytes <= 0 -> scheduling disabled -> huge credit
-        self._credit = credit_bytes if credit_bytes > 0 else UNLIMITED_CREDIT
+        self._credit = (credit_bytes if credit_bytes > 0
+                        else UNLIMITED_CREDIT)  # guarded-by: _cv|_mu
         self._capacity = self._credit
         self._scheduling = credit_bytes > 0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._heap: List = []
+        # _cv wraps _mu, so holding either guards the same state
+        self._heap: List = []          # guarded-by: _cv|_mu
         self._counter = itertools.count()
-        self._stopped = False
+        self._stopped = False          # guarded-by: _cv|_mu
         # keys with a task currently running: same-key tasks are serialized
         # so overlapping push_pulls of one tensor can't interleave their
         # PUSH/PULL into the same server aggregation round
-        self._inflight: set = set()
+        self._inflight: set = set()    # guarded-by: _cv|_mu
         # measurement plane (core/metrics.py); None when metrics off —
         # instrument refs cached here so the hot path never takes the
         # registry lock
         self._profiler = profiler
-        self._credit_blocked = False  # set by _pop_admissible_locked
+        # set by _pop_admissible_locked
+        self._credit_blocked = False   # guarded-by: _cv|_mu
         if metrics is not None:
             self._depth_gauge = metrics.gauge("scheduler/queue_depth")
             self._admit_hist = metrics.histogram(
@@ -256,10 +259,10 @@ class TaskGroup:
     def __init__(self, ctx: TensorContext, total: int,
                  callback: Callable[[Optional[Exception]], None]):
         self.ctx = ctx
-        self._remaining = total
+        self._remaining = total        # guarded-by: _mu
         self._mu = threading.Lock()
         self._callback = callback
-        self._error: Optional[Exception] = None
+        self._error: Optional[Exception] = None  # guarded-by: _mu
 
     def partition_done(self, err: Optional[Exception] = None) -> None:
         with self._mu:
@@ -267,9 +270,14 @@ class TaskGroup:
                 self._error = err
             self._remaining -= 1
             fire = self._remaining == 0
+            # capture the error inside the lock: the old read of
+            # self._error at the callback site below was outside it
+            # (benign only because fire implies no more writers —
+            # byteps-lint guarded-by made the assumption explicit)
+            final_err = self._error
         if fire:
             try:
-                self._callback(self._error)
+                self._callback(final_err)
             except Exception:  # noqa: BLE001 - then re-raised
                 # a completion-callback bug must be LOUD: swallowed (the
                 # stage pools drop future exceptions), it strands the
@@ -292,7 +300,7 @@ class Handle:
         self._err: Optional[Exception] = None
         self.result: Optional[np.ndarray] = None
         self._cb_mu = threading.Lock()
-        self._cbs: List[Callable[[], None]] = []
+        self._cbs: List[Callable[[], None]] = []  # guarded-by: _cb_mu
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -336,8 +344,8 @@ class HandleManager:
 
     def __init__(self) -> None:
         self._mu = threading.Lock()
-        self._next = 0
-        self._handles: Dict[int, Handle] = {}
+        self._next = 0                           # guarded-by: _mu
+        self._handles: Dict[int, Handle] = {}    # guarded-by: _mu
 
     def allocate(self, name: str) -> Handle:
         with self._mu:
@@ -461,7 +469,14 @@ class PipelineScheduler:
         # compression ratio counters accumulate pre/post wire bytes
         self._metrics = metrics
         self._profiler = profiler
-        self._stage_hists: Dict[tuple, Any] = {}
+        # REAL violation found at guarded-by introduction: two stage
+        # pool threads racing _stage_done's get-then-insert could both
+        # miss and both insert (benign on CPython only because the
+        # registry hands back the same Histogram for one name). The
+        # dedicated lock makes the cache safe by construction; the
+        # registry lock stays off this path as before.
+        self._stage_mu = threading.Lock()
+        self._stage_hists: Dict[tuple, Any] = {}  # guarded-by: _stage_mu
         if metrics is not None:
             self._comp_pre = metrics.counter("compress/bytes_pre")
             self._comp_post = metrics.counter("compress/bytes_post")
@@ -485,17 +500,17 @@ class PipelineScheduler:
             num_threads, thread_name_prefix="bps-pull")
         self._codec_pool = concurrent.futures.ThreadPoolExecutor(
             n_codec, thread_name_prefix="bps-codec")
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _inflight_mu|_inflight_cv
         self._inflight_mu = threading.Lock()
         self._inflight_cv = threading.Condition(self._inflight_mu)
         # per-key pinned priority (see _pin_priority)
         self._prio_mu = threading.Lock()
-        self._key_priority: Dict[int, int] = {}
-        self._prio_warned: set = set()
+        self._key_priority: Dict[int, int] = {}  # guarded-by: _prio_mu
+        self._prio_warned: set = set()           # guarded-by: _prio_mu
         # measured production order (see production_priority): the n-th
         # key to first cross the export boundary gets ordinal n
-        self._export_ordinal = 0
-        self._export_order: Dict[int, int] = {}
+        self._export_ordinal = 0                 # guarded-by: _prio_mu
+        self._export_order: Dict[int, int] = {}  # guarded-by: _prio_mu
         # ---- fault tolerance (docs/fault-tolerance.md) ---------------- #
         # bounded wire retry with exponential backoff: a failed wire
         # exchange (fused PUSHPULL or two-op push/pull) is retried up to
@@ -518,16 +533,16 @@ class PipelineScheduler:
         # per-declared-key submission ordinal: the ROUND half of the
         # epoch stamp. Scheduler-owned (not the caller's `version`) so
         # dedup never depends on callers passing monotonic versions.
-        self._round_seq: Dict[int, int] = {}
+        self._round_seq: Dict[int, int] = {}     # guarded-by: _prio_mu
         # pending backoff timers: task-id -> (timer, task); stop() fails
         # them so no handle waits on a retry that will never fire
         self._retry_mu = threading.Lock()
-        self._pending_retries: Dict[int, tuple] = {}
+        self._pending_retries: Dict[int, tuple] = {}  # guarded-by: _retry_mu
         # servers already failed over (migrate once per death); the
         # failover lock is held across a whole migration so concurrent
         # failing partitions only ever see a fully-applied routing table
         self._failover_mu = threading.Lock()
-        self._migrated_servers: set = set()
+        self._migrated_servers: set = set()  # guarded-by: _failover_mu
         if metrics is not None:
             # created eagerly (not on first event) so the observability
             # schema resolves 0-valued counters on healthy fleets
@@ -701,11 +716,12 @@ class PipelineScheduler:
             return
         dt = time.perf_counter() - t0
         key = (stage, self._key_class(task))
-        h = self._stage_hists.get(key)
-        if h is None:
-            h = self._metrics.histogram(
-                f"scheduler/{stage.lower()}_us/{key[1]}")
-            self._stage_hists[key] = h
+        with self._stage_mu:
+            h = self._stage_hists.get(key)
+            if h is None:
+                h = self._metrics.histogram(
+                    f"scheduler/{stage.lower()}_us/{key[1]}")
+                self._stage_hists[key] = h
         h.record_seconds(dt)
         prof = self._profiler.current() if self._profiler else None
         if prof is not None:

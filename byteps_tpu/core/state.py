@@ -37,9 +37,10 @@ class _Telemetry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._window_start = time.monotonic()
-        self._window_bytes = 0
-        self._last_sample = (0.0, 0.0)  # (timestamp, MB/s)
+        self._window_start = time.monotonic()   # guarded-by: _lock
+        self._window_bytes = 0                  # guarded-by: _lock
+        # (timestamp, MB/s)
+        self._last_sample = (0.0, 0.0)          # guarded-by: _lock
         self.enabled = True  # BYTEPS_TELEMETRY_ON; set by GlobalState.init
         # registry mirror (core/metrics.py), set by GlobalState.init:
         # every recorded byte also lands on the unified counter surface

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
+import shutil
 import subprocess
 import threading
 
@@ -17,7 +19,27 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ps.cc")
 _LOCK = threading.Lock()
 
-CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+# -Wextra -Werror: the native tier builds WARNING-CLEAN by contract
+# (byteps-lint's native leg; docs/static-analysis.md) — a new warning
+# is a build failure, not console noise someone may read. The flags are
+# part of the build hash below, so upgrading a cached stale .so built
+# without them rebuilds instead of silently skipping the gate.
+CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall",
+            "-Wextra", "-Werror"]
+
+# Curated clang-tidy checks run (non-fatally) when the tool is present:
+# the bug classes a PS wire server actually hits — lifetime/use-after-
+# move/bounds (bugprone), lock misuse (concurrency), needless copies on
+# the payload path (performance). Noisy style checks are deliberately
+# absent; -Werror above is the fatal gate, this is the advisory one.
+CLANG_TIDY_CHECKS = ",".join([
+    "-*",
+    "bugprone-*",
+    "concurrency-*",
+    "performance-*",
+    "-bugprone-easily-swappable-parameters",
+    "-bugprone-narrowing-conversions",
+])
 # shm_open/sem_* live in librt on glibc < 2.34 (a no-op stub after): a
 # binary linked on a new-glibc host dlopens with "undefined symbol:
 # shm_open" on an older one, so always link it (dropped as a last
@@ -55,6 +77,16 @@ def _cpu_tag() -> str:
     return platform.processor() or platform.machine()
 
 
+def _family_tag() -> str:
+    """Cache-family prefix: sanitized builds live alongside the dense
+    one ("thread-"/"address-"/"" before the digest). Eviction is
+    per-family, so a tier-1 run interleaving the TSAN smoke with
+    dense-lib tests keeps BOTH cached instead of recompiling each ~5 s
+    artifact every time the other is built."""
+    san = os.environ.get("BYTEPS_SANITIZE", "")
+    return f"{san}-" if san in ("thread", "address") else ""
+
+
 def lib_path() -> str:
     with open(_SRC, "rb") as f:
         h = hashlib.sha256(f.read())
@@ -62,7 +94,39 @@ def lib_path() -> str:
                       + _sanitizer_flags()).encode())
     h.update(_cpu_tag().encode())
     digest = h.hexdigest()[:16]
-    return os.path.join(_DIR, f"libbyteps_ps-{digest}.so")
+    return os.path.join(_DIR, f"libbyteps_ps-{_family_tag()}{digest}.so")
+
+
+def clang_tidy(verbose: bool = False) -> str:
+    """Run the curated clang-tidy checks over ps.cc when the tool is
+    installed; returns its report text ("" when unavailable or clean).
+    NON-FATAL by design: tidy availability varies across build hosts,
+    so its findings advise while the -Wall -Wextra -Werror compile is
+    the hard gate. Invoked from ci/checks.sh (which prints the
+    report), NOT from the lazy import-time build() — a train/server
+    start must never block on an advisory analysis whose output
+    nothing would read."""
+    tool = shutil.which("clang-tidy")
+    if tool is None:
+        return ""
+    try:
+        proc = subprocess.run(
+            [tool, _SRC, f"--checks={CLANG_TIDY_CHECKS}", "--quiet",
+             "--", "-std=c++17", "-pthread"],
+            capture_output=True, text=True, timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"[clang-tidy] did not complete: {e!r}"
+    report = (proc.stdout or "").strip()
+    if proc.returncode != 0:
+        # a nonzero rc means the analysis itself failed (tidy's bare
+        # compile line hit an error, bad invocation, ...) — that must
+        # never read as "clean" to the gate
+        err = (proc.stderr or "").strip()[-2000:]
+        report = (f"[clang-tidy] FAILED rc={proc.returncode} — analysis "
+                  f"did not run cleanly:\n{report}\n{err}").strip()
+    if report and verbose:
+        print(f"[byteps_tpu] clang-tidy (advisory):\n{report}")
+    return report
 
 
 def build(verbose: bool = False) -> str:
@@ -112,12 +176,16 @@ def build(verbose: bool = False) -> str:
                     os.remove(tmp)
                 except OSError:
                     pass
-        # clean stale builds
+        # clean stale builds of THIS family only (digest prefixed by
+        # the same sanitizer tag): evicting across families would make
+        # dense and sanitized builds recompile each other out of the
+        # cache on every alternation. Orphaned pid-tmps of crashed
+        # builds are matched by the same family pattern.
+        stale = re.compile(
+            rf"libbyteps_ps-{re.escape(_family_tag())}[0-9a-f]{{16}}"
+            rf"\.so(\.tmp\..*)?$")
         for f in os.listdir(_DIR):
-            # stale builds AND orphaned pid-tmps of crashed builds
-            if (f.startswith("libbyteps_ps-")
-                    and (f.endswith(".so") or ".so.tmp." in f)
-                    and os.path.join(_DIR, f) != out):
+            if stale.fullmatch(f) and os.path.join(_DIR, f) != out:
                 try:
                     os.remove(os.path.join(_DIR, f))
                 except OSError:

@@ -241,6 +241,29 @@ struct MsgHeader {
 
 static_assert(sizeof(MsgHeader) == 40, "header layout");
 
+// Reply/control header factory: the trailing epoch/codec fields are
+// always 0 on server replies and handshake messages, and spelling that
+// with 8-field aggregate initializers tripped
+// -Wmissing-field-initializers at every site once the build went
+// -Wall -Wextra -Werror (native/build.py). Value-init zero-fills
+// everything first, so a future MsgHeader field is 0 on every reply by
+// construction instead of by 30 hand-updated braces.
+static inline MsgHeader ReplyHeader(uint8_t op, uint8_t flags,
+                                    uint16_t sender, uint32_t rid,
+                                    uint64_t key = 0, uint32_t cmd = 0,
+                                    uint32_t len = 0) {
+  MsgHeader h{};
+  h.magic = kMagic;
+  h.op = op;
+  h.flags = flags;
+  h.sender = sender;
+  h.rid = rid;
+  h.key = key;
+  h.cmd = cmd;
+  h.len = len;
+  return h;
+}
+
 // Inverse Cantor pairing (common.cc:98-101).
 static inline void decode_cmd(uint32_t cmd, uint32_t* req, uint32_t* dtype) {
   uint64_t w = (uint64_t)((std::sqrt(8.0 * cmd + 1) - 1) / 2);
@@ -249,16 +272,8 @@ static inline void decode_cmd(uint32_t cmd, uint32_t* req, uint32_t* dtype) {
   *req = (uint32_t)(w - *dtype);
 }
 
-static bool send_all(int fd, const void* buf, size_t n) {
-  const char* p = (const char*)buf;
-  while (n) {
-    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (w <= 0) return false;
-    p += w;
-    n -= (size_t)w;
-  }
-  return true;
-}
+// (send_all was deleted here: every send rides the gathered
+// send_msg_iov path, and -Wextra -Werror flagged the dead helper.)
 
 static bool recv_all(int fd, void* buf, size_t n) {
   char* p = (char*)buf;
@@ -1784,7 +1799,7 @@ class Server {
       barrier_waiters_.clear();
     }
     for (auto& p : victims) {
-      MsgHeader r{kMagic, ACK, 1, 0, p.rid, 0, 0, 0};  // flags=1: error
+      MsgHeader r = ReplyHeader(ACK, 1, 0, p.rid);  // flags=1: error
       p.conn->send_msg(r, nullptr);
     }
   }
@@ -1814,7 +1829,7 @@ class Server {
         if (s->magic == kIpcMagic && s->ring_size >= (64 << 10) &&
             (size_t)st.st_size ==
                 sizeof(IpcShm) + 2 * (size_t)s->ring_size) {
-          MsgHeader r{kMagic, ACK, 0, 0, rid, 0, 0, 0};
+          MsgHeader r = ReplyHeader(ACK, 0, 0, rid);
           conn->send_msg(r, nullptr);  // still TCP: ipc not yet set
           // pending until the client's IPC_CONFIRM commits it — the
           // client may time out on our ACK and stay TCP
@@ -1830,7 +1845,7 @@ class Server {
       std::fprintf(stderr,
                    "[bps-server] ipc upgrade declined (shm %s)\n",
                    name.c_str());
-      MsgHeader r{kMagic, ACK, 1, 0, rid, 0, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 1, 0, rid);
       conn->send_msg(r, nullptr);
     }
   }
@@ -1850,7 +1865,7 @@ class Server {
       }
     }
     for (auto& w : release) {
-      MsgHeader r{kMagic, ACK, 0, 0, w.rid, 0, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 0, 0, w.rid);
       w.conn->send_msg(r, nullptr);
     }
   }
@@ -1862,7 +1877,7 @@ class Server {
       std::lock_guard<Mu> lk(worker_conns_mu_);
       clean_exit_.insert((int)m.sender);
     }
-    MsgHeader r{kMagic, ACK, 0, 0, m.rid, 0, 0, 0};
+    MsgHeader r = ReplyHeader(ACK, 0, 0, m.rid);
     m.conn->send_msg(r, nullptr);
     if (++shutdown_count_ >= num_workers_) {
       shutting_down_.store(true);
@@ -1881,7 +1896,7 @@ class Server {
         // (e.g. a stale push adopted as the first push of the re-armed
         // round). This dequeue-time check is the fast path; the handlers
         // re-check under ks.mu to close the check-then-act window.
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         continue;
       }
@@ -1896,7 +1911,7 @@ class Server {
           // server). Error-reply instead of dropping — a fused client
           // would otherwise wait out its full request timeout on a
           // request this server can never answer.
-          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
           m.conn->send_msg(r, nullptr);
           break;
       }
@@ -1995,7 +2010,7 @@ class Server {
       // aggregate (error-reply pattern as the length-mismatch path below)
       std::fprintf(stderr, "[bps-server] init rejected key=%llu: unknown "
                    "dtype %u\n", (unsigned long long)m.key, m.dtype);
-      MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
       m.conn->send_msg(r, nullptr);
       return;
     }
@@ -2005,7 +2020,7 @@ class Server {
       KeyStore& ks = store_of(m.key);
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2070,11 +2085,11 @@ class Server {
       }
     }
     for (auto& w : stale) {
-      MsgHeader r{kMagic, ACK, 1, 0, w.rid, m.key, 0, 0};  // flags=1: error
+      MsgHeader r = ReplyHeader(ACK, 1, 0, w.rid, m.key);  // flags=1: error
       w.conn->send_msg(r, nullptr);
     }
     for (auto& w : release) {
-      MsgHeader r{kMagic, ACK, 0, 0, w.rid, m.key, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 0, 0, w.rid, m.key);
       w.conn->send_msg(r, nullptr);
     }
   }
@@ -2088,7 +2103,7 @@ class Server {
     {
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2137,7 +2152,7 @@ class Server {
         }
       }
     }
-    MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key, 0, 0};
+    MsgHeader r = ReplyHeader(ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key);
     m.conn->send_msg(r, nullptr);
   }
 
@@ -2215,13 +2230,13 @@ class Server {
     {
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
       if (IsReplay(ks, m)) goto ack;  // fold at most once per round
       if (!CodecTagOk(ks, m)) {
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2239,7 +2254,7 @@ class Server {
           std::fprintf(stderr, "[bps-server] compressed push rejected "
                        "key=%llu (bad indices)\n",
                        (unsigned long long)m.key);
-          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
           m.conn->send_msg(r, nullptr);
           return;
         }
@@ -2328,7 +2343,7 @@ class Server {
                      "len=%zu bound=%u\n",
                      (unsigned long long)m.key, m.payload.size(),
                      ks.comp.WireLen());
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2385,7 +2400,7 @@ class Server {
     }
   ack:
     if (!fused) {
-      MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 0, 0, m.rid, m.key);
       m.conn->send_msg(r, nullptr);
     }
     for (auto& p : flush) AnswerPull(ks, p);
@@ -2479,8 +2494,8 @@ class Server {
       std::fprintf(stderr, "[bps-server] sparse push rejected key=%llu "
                    "len=%zu\n", (unsigned long long)m.key, m.payload.size());
     if (!ok || !fused) {
-      MsgHeader r{kMagic, ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key,
-                  0, 0};
+      MsgHeader r =
+          ReplyHeader(ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key);
       m.conn->send_msg(r, nullptr);
     }
     for (auto& p : flush) AnswerPull(ks, p);
@@ -2507,7 +2522,7 @@ class Server {
                      "[bps-server] push mode mismatch key=%llu comp=%d "
                      "req=%u\n",
                      (unsigned long long)m.key, (int)has_comp, m.req);
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2519,7 +2534,7 @@ class Server {
     {
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2532,13 +2547,13 @@ class Server {
                      (unsigned long long)m.key, m.payload.size(), ks.len);
         // flags bit0 = error: reply instead of dropping, so the client
         // raises instead of hanging on a never-acked request
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
       if (!IsReplay(ks, m)) {
         if (!CodecTagOk(ks, m)) {
-          MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+          MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
           m.conn->send_msg(r, nullptr);
           return;
         }
@@ -2592,7 +2607,7 @@ class Server {
     }
     if (!fused) {
       // ack the push (ZPush completion callback)
-      MsgHeader r{kMagic, ACK, 0, 0, m.rid, m.key, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 0, 0, m.rid, m.key);
       m.conn->send_msg(r, nullptr);
     }
     for (auto& p : flush) AnswerPull(ks, p);
@@ -2625,8 +2640,8 @@ class Server {
         std::lock_guard<Mu> lk(ks.mu);
         snapshot = ks.merged;
       }
-      MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
-                  (uint32_t)snapshot.size()};
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, p.rid, 0, 0,
+                                (uint32_t)snapshot.size());
       p.conn->send_msg(r, snapshot.data());
       return;
     }
@@ -2641,12 +2656,12 @@ class Server {
       snap = p.compressed ? ks.pub_wire : ks.pub;
     }
     if (!snap) {  // defensive: pull answered before any init
-      MsgHeader r{kMagic, ACK, 1, 0, p.rid, 0, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 1, 0, p.rid);
       p.conn->send_msg(r, nullptr);
       return;
     }
-    MsgHeader r{kMagic, PULL_REPLY, 0, 0, p.rid, 0, 0,
-                (uint32_t)snap->size()};
+    MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, p.rid, 0, 0,
+                              (uint32_t)snap->size());
     p.conn->send_msg(r, snap->data());
   }
 
@@ -2658,7 +2673,7 @@ class Server {
     {
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2667,7 +2682,7 @@ class Server {
         // pushed: serving the previous round's aggregate would be a
         // silent stale read — error so the worker retries the round
         ks.pull_abort[m.sender] = 0;
-        MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+        MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
       }
@@ -2683,7 +2698,7 @@ class Server {
       // so parking here would hang the client forever)
       std::fprintf(stderr, "[bps-server] pull before init key=%llu\n",
                    (unsigned long long)m.key);
-      MsgHeader r{kMagic, ACK, 1, 0, m.rid, m.key, 0, 0};
+      MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
       m.conn->send_msg(r, nullptr);
       return;
     }
@@ -3256,8 +3271,8 @@ class ServerConn {
     IpcShm* s = reinterpret_cast<IpcShm*>(base);  // pages arrive zeroed
     s->ring_size = (uint32_t)ring;
     s->magic = kIpcMagic;
-    MsgHeader h{kMagic, IPC_HELLO, 0, sender, 0, 0, 0,
-                (uint32_t)std::strlen(name)};
+    MsgHeader h = ReplyHeader(IPC_HELLO, 0, sender, 0, 0, 0,
+                              (uint32_t)std::strlen(name));
     MsgHeader r{};
     // Bound the handshake: a server that stalls or predates IPC_HELLO
     // (version skew) must not wedge Connect() forever. The peeking
@@ -3276,7 +3291,7 @@ class ServerConn {
       std::fprintf(stderr, "[bps-client] ipc upgrade declined, using TCP\n");
       return;
     }
-    MsgHeader c{kMagic, IPC_CONFIRM, 0, sender, 0, 0, 0, 0};
+    MsgHeader c = ReplyHeader(IPC_CONFIRM, 0, sender, 0);
     if (!send_msg_iov(fd_, c, nullptr)) {
       ::munmap(base, total);
       return;

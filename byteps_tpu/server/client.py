@@ -19,6 +19,7 @@ from __future__ import annotations
 import concurrent.futures
 import ctypes
 import os
+import struct
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -31,6 +32,19 @@ from ..core.types import (
 )
 from ..native.build import build
 from ..utils.logging import log
+
+# Python mirror of the native wire header (native/ps.cc MsgHeader).
+# The transport itself is native — these constants exist so the Python
+# side can NAME the contract (tests, tooling, debugging captures) and
+# so byteps-lint's wire-layout rule can diff both sides statically: a
+# header or magic change that lands on only one side fails the lint
+# (the 36B->40B / 0xB17E5001->0xB17E5002 drift class). Keep field
+# order identical to the struct: magic, op, flags, sender, rid, key,
+# cmd, len, epoch, codec — little-endian, packed.
+WIRE_MAGIC = 0xB17E5002
+WIRE_HEADER_FMT = "<IBBHIQIIQI"
+WIRE_HEADER_BYTES = 40
+assert struct.calcsize(WIRE_HEADER_FMT) == WIRE_HEADER_BYTES
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -198,7 +212,7 @@ class PSClient:
         # key -> store length this client has init-pushed on the server
         # (server-side initialization is per-store, distinct from registry
         # declaration; a resize needs a fresh init push)
-        self._inited_keys: dict = {}
+        self._inited_keys: dict = {}   # guarded-by: _lock
         # wire-layer instrument refs (core/metrics.py), attached by
         # GlobalState.init after connect; None = uninstrumented (direct
         # construction in tests/benches)
@@ -211,16 +225,16 @@ class PSClient:
         # recv loop writes through its pointer until the ticket's
         # completion record is drained, so it must not be collectable.
         self._fused_mu = threading.Lock()
-        self._fused: dict = {}
-        self._next_ticket = 1
+        self._fused: dict = {}         # guarded-by: _fused_mu
+        self._next_ticket = 1          # guarded-by: _fused_mu
         self._reactor: Optional[threading.Thread] = None
-        self._reactor_started = False
+        self._reactor_started = False  # guarded-by: _lock
         # outstanding wire requests awaiting a server reply (fused
         # requests + blocking pulls): THE concurrency the reactor model
         # unlocks — two-op mode caps it at the pull-pool thread count,
         # fused mode at scheduling credit
-        self._inflight = 0
-        self._inflight_peak = 0
+        self._inflight = 0             # guarded-by: _lock
+        self._inflight_peak = 0        # guarded-by: _lock
 
     def attach_metrics(self, metrics) -> None:
         """Cache wire counters off the registry: every ZPush/ZPull
@@ -482,7 +496,10 @@ class PSClient:
                 f"(connection poisoned or lost)")
 
     def _ensure_reactor(self) -> None:
-        if self._reactor_started:
+        # double-checked locking: the flag only ever flips False->True,
+        # so the lock-free fast path can at worst take the slow path
+        # once more — keeping the lock off every post-startup send
+        if self._reactor_started:  # bps-lint: disable=guarded-by
             return
         with self._lock:
             if self._reactor_started:
@@ -544,7 +561,9 @@ class PSClient:
         """Teardown half-step: fail outstanding fused requests into the
         queue, close it, and join the reactor so no native callback can
         run after the client handle is freed."""
-        if not self._reactor_started:
+        with self._lock:
+            started = self._reactor_started
+        if not started:
             return
         try:
             self._lib.bps_client_cq_abort(self._handle)

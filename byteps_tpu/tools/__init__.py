@@ -1,0 +1,6 @@
+"""Developer tooling that ships with the framework (not runtime code).
+
+``byteps_tpu.tools.lint`` — the project-native static analysis suite
+(docs/static-analysis.md). Nothing under this package is imported by
+the training path.
+"""

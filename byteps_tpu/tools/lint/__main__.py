@@ -1,0 +1,58 @@
+"""CLI: ``python -m byteps_tpu.tools.lint [--root DIR] [--rules a,b]``.
+
+Exit codes (pinned by tests/test_lint.py): 0 clean, 1 findings,
+2 usage error. Finding format: ``path:line: [rule] message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .base import all_rules, run_lint
+
+
+def _repo_root() -> str:
+    # byteps_tpu/tools/lint -> byteps_tpu/tools -> byteps_tpu -> repo
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="byteps-lint",
+        description="project-native static analysis "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("--root", default=_repo_root(),
+                        help="tree to lint (default: this repo)")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated subset of rules")
+    parser.add_argument("--list", action="store_true",
+                        help="list rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for rule in all_rules():
+            print(f"{rule.name}: {rule.doc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings = run_lint(args.root, rules or None)
+    except ValueError as e:
+        print(f"byteps-lint: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    n_rules = len(rules) if rules else len(all_rules())
+    if findings:
+        print(f"byteps-lint: {len(findings)} finding(s) "
+              f"({n_rules} rule(s) run)")
+        return 1
+    print(f"byteps-lint: clean ({n_rules} rule(s) run)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Minimal C++ fact extraction from ``native/ps.cc``.
+
+Not a parser — targeted regexes over the comment-stripped source for
+exactly the declarations that form the cross-language wire contract:
+the packed ``MsgHeader`` struct, its ``static_assert`` size, ``kMagic``,
+and the ``WireCodec`` / ``DType`` enums. The wire-layout rule treats
+these as ground truth and diffs every Python mirror against them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+# Fixed-width integer types only: the wire header must not contain
+# anything whose size is platform-dependent.
+CTYPE_SIZES = {
+    "uint8_t": 1, "int8_t": 1, "uint16_t": 2, "int16_t": 2,
+    "uint32_t": 4, "int32_t": 4, "uint64_t": 8, "int64_t": 8,
+}
+
+# struct-module format char per C type (little-endian "<" prefix added
+# by the caller; pack(push, 1) means no padding either side).
+CTYPE_FMT = {
+    "uint8_t": "B", "int8_t": "b", "uint16_t": "H", "int16_t": "h",
+    "uint32_t": "I", "int32_t": "i", "uint64_t": "Q", "int64_t": "q",
+}
+
+
+@dataclasses.dataclass
+class HeaderInfo:
+    fields: List[Tuple[str, str]]        # (ctype, name) in wire order
+    line: int                            # struct declaration line
+    asserted_size: Optional[int]         # static_assert(sizeof==N)
+    assert_line: int
+    magic: Optional[int]
+    magic_line: int
+
+    @property
+    def computed_size(self) -> Optional[int]:
+        try:
+            return sum(CTYPE_SIZES[t] for t, _ in self.fields)
+        except KeyError:
+            return None
+
+    @property
+    def fmt(self) -> Optional[str]:
+        """Expected struct-module format ("<" + one char per field)."""
+        try:
+            return "<" + "".join(CTYPE_FMT[t] for t, _ in self.fields)
+        except KeyError:
+            return None
+
+
+def _strip_comments(text: str) -> str:
+    """Blank out // and /* */ comments, preserving newlines so line
+    numbers computed on the stripped text match the original."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        if text.startswith("//", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            seg = text[i:(n if j < 0 else j + 2)]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        else:
+            out.append(text[i])
+            i += 1
+    return "".join(out)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def parse_header(text: str, struct_name: str = "MsgHeader"
+                 ) -> Optional[HeaderInfo]:
+    stripped = _strip_comments(text)
+    m = re.search(r"struct\s+%s\s*\{(.*?)\};" % re.escape(struct_name),
+                  stripped, re.S)
+    if not m:
+        return None
+    fields = re.findall(r"(\w+)\s+(\w+)\s*;", m.group(1))
+    sa = re.search(
+        r"static_assert\(\s*sizeof\(%s\)\s*==\s*(\d+)"
+        % re.escape(struct_name), stripped)
+    mg = re.search(r"kMagic\s*=\s*(0[xX][0-9a-fA-F]+|\d+)", stripped)
+    return HeaderInfo(
+        fields=fields,
+        line=_line_of(stripped, m.start()),
+        asserted_size=int(sa.group(1)) if sa else None,
+        assert_line=_line_of(stripped, sa.start()) if sa else 0,
+        magic=int(mg.group(1), 0) if mg else None,
+        magic_line=_line_of(stripped, mg.start()) if mg else 0,
+    )
+
+
+def parse_enum(text: str, enum_name: str) -> Dict[str, int]:
+    """``enum Name [: type] { A = 1, B, ... };`` -> {A: 1, B: 2, ...}."""
+    stripped = _strip_comments(text)
+    m = re.search(
+        r"enum\s+%s\s*(?::\s*\w+)?\s*\{(.*?)\};" % re.escape(enum_name),
+        stripped, re.S)
+    if not m:
+        return {}
+    out: Dict[str, int] = {}
+    nxt = 0
+    for entry in m.group(1).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        em = re.match(r"(\w+)(?:\s*=\s*(0[xX][0-9a-fA-F]+|\d+))?", entry)
+        if not em:
+            continue
+        if em.group(2) is not None:
+            nxt = int(em.group(2), 0)
+        out[em.group(1)] = nxt
+        nxt += 1
+    return out
+
+
+def getenv_reads(text: str) -> List[Tuple[str, int]]:
+    """(var, line) for every ``getenv("X")`` in a C++ source."""
+    stripped = _strip_comments(text)
+    return [(m.group(1), _line_of(stripped, m.start()))
+            for m in re.finditer(r'getenv\(\s*"([A-Z][A-Z0-9_]*)"',
+                                 stripped)]

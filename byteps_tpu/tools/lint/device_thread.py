@@ -1,0 +1,252 @@
+"""Rule ``device-thread``: io_callback taps must not block.
+
+Historical bug class (PR 2, found the hard way): a function handed to
+``jax.experimental.io_callback`` / ``pure_callback`` runs on the
+device-dispatch thread, and its array arguments are LAZY — touching
+one (``int(arr)``, ``np.asarray(arr)``) re-enters the very executor
+running the tapped program and self-deadlocks the step at the next
+collective. The same goes for any blocking call (lock acquisition,
+``Future.result()``, ``queue.get()``, condition waits): the tap must
+only ENQUEUE to a worker thread and return.
+
+The rule resolves the callback argument of every
+``io_callback(f, ...)`` / ``pure_callback(f, ...)`` call (a bare
+function name, ``self.<method>``, a lambda, or any of those behind
+``functools.partial(f, ...)``) and scans that function's body —
+lexically, not transitively — for:
+
+- materialization of a tap parameter: ``int``/``float``/``bool``/
+  ``np.asarray``/``np.array``/``np.copy`` applied to a parameter, or
+  ``param.item()`` / ``param.tolist()`` / ``param.block_until_ready()``;
+- blocking calls: ``with`` on a lock-ish attribute, ``.acquire()``,
+  ``.result()``, ``.wait()``/``.wait_for()``, zero-positional-arg
+  ``.join()`` (the Thread.join shape — ``"/".join(parts)`` and
+  ``os.path.join(...)`` carry args and are not flagged) and
+  zero-positional-arg ``.get()`` (the queue signature), ``time.sleep``.
+
+A tap the rule CANNOT resolve to a function defined in the same module
+is itself a finding, never a silent pass: an unscannable tap is exactly
+where the next PR 2 deadlock hides. Define the tap locally (the
+project convention) or suppress at the registration site with a WHY.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .base import Finding, Project, Rule
+
+_CALLBACK_NAMES = {"io_callback", "pure_callback"}
+_MATERIALIZE_BUILTINS = {"int", "float", "bool"}
+_MATERIALIZE_NP = {"asarray", "array", "copy"}
+_MATERIALIZE_METHODS = {"item", "tolist", "block_until_ready"}
+# .acquire()/.result()/.wait()/.wait_for() have no common non-blocking
+# homonyms; .join() does (str.join, os.path.join), so it only counts
+# when called with no positional args (the Thread.join() shape) on a
+# non-literal receiver.
+_BLOCKING_METHODS = {"acquire", "result", "wait", "wait_for"}
+_LOCKISH = ("lock", "mu", "cv", "sem", "cond")
+# Receivers whose lambda arguments run LATER on another thread — only
+# these defer; a lambda anywhere else in the tap (sorted key=, an
+# immediately-invoked (lambda: ...)()) executes on the device thread
+# and is scanned like inline code.
+_DEFER_CALLEES = {"submit", "put", "put_nowait", "add_done_callback",
+                  "call_soon", "call_soon_threadsafe", "apply_async",
+                  "defer", "Thread", "Timer"}
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _tap_ref(call: ast.Call) -> Optional[ast.AST]:
+    """The AST node a tap call registers as its callback: the first
+    positional arg or the ``callback=`` keyword, seen through
+    ``functools.partial``."""
+    arg: Optional[ast.AST] = call.args[0] if call.args else None
+    if arg is None:
+        for kw in call.keywords:
+            if kw.arg == "callback":
+                arg = kw.value
+                break
+    if arg is None:
+        return None
+    if isinstance(arg, ast.Call) and _callee_name(arg.func) == "partial" \
+            and arg.args:
+        arg = arg.args[0]
+    return arg
+
+
+def _ref_name(ref: ast.AST) -> Optional[str]:
+    """Local def name a callback ref resolves to: a bare name or a
+    ``self.<method>`` attribute (methods land in the same per-module
+    def table)."""
+    if isinstance(ref, ast.Name):
+        return ref.id
+    if isinstance(ref, ast.Attribute) and isinstance(ref.value, ast.Name) \
+            and ref.value.id == "self":
+        return ref.attr
+    return None
+
+
+class _TapScan(ast.NodeVisitor):
+    """Scan one tap body (a FunctionDef or a Lambda)."""
+
+    def __init__(self, rel: str, fn: ast.AST, findings: List[Finding]):
+        self.rel = rel
+        self.fn = fn
+        self.name = getattr(fn, "name", "<lambda>")
+        self.findings = findings
+        self.params: Set[str] = {a.arg for a in fn.args.args
+                                 + fn.args.posonlyargs
+                                 + fn.args.kwonlyargs}
+        self._deferred: Set[ast.Lambda] = set()
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "device-thread", self.rel, node.lineno,
+            f"tap function {self.name}() {what} — io_callback taps "
+            f"run on the device-dispatch thread and must only enqueue "
+            f"(materializing a lazy callback arg or blocking here "
+            f"self-deadlocks the step at the next collective; PR 2)"))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.fn:
+            self.generic_visit(node)
+        # nested defs are not executed on the device thread
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a lambda handed to a deferral site (pool.submit(lambda:
+        # q.get())) runs later on a worker thread, like a nested def;
+        # any other lambda (sorted key=, an immediately-invoked
+        # (lambda: ...)()) executes right here on the device thread
+        if node is self.fn or node not in self._deferred:
+            self.generic_visit(node)
+
+    def _is_param(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.params
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            expr = item.context_expr
+            name = None
+            if isinstance(expr, ast.Attribute):
+                name = expr.attr
+            elif isinstance(expr, ast.Name):
+                name = expr.id
+            if name is not None and any(t in name.lower()
+                                        for t in _LOCKISH):
+                self._flag(node, f"acquires lock {name!r}")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = _callee_name(func)
+        if name in _DEFER_CALLEES:
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                # partial is transparent here exactly as it is when
+                # resolving the tap callback itself (_tap_ref)
+                if isinstance(arg, ast.Call) \
+                        and _callee_name(arg.func) == "partial":
+                    for inner in list(arg.args) + [kw.value for kw
+                                                   in arg.keywords]:
+                        if isinstance(inner, ast.Lambda):
+                            self._deferred.add(inner)
+                elif isinstance(arg, ast.Lambda):
+                    self._deferred.add(arg)
+        if isinstance(func, ast.Name):
+            if name in _MATERIALIZE_BUILTINS and node.args \
+                    and self._is_param(node.args[0]):
+                self._flag(node, f"materializes parameter "
+                                 f"{node.args[0].id!r} via {name}()")
+        elif isinstance(func, ast.Attribute):
+            recv = func.value
+            if name in _MATERIALIZE_NP and isinstance(recv, ast.Name) \
+                    and recv.id in ("np", "numpy", "jnp") and node.args \
+                    and self._is_param(node.args[0]):
+                self._flag(node, f"materializes parameter "
+                                 f"{node.args[0].id!r} via "
+                                 f"{recv.id}.{name}()")
+            elif name in _MATERIALIZE_METHODS and self._is_param(recv):
+                self._flag(node, f"materializes parameter {recv.id!r} "
+                                 f"via .{name}()")
+            elif name in _BLOCKING_METHODS and not isinstance(
+                    recv, ast.Constant):
+                self._flag(node, f"calls blocking .{name}()")
+            elif name == "join" and not node.args \
+                    and not isinstance(recv, ast.Constant):
+                self._flag(node, "calls blocking .join()")
+            elif name == "get" and not node.args and not any(
+                    kw.arg not in ("timeout", "block")
+                    for kw in node.keywords) and not any(
+                    kw.arg == "block" and isinstance(kw.value,
+                                                     ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords):
+                # block=False is the explicit NON-blocking drain probe
+                self._flag(node, "calls blocking .get()")
+            elif name == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id == "time":
+                self._flag(node, "calls time.sleep()")
+        self.generic_visit(node)
+
+
+class DeviceThreadRule(Rule):
+    name = "device-thread"
+    doc = ("functions passed to io_callback/pure_callback must not "
+           "block or materialize their lazy args (the PR 2 "
+           "self-deadlock class)")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in project.py_files():
+            tree = project.tree(path)
+            if tree is None:
+                continue
+            rel = project.rel(path)
+            defs: Dict[str, ast.FunctionDef] = {}
+            sites: List[ast.Call] = []
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    defs[node.name] = node
+                elif isinstance(node, ast.Call) \
+                        and _callee_name(node.func) in _CALLBACK_NAMES:
+                    sites.append(node)
+            scanned: Set[str] = set()
+            for call in sites:
+                ref = _tap_ref(call)
+                if ref is None:
+                    continue  # no callback arg: not a tap registration
+                if isinstance(ref, ast.Lambda):
+                    _TapScan(rel, ref, findings).visit(ref)
+                    continue
+                name = _ref_name(ref)
+                fn = defs.get(name) if name is not None else None
+                if fn is not None:
+                    if name not in scanned:
+                        scanned.add(name)
+                        _TapScan(rel, fn, findings).visit(fn)
+                    continue
+                # fail CLOSED: a tap the rule cannot see is where the
+                # next deadlock hides — never a silent pass
+                what = (f"callback {name!r} is not defined in this "
+                        f"module" if name is not None else
+                        "callback expression cannot be resolved to a "
+                        "function")
+                findings.append(Finding(
+                    "device-thread", rel, call.lineno,
+                    f"{what} — the rule scans taps lexically and "
+                    f"cannot verify this one never blocks on the "
+                    f"device-dispatch thread (PR 2 deadlock class); "
+                    f"define the tap in this module or suppress here "
+                    f"with a WHY"))
+        return findings

@@ -1,0 +1,200 @@
+"""Rule ``env-sync``: every BYTEPS_*/DMLC_* knob is documented, every
+documented knob exists, and config defaults match the docs.
+
+Historical bug class: each PR adds knobs (PR 6: wire retry/chaos,
+PR 9: seven codec-plane vars) and ``docs/env.md`` is updated by
+memory; a missed row means an operator cannot discover the knob, a
+stale default means they reason from the wrong baseline (the
+``BYTEPS_PARTITION_BYTES`` row drifted from the code's 4096000 to a
+plausible-but-wrong 4 MiB). Three checks:
+
+1. every ``BYTEPS_``/``DMLC_`` name READ in package code (Python call
+   sites / env subscripts — docstrings and log messages do not count —
+   AND native ``getenv``) appears somewhere in ``docs/env.md``;
+2. every var named in an env.md TABLE row is referenced somewhere in
+   code (a documented knob nothing reads is a lie);
+3. for single-var table rows read through ``config.py``'s typed
+   helpers (``_env_int``/``_env_bool``), the row's default equals the
+   code default (module-level constants are resolved). String-typed
+   knobs are presence-checked only — their doc cells are often prose
+   ("auto", "partition-dependent").
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import cpp
+from .base import Finding, Project, Rule
+
+_VAR_NAME_RE = re.compile(r"(?:BYTEPS|DMLC)_[A-Z0-9_]+")
+_ROW_RE = re.compile(r"^\s*\|(.+)")
+_TICKED_RE = re.compile(r"`((?:BYTEPS|DMLC)_[A-Z0-9_]+)`")
+_ANY_VAR_RE = re.compile(r"\b((?:BYTEPS|DMLC)_[A-Z0-9_]+)\b")
+
+
+def _py_env_refs(tree) -> List[Tuple[str, int]]:
+    """(var, line) for env-var string literals in READ positions: the
+    first argument of any call (``os.environ.get("X")``, ``getenv``,
+    the typed ``_env_*`` helpers, local wrappers like the codec
+    plane's ``env()``) or a subscript key (``environ["X"]``).
+    Deliberately AST-based, not a text regex: a knob quoted in a
+    docstring, comment or log message is NOT a read — counting those
+    would both raise false undocumented-read findings and keep stale
+    env.md rows alive forever (the drift class this rule exists to
+    catch)."""
+    refs: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args:
+            cand = node.args[0]
+        elif isinstance(node, ast.Subscript):
+            cand = node.slice
+        else:
+            continue
+        if isinstance(cand, ast.Constant) and isinstance(cand.value, str) \
+                and _VAR_NAME_RE.fullmatch(cand.value):
+            refs.append((cand.value, node.lineno))
+    return refs
+
+
+def _doc_rows(lines: List[str]):
+    """(vars, default_cell, line) per table row naming at least one
+    env var; header/separator rows carry none."""
+    for i, text in enumerate(lines, start=1):
+        if not _ROW_RE.match(text):
+            continue
+        cells = [c.strip() for c in text.strip().strip("|").split("|")]
+        if not cells:
+            continue
+        names = _TICKED_RE.findall(cells[0])
+        if names:
+            default = cells[1] if len(cells) > 1 else ""
+            yield names, default, i
+
+
+def _config_defaults(project: Project) -> Dict[str, Tuple[object, str]]:
+    """var -> (default value, helper name) from config.py's from_env
+    reads, with module-level constants resolved."""
+    out: Dict[str, Tuple[object, str]] = {}
+    cfg = None
+    for p in project.py_files():
+        if os.path.basename(p) == "config.py":
+            cfg = p
+            break
+    if cfg is None:
+        return out
+    tree = project.tree(cfg)
+    if tree is None:
+        return out
+    consts: Dict[str, object] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant):
+            consts[node.targets[0].id] = node.value.value
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("_env_int", "_env_bool", "_env_str")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            continue
+        var = node.args[0].value
+        helper = node.func.id
+        default: object = False if helper == "_env_bool" else None
+        if len(node.args) > 1:
+            d = node.args[1]
+            if isinstance(d, ast.Constant):
+                default = d.value
+            elif isinstance(d, ast.Name) and d.id in consts:
+                default = consts[d.id]
+            else:
+                continue  # computed default: not statically comparable
+        elif helper != "_env_bool":
+            continue
+        out[var] = (default, helper)
+    return out
+
+
+def _default_token(cell: str) -> Optional[str]:
+    """First meaningful token of a doc default cell ("0 (off)" -> "0";
+    "—" and prose -> None)."""
+    cell = cell.replace("`", "").strip()
+    if not cell:
+        return None
+    tok = cell.split()[0]
+    return tok if re.fullmatch(r"-?\d+(\.\d+)?", tok) else None
+
+
+class EnvSyncRule(Rule):
+    name = "env-sync"
+    doc = ("BYTEPS_*/DMLC_* knobs read in code and rows in docs/env.md "
+           "must agree, including config.py defaults")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        env_md = project.doc("env.md")
+        if env_md is None:
+            return findings  # fixture without docs: nothing to sync
+
+        # -- code references ------------------------------------------ #
+        code_refs: Dict[str, Tuple[str, int]] = {}
+        for path in project.env_scan_files():
+            if path.endswith(".cc"):
+                text = project.text(path)
+                refs = cpp.getenv_reads(text) if text is not None else []
+            else:
+                tree = project.tree(path)
+                refs = _py_env_refs(tree) if tree is not None else []
+            for var, line in refs:
+                code_refs.setdefault(var, (project.rel(path), line))
+
+        # -- doc side -------------------------------------------------- #
+        doc_lines = project.lines(env_md)
+        doc_text = project.text(env_md) or ""
+        doc_any = set(_ANY_VAR_RE.findall(doc_text))
+        rel_doc = project.rel(env_md)
+
+        # 1: code reads must be documented (anywhere in env.md)
+        for var in sorted(code_refs):
+            if var not in doc_any:
+                path, line = code_refs[var]
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"{var} is read in code but has no mention in "
+                    f"docs/env.md — operators cannot discover it"))
+
+        # 2 + 3: table rows must be read, and typed defaults must match
+        defaults = _config_defaults(project)
+        for names, default_cell, line in _doc_rows(doc_lines):
+            for var in names:
+                if var not in code_refs:
+                    findings.append(Finding(
+                        self.name, rel_doc, line,
+                        f"docs/env.md documents {var} but nothing in "
+                        f"the code reads it — stale row?"))
+            if len(names) != 1 or names[0] not in defaults:
+                continue
+            var = names[0]
+            code_default, helper = defaults[var]
+            tok = _default_token(default_cell)
+            if tok is None:
+                continue  # prose default: presence-only
+            doc_val = float(tok)
+            if helper == "_env_bool":
+                code_val = 1.0 if code_default else 0.0
+            else:
+                try:
+                    code_val = float(code_default)
+                except (TypeError, ValueError):
+                    continue
+            if doc_val != code_val:
+                findings.append(Finding(
+                    self.name, rel_doc, line,
+                    f"docs/env.md says {var} defaults to {tok} but "
+                    f"config.py says {code_default!r} — fix whichever "
+                    f"side drifted"))
+        return findings

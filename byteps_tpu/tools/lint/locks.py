@@ -1,0 +1,257 @@
+"""Rule ``guarded-by``: annotated shared state is only touched under
+its lock.
+
+Historical bug class: the scheduler/registry/metrics/codec-plane hot
+path accumulates lock-guarded state with every PR (priority pins,
+retry parks, codec plans, server loads), and the discipline — WHICH
+lock covers WHICH attribute — existed only in comments and reviewers'
+heads. PR 5's registry load-accounting imbalance and PR 6's
+sibling-failover race were both "touched guarded state on the wrong
+side of the lock" bugs found at runtime.
+
+Contract: an attribute assigned in a class body with a trailing
+``# guarded-by: <lock>`` comment (``|``/``,`` separates alternatives —
+e.g. a Condition and the Lock it wraps) may only be read or written
+lexically inside a ``with self.<lock>:`` block of that class.
+Exemptions:
+
+- ``__init__`` (construction precedes sharing);
+- methods/functions whose name ends in ``_locked`` (the project's
+  caller-holds-the-lock convention) — but NOT as a blanket pass: when
+  the class's guarded attributes sit under a single lock group, that
+  group is what the caller is assumed to hold; when the class mixes
+  locks (e.g. ``_mu`` + ``_ingest_mu``), the convention is ambiguous
+  and the ``def`` line must say which with ``# caller-holds: <lock>``
+  — otherwise touching an attribute guarded by a DIFFERENT lock than
+  the caller actually holds would pass silently, which is the exact
+  wrong-side-of-the-lock class this rule exists for;
+- per-line suppression for documented racy reads
+  (``# bps-lint: disable=guarded-by`` with a WHY next to it).
+
+Lexical means lexical: code inside a nested ``def`` runs later on an
+unknown thread, so held locks do NOT propagate into it (lambdas and
+comprehensions DO keep them — condition-variable predicates run under
+the lock).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from .base import Finding, Project, Rule
+
+_ANNOT_RE = re.compile(r"#\s*guarded-by:\s*([\w|,\s]+)")
+_HOLDS_RE = re.compile(r"#\s*caller-holds:\s*([\w|,\s]+)")
+_SELF_ATTR_RE = re.compile(r"self\.(\w+)")
+
+
+def _caller_holds(project: Project, path: str, fn) -> Set[str]:
+    """Locks a ``# caller-holds: <lock>`` annotation on the ``def``
+    line (or the line directly above) says the caller must hold."""
+    lines = project.lines(path)
+    for ln in (fn.lineno, fn.lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _HOLDS_RE.search(lines[ln - 1])
+            if m:
+                return {tok.strip()
+                        for tok in re.split(r"[|,]", m.group(1))
+                        if tok.strip()}
+    return set()
+
+
+def _class_annotations(project: Project, path: str, tree: ast.AST,
+                       findings: List[Finding]):
+    """class name -> {attr: {lock, ...}} from trailing comments. An
+    annotation that cannot be bound to a ``self.<attr>`` in a class is
+    appended to ``findings`` — a guard comment that protects nothing
+    must never silently disarm."""
+    lines = project.lines(path)
+    rel = project.rel(path)
+    spans = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            spans[node.name] = (node.lineno,
+                                max(getattr(node, "end_lineno",
+                                            node.lineno), node.lineno))
+    out: Dict[str, Dict[str, Set[str]]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _ANNOT_RE.search(text)
+        if not m:
+            continue
+        # the annotated attribute: same line, the next line when the
+        # annotation stands alone (annotation-above style), or the
+        # previous line when it trails a wrapped statement
+        attr_m = _SELF_ATTR_RE.search(text)
+        if attr_m is None:
+            if text.lstrip().startswith("#") and i < len(lines):
+                attr_m = _SELF_ATTR_RE.search(lines[i])
+            elif i >= 2:
+                attr_m = _SELF_ATTR_RE.search(lines[i - 2])
+        cls_hit = next((cls for cls, (lo, hi) in spans.items()
+                        if lo <= i <= hi), None)
+        if attr_m and cls_hit is not None:
+            locks = {tok.strip()
+                     for tok in re.split(r"[|,]", m.group(1))
+                     if tok.strip()}
+            attrs = out.setdefault(cls_hit, {})
+            prev = attrs.get(attr_m.group(1))
+            if prev is not None and prev != locks:
+                # a re-annotation naming a DIFFERENT lock is author
+                # error (a refactor swapped the lock on one site only).
+                # FIRST annotation wins for enforcement — unioning
+                # would accept either lock, weaker than either
+                # annotation alone
+                findings.append(Finding(
+                    "guarded-by", rel, i,
+                    f"conflicting '# guarded-by:' annotations for "
+                    f"{cls_hit}.{attr_m.group(1)}: "
+                    f"{'|'.join(sorted(locks))} here vs "
+                    f"{'|'.join(sorted(prev))} earlier — pick one "
+                    f"(the first is enforced until then)"))
+            elif prev is None:
+                attrs[attr_m.group(1)] = locks
+        else:
+            what = ("does not sit inside a class body"
+                    if attr_m else "binds to no self.<attr> on this, "
+                    "the next, or the previous line")
+            findings.append(Finding(
+                "guarded-by", rel, i,
+                f"'# guarded-by:' annotation {what} — it guards "
+                f"nothing; attach it to the attribute assignment or "
+                f"delete it"))
+    return out
+
+
+class _Checker(ast.NodeVisitor):
+    """Walk one method tracking lexically held ``with self.<lock>``
+    blocks."""
+
+    def __init__(self, rule: str, rel: str, cls: str,
+                 guarded: Dict[str, Set[str]], findings: List[Finding],
+                 entry_held: Set[str]):
+        self.rule = rule
+        self.rel = rel
+        self.cls = cls
+        self.guarded = guarded
+        self.findings = findings
+        self.held: Set[str] = set()
+        self.entry_held = entry_held
+        self.func_stack: List[str] = []
+
+    def run(self, node) -> None:
+        """Check the class-body method ``node``, entering with the
+        locks its caller is assumed to hold (``set()`` for ordinary
+        methods; the caller-holds set for ``*_locked`` ones)."""
+        self.func_stack.append(node.name)
+        self.held = set(self.entry_held)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.func_stack.pop()
+
+    # -- lock tracking -------------------------------------------------- #
+
+    @staticmethod
+    def _lock_name(expr: ast.AST):
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        added = set()
+        for item in node.items:
+            name = self._lock_name(item.context_expr)
+            if name is not None and name not in self.held:
+                added.add(name)
+        self.held |= added
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    # -- scope boundaries ----------------------------------------------- #
+
+    def _visit_func(self, node) -> None:
+        saved, self.held = self.held, set()
+        self.func_stack.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.func_stack.pop()
+        self.held = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_func(node)
+
+    # -- guarded accesses ----------------------------------------------- #
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and node.attr in self.guarded:
+            fn = self.func_stack[-1] if self.func_stack else "?"
+            locks = self.guarded[node.attr]
+            if fn != "__init__" and not (locks & self.held):
+                hint = ""
+                if fn.endswith("_locked") and len(self.func_stack) == 1 \
+                        and not self.entry_held:
+                    # the caller-holds convention did not cover this
+                    # attribute's lock — the class mixes lock groups,
+                    # so WHICH lock the caller holds must be spelled out
+                    hint = (" (the class mixes lock groups, so the "
+                            "*_locked convention is ambiguous here — "
+                            "annotate the def with '# caller-holds: "
+                            "<lock>')")
+                self.findings.append(Finding(
+                    "guarded-by", self.rel, node.lineno,
+                    f"{self.cls}.{node.attr} is guarded-by "
+                    f"{'|'.join(sorted(locks))} but {fn}() touches it "
+                    f"without holding the lock{hint}"))
+        self.generic_visit(node)
+
+
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    doc = ("attributes annotated '# guarded-by: <lock>' may only be "
+           "accessed inside 'with self.<lock>:' in their class")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in project.py_files():
+            tree = project.tree(path)
+            if tree is None:
+                continue
+            annots = _class_annotations(project, path, tree, findings)
+            if not annots:
+                continue
+            rel = project.rel(path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                guarded = annots.get(node.name)
+                if not guarded:
+                    continue
+                # the lock set a *_locked method's caller is assumed
+                # to hold: the intersection of every guarded attr's
+                # alternatives. Non-empty (e.g. {_mu} across '_mu' and
+                # '_mu|_cv' — one lock family) means one lock satisfies
+                # every attr, so the bare convention stays unambiguous;
+                # empty (truly mixed locks, '_mu' vs '_ingest_mu') forces
+                # an explicit '# caller-holds:' annotation
+                single = set.intersection(
+                    *(set(locks) for locks in guarded.values()))
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        entry_held: Set[str] = set()
+                        if stmt.name.endswith("_locked"):
+                            entry_held = (_caller_holds(project, path,
+                                                        stmt) or single)
+                        checker = _Checker(self.name, rel, node.name,
+                                           guarded, findings, entry_held)
+                        checker.run(stmt)
+        return findings

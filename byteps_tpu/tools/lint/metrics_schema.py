@@ -1,0 +1,159 @@
+"""Rule ``metrics-schema``: instrument names in code and the
+machine-checked ```schema block in docs/observability.md agree, both
+directions.
+
+Historical bug class: the PR 3 runtime liveness guard
+(``tests/test_metrics.py::test_documented_schema_is_live``) catches a
+documented path that fails to resolve in a LIVE snapshot — but only
+for instruments a dense CPU train step happens to create, and only
+docs->code. A counter created in code but never documented (or a
+schema row that only a TPU/sharded run would instantiate) sails
+through. This rule closes it statically:
+
+- every ``counters.X`` / ``gauges.X`` / ``histograms.X`` schema entry
+  must correspond to a ``.counter("X")`` / ``.gauge("X")`` /
+  ``.histogram("X")`` creation site in code — exact literal, or the
+  literal prefix of an f-string site (``f"codec/active/{tier}"``
+  covers ``codec/active/dense``);
+- every static instrument literal in code must appear in the schema
+  block of the same kind; every dynamic (f-string) site must have at
+  least one documented instance of its prefix.
+
+``arena.*`` / ``steps.*`` entries are live-collected sections, owned
+by the runtime guard, and skipped here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .base import Finding, Project, Rule
+
+_KINDS = {"counter": "counters", "gauge": "gauges",
+          "histogram": "histograms"}
+_SCHEMA_RE = re.compile(r"```schema\n(.*?)```", re.S)
+
+
+def _schema_entries(project: Project, path: str):
+    """(kind, name, line) from the fenced schema block."""
+    text = project.text(path) or ""
+    m = _SCHEMA_RE.search(text)
+    if not m:
+        return None
+    start_line = text.count("\n", 0, m.start(1)) + 1
+    out = []
+    for i, row in enumerate(m.group(1).splitlines()):
+        row = row.strip()
+        if not row:
+            continue
+        kind, _, name = row.partition(".")
+        out.append((kind, name, start_line + i))
+    return out
+
+
+def _receiver_is_tracer(func: ast.Attribute) -> bool:
+    v = func.value
+    name = v.id if isinstance(v, ast.Name) else (
+        v.attr if isinstance(v, ast.Attribute) else "")
+    return "tracer" in name.lower()
+
+
+def _instrument_sites(project: Project):
+    """static: (kind, name) -> (rel, line); dynamic: (kind, prefix) ->
+    (rel, line) for f-string creation sites."""
+    static: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    dynamic: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for path in project.py_files():
+        tree = project.tree(path)
+        if tree is None:
+            continue
+        rel = project.rel(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KINDS
+                    and node.args):
+                continue
+            if _receiver_is_tracer(node.func):
+                continue  # Chrome-trace counter events, not instruments
+            kind = _KINDS[node.func.attr]
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                static.setdefault((kind, arg.value), (rel, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant):
+                        prefix += str(part.value)
+                    else:
+                        break
+                if prefix:
+                    dynamic.setdefault((kind, prefix),
+                                       (rel, node.lineno))
+    return static, dynamic
+
+
+class MetricsSchemaRule(Rule):
+    name = "metrics-schema"
+    doc = ("instrument names created in code and the ```schema block "
+           "in docs/observability.md must agree, both directions")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        obs = project.doc("observability.md")
+        if obs is None:
+            return findings  # fixture without docs
+        rel_doc = project.rel(obs)
+        entries = _schema_entries(project, obs)
+        if entries is None:
+            findings.append(Finding(
+                self.name, rel_doc, 1,
+                "docs/observability.md lost its ```schema block — the "
+                "snapshot contract is unverifiable"))
+            return findings
+        static, dynamic = _instrument_sites(project)
+        if not static and not dynamic:
+            return findings  # fixture tree without instrumented code
+
+        doc_names: Dict[str, Set[str]] = {k: set() for k in
+                                          _KINDS.values()}
+        for kind, name_, _line in entries:
+            if kind in doc_names:
+                doc_names[kind].add(name_)
+
+        # docs -> code: every schema instrument must be creatable
+        for kind, name_, line in entries:
+            if kind not in doc_names:
+                continue  # arena./steps. sections: runtime guard's job
+            if (kind, name_) in static:
+                continue
+            if any(dk == kind and name_.startswith(prefix)
+                   for (dk, prefix) in dynamic):
+                continue
+            findings.append(Finding(
+                self.name, rel_doc, line,
+                f"schema documents {kind}.{name_} but no "
+                f".{_kind_method(kind)}() site in the code creates it"))
+
+        # code -> docs: every creation site must be documented
+        for (kind, name_), (rel, line) in sorted(static.items()):
+            if name_ not in doc_names[kind]:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"{kind[:-1]} {name_!r} is created in code but "
+                    f"missing from the docs/observability.md schema "
+                    f"block"))
+        for (kind, prefix), (rel, line) in sorted(dynamic.items()):
+            if not any(n.startswith(prefix) for n in doc_names[kind]):
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"dynamic {kind[:-1]} family {prefix!r}* has no "
+                    f"documented instance in the schema block"))
+        return findings
+
+
+def _kind_method(kind: str) -> str:
+    return {v: k for k, v in _KINDS.items()}[kind]

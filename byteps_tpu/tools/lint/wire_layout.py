@@ -1,0 +1,291 @@
+"""Rule ``wire-layout``: the cross-language wire header cannot drift.
+
+Historical bug class: the ``MsgHeader`` layout drifted twice already —
+36B -> 40B when the replay epoch landed (PR 6) and magic
+``0xB17E5001`` -> ``0xB17E5002`` when the codec tag landed (PR 9).
+Each time, every mirror (Python header constants, codec-id table,
+dtype codes) had to be found and updated by memory; a missed one
+means payload bytes misparsed as headers, or worse, dense bytes
+silently summed with codec payloads. This rule re-derives the layout
+from ``native/ps.cc`` (the ground truth: field list, ``static_assert``
+size, ``kMagic``, ``WireCodec``/``DType`` enums) and fails on ANY
+disagreement with the Python side, in both directions:
+
+- ``server/client.py`` ``WIRE_MAGIC`` / ``WIRE_HEADER_FMT`` /
+  ``WIRE_HEADER_BYTES`` — size, field order and magic;
+- ``core/codec_plane.py`` ``WIRE_CODEC_IDS`` — every codec name/id;
+- ``core/types.py`` ``DataType`` — every wire dtype code.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from . import cpp
+from .base import Finding, Project, Rule
+
+# DType enum name (ps.cc) per DataType member name (core/types.py).
+_DTYPE_TRANSLATE = (
+    ("BFLOAT16", "BF16"), ("FLOAT", "F"), ("UINT", "U"), ("INT", "I"),
+)
+
+
+def _py_to_cc_dtype(py_name: str) -> str:
+    for old, new in _DTYPE_TRANSLATE:
+        if py_name.startswith(old):
+            return new + py_name[len(old):]
+    return py_name
+
+
+def _module_constants(tree: ast.AST) -> Dict[str, Tuple[ast.AST, int]]:
+    out: Dict[str, Tuple[ast.AST, int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = (node.value, node.lineno)
+    return out
+
+
+class WireLayoutRule(Rule):
+    name = "wire-layout"
+    doc = ("native/ps.cc MsgHeader layout, magic and codec/dtype ids "
+           "must agree with every Python mirror (the 36B->40B drift "
+           "class)")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        src = project.native_source()
+        if src is None:
+            return findings  # no native tier in this tree: nothing to pin
+        text = project.text(src) or ""
+        rel_cc = project.rel(src)
+        hdr = cpp.parse_header(text)
+        if hdr is None:
+            findings.append(Finding(
+                self.name, rel_cc, 1,
+                "cannot parse struct MsgHeader out of the native source "
+                "— the wire contract is unverifiable"))
+            return findings
+
+        # internal consistency of the C++ side first
+        if hdr.computed_size is None:
+            findings.append(Finding(
+                self.name, rel_cc, hdr.line,
+                "MsgHeader contains a non-fixed-width field type; the "
+                "wire header must use uint8_t..uint64_t only"))
+            return findings
+        if hdr.asserted_size is None:
+            findings.append(Finding(
+                self.name, rel_cc, hdr.line,
+                f"missing static_assert(sizeof(MsgHeader) == "
+                f"{hdr.computed_size}) next to the struct"))
+        elif hdr.asserted_size != hdr.computed_size:
+            findings.append(Finding(
+                self.name, rel_cc, hdr.assert_line,
+                f"static_assert says sizeof(MsgHeader) == "
+                f"{hdr.asserted_size} but the declared fields sum to "
+                f"{hdr.computed_size}"))
+        if hdr.magic is None:
+            findings.append(Finding(
+                self.name, rel_cc, 1, "kMagic constant not found"))
+
+        findings += self._check_header_mirror(project, hdr, rel_cc)
+        findings += self._check_codec_ids(project, text, rel_cc)
+        findings += self._check_dtypes(project, text, rel_cc)
+        return findings
+
+    # -- WIRE_MAGIC / WIRE_HEADER_FMT / WIRE_HEADER_BYTES -------------- #
+
+    def _find_mirror(self, project: Project):
+        """Locate the Python module declaring the header mirror."""
+        for path in project.py_files():
+            tree = project.tree(path)
+            if tree is None:
+                continue
+            consts = _module_constants(tree)
+            if "WIRE_HEADER_FMT" in consts or "WIRE_MAGIC" in consts:
+                return path, consts
+        return None, {}
+
+    def _check_header_mirror(self, project: Project, hdr: cpp.HeaderInfo,
+                             rel_cc: str) -> List[Finding]:
+        findings: List[Finding] = []
+        path, consts = self._find_mirror(project)
+        if path is None:
+            findings.append(Finding(
+                self.name, rel_cc, hdr.line,
+                "no Python wire-header mirror found (expected "
+                "WIRE_MAGIC / WIRE_HEADER_FMT / WIRE_HEADER_BYTES in "
+                "server/client.py)"))
+            return findings
+        rel = project.rel(path)
+
+        def const_int(name: str) -> Tuple[Optional[int], int]:
+            node_line = consts.get(name)
+            if node_line is None:
+                return None, 0
+            node, line = node_line
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, int):
+                return node.value, line
+            return None, line
+
+        magic, magic_line = const_int("WIRE_MAGIC")
+        if magic is None:
+            findings.append(Finding(
+                self.name, rel, magic_line or 1,
+                "WIRE_MAGIC missing or not an int literal"))
+        elif hdr.magic is not None and magic != hdr.magic:
+            findings.append(Finding(
+                self.name, rel, magic_line,
+                f"WIRE_MAGIC is {magic:#010x} but native kMagic is "
+                f"{hdr.magic:#010x} — a magic bump must land on both "
+                f"sides in the same commit"))
+
+        fmt_node = consts.get("WIRE_HEADER_FMT")
+        expected_fmt = hdr.fmt
+        if fmt_node is None or not (
+                isinstance(fmt_node[0], ast.Constant)
+                and isinstance(fmt_node[0].value, str)):
+            findings.append(Finding(
+                self.name, rel, 1,
+                "WIRE_HEADER_FMT missing or not a str literal"))
+        else:
+            fmt, fmt_line = fmt_node[0].value, fmt_node[1]
+            try:
+                fmt_size = struct.calcsize(fmt)
+            except struct.error:
+                fmt_size = -1
+                findings.append(Finding(
+                    self.name, rel, fmt_line,
+                    f"WIRE_HEADER_FMT {fmt!r} is not a valid struct "
+                    f"format"))
+            if expected_fmt is not None and fmt != expected_fmt \
+                    and fmt_size >= 0:
+                findings.append(Finding(
+                    self.name, rel, fmt_line,
+                    f"WIRE_HEADER_FMT {fmt!r} disagrees with the native "
+                    f"field order {expected_fmt!r} "
+                    f"({', '.join(f'{t} {n}' for t, n in hdr.fields)})"))
+            elif fmt_size >= 0 and hdr.asserted_size is not None \
+                    and fmt_size != hdr.asserted_size:
+                findings.append(Finding(
+                    self.name, rel, fmt_line,
+                    f"WIRE_HEADER_FMT packs {fmt_size} bytes but the "
+                    f"native header is {hdr.asserted_size} bytes"))
+
+        size, size_line = const_int("WIRE_HEADER_BYTES")
+        if size is None:
+            findings.append(Finding(
+                self.name, rel, size_line or 1,
+                "WIRE_HEADER_BYTES missing or not an int literal"))
+        elif hdr.asserted_size is not None and size != hdr.asserted_size:
+            findings.append(Finding(
+                self.name, rel, size_line,
+                f"WIRE_HEADER_BYTES is {size} but the native header is "
+                f"{hdr.asserted_size} bytes (the 36B->40B drift class)"))
+        return findings
+
+    # -- WIRE_CODEC_IDS <-> enum WireCodec ----------------------------- #
+
+    def _check_codec_ids(self, project: Project, cc_text: str,
+                         rel_cc: str) -> List[Finding]:
+        findings: List[Finding] = []
+        enum = cpp.parse_enum(cc_text, "WireCodec")
+        table: Dict[str, int] = {}
+        path = line = None
+        for p in project.py_files():
+            tree = project.tree(p)
+            if tree is None:
+                continue
+            node_line = _module_constants(tree).get("WIRE_CODEC_IDS")
+            if node_line and isinstance(node_line[0], ast.Dict):
+                path, line = p, node_line[1]
+                for k, v in zip(node_line[0].keys, node_line[0].values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                            v, ast.Constant):
+                        table[k.value] = v.value
+                break
+        if not enum and not table:
+            return findings  # neither side has the adaptive plane
+        if not table:
+            findings.append(Finding(
+                self.name, rel_cc, 1,
+                "native enum WireCodec exists but no Python "
+                "WIRE_CODEC_IDS mirror was found"))
+            return findings
+        rel = project.rel(path)
+        if not enum:
+            findings.append(Finding(
+                self.name, rel, line,
+                "WIRE_CODEC_IDS exists but native enum WireCodec was "
+                "not found"))
+            return findings
+        for name, val in sorted(table.items()):
+            cc_name = "kCodec" + name.capitalize()
+            if cc_name not in enum:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"WIRE_CODEC_IDS[{name!r}] has no native enum "
+                    f"counterpart {cc_name}"))
+            elif enum[cc_name] != val:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"WIRE_CODEC_IDS[{name!r}] = {val} but native "
+                    f"{cc_name} = {enum[cc_name]} — id skew would make "
+                    f"the server validate the wrong codec tag"))
+        for cc_name, val in sorted(enum.items()):
+            if cc_name == "kCodecUntagged":
+                continue
+            py_name = cc_name[len("kCodec"):].lower()
+            if py_name not in table:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"native {cc_name} = {val} has no WIRE_CODEC_IDS "
+                    f"entry {py_name!r}"))
+        return findings
+
+    # -- DataType <-> enum DType --------------------------------------- #
+
+    def _check_dtypes(self, project: Project, cc_text: str,
+                      rel_cc: str) -> List[Finding]:
+        findings: List[Finding] = []
+        enum = cpp.parse_enum(cc_text, "DType")
+        if not enum:
+            return findings
+        py: Dict[str, Tuple[int, int]] = {}
+        path = None
+        for p in project.py_files():
+            tree = project.tree(p)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef) \
+                        and node.name == "DataType":
+                    for st in node.body:
+                        if isinstance(st, ast.Assign) and isinstance(
+                                st.targets[0], ast.Name) and isinstance(
+                                st.value, ast.Constant) and isinstance(
+                                st.value.value, int):
+                            py[st.targets[0].id] = (st.value.value,
+                                                    st.lineno)
+                    path = p
+                    break
+            if path:
+                break
+        if not py:
+            return findings  # fixture without a DataType mirror
+        rel = project.rel(path)
+        for py_name, (val, line) in sorted(py.items()):
+            cc_name = _py_to_cc_dtype(py_name)
+            if cc_name not in enum:
+                continue  # host-only dtypes need no wire code
+            if enum[cc_name] != val:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"DataType.{py_name} = {val} but native DType::"
+                    f"{cc_name} = {enum[cc_name]} — dtype code skew "
+                    f"folds payloads with the wrong element width"))
+        return findings

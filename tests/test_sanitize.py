@@ -115,6 +115,64 @@ print("STRESS_OK")
 """
 
 
+# Minimal deterministic repro of the PR-6 TSAN finding (the Waiter-pool
+# regression class): tight concurrent BLOCKING push/pull loops from 4
+# threads sharing one client's striped conns churn Waiter completions
+# across threads. Before the per-conn Waiter pool + explicitly
+# pthread-initialized Mu/Cv wrappers (PR 7 fix), heap/address reuse of
+# completed Waiters produced ~510 "double lock of a destroyed mutex"
+# reports within seconds of exactly this loop — so a regression fires
+# fast and deterministically. Kept SMALL (4 threads x 60 rounds, one
+# small key each + one shared contended key) so the whole test — TSAN
+# build included, content-hash-cached across the session — fits the
+# tier-1 budget; the full protocol burst stays in the slow tier above.
+_WAITER_SMOKE = r"""
+import threading, numpy as np
+import os, sys
+sys.path.insert(0, os.environ["BPS_REPO"])
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+
+PORT = int(os.environ["BPS_STRESS_PORT"])
+cfg = Config(num_workers=1, num_servers=1)
+server = threading.Thread(target=run_server, args=(PORT, cfg), daemon=True)
+server.start()
+
+CMD = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+client = PSClient([f"127.0.0.1:{PORT}"], worker_id=0)
+reg = TensorRegistry(cfg)
+ctxs = [reg.init_tensor(f"w{t}", 256 * 4, DataType.FLOAT32)
+        for t in range(4)]
+shared = reg.init_tensor("shared", 256 * 4, DataType.FLOAT32)
+for ctx in ctxs + [shared]:
+    client.init_tensor(ctx, np.zeros(256, np.float32))
+
+def worker(t):
+    rng = np.random.RandomState(t)
+    own = ctxs[t].partitions[0]
+    sp = shared.partitions[0]
+    out = np.empty(256, np.float32)
+    for _ in range(60):
+        client.zpush(own.server, own.key,
+                     rng.randn(256).astype(np.float32), CMD)
+        client.zpull(own.server, own.key, out, CMD)
+        # shared-key contention: Waiters of different threads complete
+        # interleaved on the same striped conns
+        client.zpush(sp.server, sp.key, np.ones(256, np.float32), CMD)
+        client.zpull(sp.server, sp.key, out, CMD)
+
+threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+client.close()
+server.join(timeout=20)
+print("SMOKE_OK")
+"""
+
+
 _TIERS = {
     # mode -> (runtime lib, options env var, options, error marker)
     "thread": ("libtsan.so", "TSAN_OPTIONS",
@@ -171,3 +229,48 @@ def test_sanitized_loopback_stress(tmp_path, mode):
     assert marker not in out, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert "STRESS_OK" in out, out[-4000:]
+
+
+def test_tsan_waiter_pool_smoke(tmp_path):
+    """Fast deterministic TSAN smoke (NOT slow — runs inside tier-1):
+    the PR-6 Waiter-pool minimal repro. A regression in the per-conn
+    Waiter pool or the pthread-initialized Mu/Cv wrappers reports
+    "double lock of a destroyed mutex" within seconds of this loop,
+    so the class is caught by the 870 s tier-1 gate instead of only by
+    the slow sanitize burst. The TSAN build is content-hash-cached
+    (~6 s cold on the 2-core box); the stress itself is ~4 threads x
+    60 blocking rounds."""
+    from byteps_tpu.utils.net import free_port
+
+    lib_name, opts_var, opts, marker = _TIERS["thread"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runtime = subprocess.run(
+        ["g++", f"-print-file-name={lib_name}"], capture_output=True,
+        text=True).stdout.strip()
+    if not os.path.isabs(runtime) or not os.path.exists(runtime):
+        pytest.skip(f"{lib_name} not available")
+
+    subprocess.run(
+        [sys.executable, "-c",
+         "import sys, os; sys.path.insert(0, os.environ['BPS_REPO']); "
+         "from byteps_tpu.native.build import build; build()"],
+        env={**os.environ, "BPS_REPO": repo, "BYTEPS_SANITIZE": "thread"},
+        check=True, capture_output=True, timeout=300)
+
+    script = tmp_path / "waiter_smoke.py"
+    script.write_text(_WAITER_SMOKE)
+    env = {
+        **os.environ,
+        "BPS_REPO": repo,
+        "BPS_STRESS_PORT": str(free_port()),
+        "BYTEPS_SANITIZE": "thread",
+        "LD_PRELOAD": runtime,
+        opts_var: opts,
+        "JAX_PLATFORMS": "cpu",
+    }
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=240)
+    out = proc.stdout + proc.stderr
+    assert marker not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "SMOKE_OK" in out, out[-4000:]
