@@ -202,16 +202,80 @@ def _cpu_put(x):
 
 
 def phase_probe() -> dict:
-    """Cheap liveness check of the default backend: one tiny matmul with
-    a host readback. A wedged tunnel hangs here (and the parent's
-    deadline catches it) instead of inside the train phase."""
-    jax = _setup_device_backend()
-    import jax.numpy as jnp
+    """Cheap liveness check of the default backend, instrumented to
+    ATTRIBUTE a wedge instead of dying as a bare watchdog rc=3 (every
+    BENCH round since r01 carried `value: null` with the probe killed
+    inside `jnp.ones` and nothing in the JSON saying where or why —
+    BENCH_r03–r05 tails). Three stages — backend import/device query, a
+    tiny-shape preflight (1-element ones + readback: isolates
+    allocation/transfer from compilation), then the 128x128 matmul —
+    each run on a worker thread under its own deadline. On a hang the
+    phase RETURNS a parseable result carrying the stage name and the
+    worker's live stack (faulthandler + sys._current_frames) instead of
+    waiting for the parent's kill; on an exception it returns the real
+    traceback. The parent copies `error`/`stage` into tunnel_diag, so a
+    dead round is attributable from BENCH_rNN.json alone."""
+    import faulthandler
+    import threading
+    import traceback
 
-    x = jnp.ones((128, 128), jnp.bfloat16)
-    s = float((x @ x).sum())
-    return {"ok": s == 128.0 * 128 * 128,
-            "platform": jax.devices()[0].platform}
+    faulthandler.enable()  # any later hard kill still dumps all stacks
+    stage_deadline_s = float(os.environ.get("BENCH_PROBE_STAGE_S", "25"))
+    state: dict = {}
+
+    def run_stage(name, fn):
+        box: dict = {}
+
+        def body():
+            try:
+                box["value"] = fn()
+            except BaseException:  # noqa: BLE001 - reported, not raised
+                box["error"] = traceback.format_exc()
+
+        t = threading.Thread(target=body, name=f"probe-{name}",
+                             daemon=True)
+        t.start()
+        t.join(stage_deadline_s)
+        if t.is_alive():
+            frame = sys._current_frames().get(t.ident)
+            stack = ("".join(traceback.format_stack(frame)) if frame
+                     else "<no frame>")
+            return None, (f"stage {name!r} hung > "
+                          f"{stage_deadline_s:.0f}s; worker stack:\n"
+                          f"{stack}")
+        if "error" in box:
+            return None, f"stage {name!r} raised:\n{box['error']}"
+        return box.get("value"), None
+
+    def stage_backend():
+        jax = _setup_device_backend()
+        state["jax"] = jax
+        return jax.devices()[0].platform
+
+    def stage_tiny():
+        # tiny-shape preflight: a 1-element constant + readback touches
+        # allocation and transfer but compiles trivially — separating
+        # "runtime wedged" from "compile wedged" in the verdict
+        import jax.numpy as jnp
+
+        return float(jnp.ones((1,), jnp.float32).sum())
+
+    def stage_matmul():
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        return float((x @ x).sum())
+
+    for name, fn in (("backend", stage_backend), ("tiny_ones", stage_tiny),
+                     ("matmul", stage_matmul)):
+        value, err = run_stage(name, fn)
+        if err is not None:
+            return {"ok": False, "stage": name, "error": err[-4000:]}
+        state[name] = value
+    return {"ok": state["matmul"] == 128.0 * 128 * 128,
+            "stage": "done",
+            "tiny_ok": state["tiny_ones"] == 1.0,
+            "platform": state["backend"]}
 
 
 def model_flops_per_token(cfg, S: int) -> float:
@@ -987,6 +1051,63 @@ def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
             "wire_half_proof": True}
 
 
+def phase_fold_ab(total_bytes: int = 96 << 20, n_tensors: int = 8,
+                  steps: int = 3, reps: int = 2) -> dict:
+    """A/B the native data plane's SIMD fold (BYTEPS_SIMD,
+    native/ps.cc runtime-dispatched AVX-512/AVX2 vs the scalar loop)
+    on the raw dense pushpull loop against a loopback server —
+    INTERLEAVED reps (host-load drift lands on both arms), best-of
+    GB/s per arm, fresh server per run so the counters are per-arm.
+
+    Wall-clock on a 1-2 core loopback box flakes, so the phase ALSO
+    carries a HARD deterministic proof from the server's per-stage
+    counters (`server.fold_bytes`, bps_server_stats): both arms must
+    fold EXACTLY the same payload bytes — same tensors, same rounds —
+    asserted hard, so a faster wall can never come from silently
+    folding less. The JSON reports both walls, the active SIMD tier,
+    the zero-copy tier engagement (direct_recvs / oob_msgs), and the
+    refreshed dense GB/s from the zero-copy path."""
+    def run(simd: bool, out: dict) -> float:
+        os.environ["BYTEPS_SIMD"] = "auto" if simd else "scalar"
+        with _loopback_ps(1) as bps:
+            grads = _make_grads(total_bytes, n_tensors)
+            gbps = _dense_round_gbps(bps, grads,
+                                     "fold" + ("s" if simd else "x"),
+                                     steps)
+            srv = bps.get_metrics()["server"]
+            arm = out.setdefault("simd" if simd else "scalar", {})
+            # fresh server per run: end-state counters are this run's
+            arm["fold_bytes"] = int(srv["fold_bytes"])
+            arm["tier"] = int(srv["simd_tier"])
+            arm["direct_recvs"] = int(srv["direct_recvs"])
+            arm["oob_msgs"] = int(srv["oob_msgs"])
+            return gbps
+
+    prior = os.environ.get("BYTEPS_SIMD")
+    arms: dict = {}
+    simd_gbps, scalar_gbps = [], []
+    try:
+        for _ in range(reps):
+            simd_gbps.append(run(True, arms))
+            scalar_gbps.append(run(False, arms))
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_SIMD", None)
+        else:
+            os.environ["BYTEPS_SIMD"] = prior
+    # HARD equal-work proof: identical tensors and rounds per arm
+    assert arms["simd"]["fold_bytes"] == arms["scalar"]["fold_bytes"], \
+        arms
+    assert arms["scalar"]["tier"] == 0, arms
+    return {"fold_simd_gbps": round(max(simd_gbps), 3),
+            "fold_scalar_gbps": round(max(scalar_gbps), 3),
+            "fold_simd_tier": arms["simd"]["tier"],
+            "fold_bytes_per_arm": arms["simd"]["fold_bytes"],
+            "fold_bytes_equal": True,
+            "fold_direct_recvs": arms["simd"]["direct_recvs"],
+            "fold_oob_msgs": arms["simd"]["oob_msgs"]}
+
+
 def phase_shard_ab(steps: int = 6, reps: int = 3) -> dict:
     """A/B the locality-sharded export/import path
     (BYTEPS_LOCAL_SHARD_EXPORT, jax/train.py): reduce-scatter → push
@@ -1468,6 +1589,7 @@ _PHASES = {
     "metrics_ab": phase_metrics_ab,
     "stream_ab": phase_stream_ab,
     "wire_ab": phase_wire_ab,
+    "fold_ab": phase_fold_ab,
     "shard_ab": phase_shard_ab,
     "pushpull_tpu": phase_pushpull_tpu,
     "scaling": phase_scaling,
@@ -1582,6 +1704,10 @@ def main() -> None:
         "wire_fused_step_ms": None,
         "wire_twoop_step_ms": None,
         "wire_request_ratio": None,
+        "fold_simd_gbps": None,
+        "fold_scalar_gbps": None,
+        "fold_simd_tier": None,
+        "fold_bytes_equal": None,
         "shard_on_step_ms": None,
         "shard_off_step_ms": None,
         "shard_reduction_ratio": None,
@@ -1657,7 +1783,15 @@ def main() -> None:
                  "elapsed_s": round(time.time() - t_start, 0)}
         diag.append(entry)
         if err or not probe.get("ok"):
+            # the probe now self-reports the wedged stage and the real
+            # traceback/stack (phase_probe's staged preflight) — copy
+            # them into the JSON-side trail instead of a bare rc code
             entry["err"] = err or f"bad probe {probe}"
+            if probe:
+                if probe.get("stage"):
+                    entry["probe_stage"] = probe["stage"]
+                if probe.get("error"):
+                    entry["probe_error"] = str(probe["error"])[-2000:]
         elif (probe.get("platform") == "cpu"
                 and not os.environ.get("BENCH_ALLOW_CPU")):
             # a silent jax CPU fallback must not publish CPU tokens/s as
@@ -1752,6 +1886,11 @@ def main() -> None:
                             # that has never landed in a driver
                             # artifact)
                             ("codec_adapt_ab", 300.0),
+                            # SIMD-fold A/B: vectorized vs scalar
+                            # server fold on the zero-copy dense path,
+                            # with the equal-fold_bytes counter proof —
+                            # in the runs-first group (new driver key)
+                            ("fold_ab", 240.0),
                             ("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback

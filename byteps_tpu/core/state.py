@@ -204,6 +204,13 @@ class GlobalState:
             self.metrics = MetricsRegistry(enabled=self.config.metrics_on)
             self.telemetry.attach_metrics(self.metrics)
             self.metrics.section("arena", self.telemetry.arena_stats)
+            # per-stage server data-plane counters (recv → queue-wait →
+            # fold → reply; native/ps.cc StageStats): live-collected
+            # from servers running IN THIS PROCESS (the loopback
+            # test/bench topology); fixed keys reading 0 when the fleet
+            # is remote, so the documented schema resolves everywhere
+            from ..server import stage_section
+            self.metrics.section("server", stage_section)
             # codec-plane instruments exist on every deployment (the
             # docs/observability.md schema guard resolves them), whether
             # or not the adaptive plane itself is enabled below

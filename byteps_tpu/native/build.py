@@ -49,6 +49,17 @@ CLANG_TIDY_CHECKS = ",".join([
 LDFLAGS = ["-lrt", "-lz"]
 
 
+def _simd_flags() -> list:
+    """BYTEPS_BUILD_SCALAR=1 compiles the native PS without the
+    runtime-dispatched AVX2/AVX-512 fold kernels (-DBYTEPS_SCALAR_ONLY)
+    — the CI knob for exercising the scalar data plane on any host and
+    for bisecting a suspected vectorization bug. Part of the build hash
+    so flipping it rebuilds instead of reusing the other variant."""
+    if os.environ.get("BYTEPS_BUILD_SCALAR", "") in ("1", "true", "on"):
+        return ["-DBYTEPS_SCALAR_ONLY"]
+    return []
+
+
 def _sanitizer_flags() -> list:
     """BYTEPS_SANITIZE=thread|address builds the native PS under
     TSAN/ASAN — the sanitizer tier the reference never had (SURVEY.md
@@ -91,7 +102,7 @@ def lib_path() -> str:
     with open(_SRC, "rb") as f:
         h = hashlib.sha256(f.read())
     h.update(" ".join(CXXFLAGS + LDFLAGS
-                      + _sanitizer_flags()).encode())
+                      + _sanitizer_flags() + _simd_flags()).encode())
     h.update(_cpu_tag().encode())
     digest = h.hexdigest()[:16]
     return os.path.join(_DIR, f"libbyteps_ps-{_family_tag()}{digest}.so")
@@ -135,7 +146,7 @@ def build(verbose: bool = False) -> str:
     with _LOCK:
         if os.path.exists(out):
             return out
-        flags = list(CXXFLAGS)
+        flags = list(CXXFLAGS) + _simd_flags()
         san = _sanitizer_flags()
         if san:
             # sanitizer flags override -O3 (listed later wins for -O)

@@ -61,7 +61,12 @@
 #include <linux/futex.h>
 #include <sys/syscall.h>
 #endif
-#if defined(__AVX2__)
+#if defined(__x86_64__)
+// Included unconditionally on x86-64: the runtime-dispatched SIMD fold
+// kernels below are compiled with per-function target attributes
+// (GCC >= 4.9 allows intrinsics inside target("avx2"/"avx512f")
+// functions regardless of the baseline -m flags), while the
+// compile-time __AVX2__ blocks in the codec keep their old gating.
 #include <immintrin.h>
 #endif
 
@@ -94,6 +99,22 @@
 namespace bps {
 
 static constexpr uint32_t kMagic = 0xB17E5002;  // 5001 + codec-tag field
+
+// MsgHeader::flags bits. Bit 0 (error) is wire contract both
+// transports. Bit 7 (out-of-band payload) is SHM-RING-ONLY framing: it
+// marks a message whose payload bytes live in the shared arena segment
+// (an 8-byte IpcDesc follows the header on the ring instead of the
+// payload), and it is set and cleared entirely inside IpcChan — a
+// header that crosses TCP, or that reaches the engine/waiter layers,
+// NEVER carries it, so the Python header mirror is unaffected.
+static constexpr uint8_t kFlagErr = 1;
+static constexpr uint8_t kFlagOob = 0x80;
+// Ring-only like kFlagOob: an ECHO reply whose descriptor names a
+// block in the RECEIVER'S OWN tx arena — the single-worker fused
+// fast path where the dense aggregate is bit-identical to the bytes
+// the client just pushed, so the server sends 8 bytes instead of
+// copying the payload back (see DoPush's echo tail).
+static constexpr uint8_t kFlagOobEcho = 0x40;
 
 // TSAN-visible mutex/condvar with EXPLICIT pthread init/destroy. glibc's
 // std::mutex / std::condition_variable are zero-initialized (no
@@ -161,6 +182,83 @@ class Cv {
   }
  private:
   pthread_cond_t c_;
+};
+
+// ------------------------------------------------------------------ //
+// payload buffers
+//
+// std::vector<uint8_t>::resize() VALUE-initializes — every received
+// payload was being memset to zero immediately before recv() overwrote
+// it, a full second write pass over multi-MB partitions on the server
+// hot loop. Buf keeps vector semantics (moves, shared_ptr publish,
+// capacity reuse) but default-initializes new bytes, so resize-then-
+// recv touches the payload exactly once. Sites that NEED zeros keep
+// saying so explicitly (assign(n, 0) / memset), which value-
+// initializes as before.
+// ------------------------------------------------------------------ //
+
+template <typename T>
+struct DefaultInitAlloc : std::allocator<T> {
+  template <typename U>
+  struct rebind {
+    using other = DefaultInitAlloc<U>;
+  };
+  template <typename U, typename... Args>
+  void construct(U* p, Args&&... args) {
+    if constexpr (sizeof...(Args) == 0)
+      ::new (static_cast<void*>(p)) U;  // default-init: no zero fill
+    else
+      ::new (static_cast<void*>(p)) U(std::forward<Args>(args)...);
+  }
+};
+
+using Buf = std::vector<uint8_t, DefaultInitAlloc<uint8_t>>;
+
+// Free list of payload buffers: the conn loops lease one per incoming
+// message, the engine thread folds from it and returns it after the
+// fold — the "fold scratch" tier of the zero-copy recv path. Together
+// with the publish-by-move recycle in the handlers, steady-state dense
+// traffic does no per-message heap allocation at all. Bounded so a
+// burst of oversized leases can't pin memory forever.
+class BufPool {
+ public:
+  Buf lease(size_t n) {
+    {
+      std::lock_guard<Mu> lk(mu_);
+      // prefer a buffer already big enough (no realloc); else reuse
+      // the last one's allocation as the growth seed
+      for (size_t i = free_.size(); i-- > 0;) {
+        if (free_[i].capacity() >= n) {
+          Buf b = std::move(free_[i]);
+          free_.erase(free_.begin() + (long)i);
+          b.resize(n);
+          return b;
+        }
+      }
+      if (!free_.empty()) {
+        Buf b = std::move(free_.back());
+        free_.pop_back();
+        b.resize(n);
+        return b;
+      }
+    }
+    Buf b;
+    b.resize(n);
+    return b;
+  }
+
+  void put(Buf&& b) {
+    if (b.capacity() == 0) return;
+    std::lock_guard<Mu> lk(mu_);
+    if (free_.size() >= kMaxPooled) return;  // drop: bounded footprint
+    b.clear();
+    free_.push_back(std::move(b));
+  }
+
+ private:
+  static constexpr size_t kMaxPooled = 32;
+  Mu mu_;
+  std::vector<Buf> free_;  // guarded-by: mu_
 };
 
 enum Op : uint8_t {
@@ -396,7 +494,55 @@ static void tune_socket(int fd) {
 // wake only when the peer registered as waiting); non-Linux builds fall
 // back to short timed waits through the same code path.
 
-static constexpr uint32_t kIpcMagic = 0xB17E51DC;
+// Bumped (..DC -> ..DD) when the descriptor/arena tier landed: the
+// segment layout changed, and an old-build server mapping a new-build
+// client's segment (or vice versa) must decline the upgrade loudly and
+// stay on TCP instead of misreading ring offsets.
+static constexpr uint32_t kIpcMagic = 0xB17E51DD;
+
+// -- true zero-copy large-message tier --------------------------------
+//
+// The byte-stream rings move SMALL messages well, but a multi-MB
+// partition costs a full memcpy into the ring and a full memcpy out —
+// plus chunked futex ping-pong whenever the payload approaches the
+// ring size. For messages >= kOobMinBytes the channel instead carries
+// only a DESCRIPTOR: the payload is written once into a per-direction
+// shared ARENA region of the same segment, the ring gets the header
+// (flags |= kFlagOob) followed by an 8-byte IpcDesc naming the arena
+// offset, and the consumer processes the bytes IN PLACE — the server
+// folds straight from the arena (sum_into src = shm), the client
+// copies an aggregate reply from the arena into the caller's buffer
+// exactly once. The consumer releases the block when done; blocks are
+// reclaimed in ring order by the producer (out-of-order completions
+// park behind a done flag per block).
+//
+// Version-fencing: a block is immutable from descriptor-publish (ring
+// head release-store) until the consumer's release; a wire RETRY never
+// reuses a block — each attempt allocates fresh and carries the same
+// PR-6 replay epoch, so the server's last_round dedup decides folding
+// exactly as on TCP and a stale descriptor can never alias a newer
+// round's bytes.
+
+#pragma pack(push, 1)
+struct IpcDesc {
+  uint64_t payload_off;  // offset of the payload inside the arena
+};
+#pragma pack(pop)
+
+static_assert(sizeof(IpcDesc) == 8, "descriptor layout");
+
+static constexpr uint32_t kOobMinBytes = 64 << 10;
+
+// Arena block header, 16 bytes before each payload. `state` flips
+// 0 -> 1 (done) on the consumer side; the producer reclaims contiguous
+// done blocks from the tail. Wrap fillers are born done.
+struct ABlk {
+  std::atomic<uint32_t> state;
+  uint32_t reserved;
+  uint64_t size;  // whole block incl. this header, 64-byte aligned
+};
+
+static_assert(sizeof(ABlk) == 16, "arena block header");
 
 #if defined(__linux__)
 static void futex_wait_u32(std::atomic<uint32_t>* addr, uint32_t expect,
@@ -431,17 +577,46 @@ struct alignas(64) IpcRing {
   char pad2[48];
 };
 
+// One direction's arena allocator state (head/tail are monotonic byte
+// positions like the ring's; space_seq/waiters signal block releases).
+struct alignas(64) ArenaHdr {
+  std::atomic<uint64_t> head;
+  char pad0[56];
+  std::atomic<uint64_t> tail;
+  char pad1[56];
+  std::atomic<uint32_t> space_seq;
+  std::atomic<uint32_t> space_waiters;
+  char pad2[56];
+};
+
 struct IpcShm {
   uint32_t magic;
   uint32_t ring_size;
+  uint64_t arena_size;  // per direction; 0 = ring-only (legacy shape)
   IpcRing c2s;
   IpcRing s2c;
-  // followed by: uint8_t c2s_data[ring_size], s2c_data[ring_size]
+  ArenaHdr c2s_arena;
+  ArenaHdr s2c_arena;
+  // followed by: uint8_t c2s_data[ring_size], s2c_data[ring_size],
+  //              c2s_arena_data[arena_size], s2c_arena_data[arena_size]
 };
 
 static_assert(std::atomic<uint64_t>::is_always_lock_free &&
               std::atomic<uint32_t>::is_always_lock_free,
               "shm ring atomics must be address-free");
+
+// A consumer-side reference to an out-of-band payload: points into the
+// receiver's rx arena; released via IpcChan::oob_release when the
+// bytes have been folded/copied out.
+struct OobRef {
+  const uint8_t* ptr = nullptr;
+  uint64_t off = 0;
+  uint32_t len = 0;
+  // echo: `ptr`/`off` name a block in the receiver's OWN tx arena (its
+  // pushed payload, handed back); release goes through
+  // oob_echo_release instead of oob_release.
+  bool echo = false;
+};
 
 class IpcChan {
  public:
@@ -450,13 +625,19 @@ class IpcChan {
       : base_(base), map_len_(map_len), fd_(fd) {
     IpcShm* s = reinterpret_cast<IpcShm*>(base);
     size_ = s->ring_size;
+    arena_size_ = s->arena_size;
     uint8_t* d0 = reinterpret_cast<uint8_t*>(base) + sizeof(IpcShm);
+    uint8_t* a0 = d0 + 2 * size_;
     if (is_server) {
       rx_ = &s->c2s; rx_data_ = d0;
       tx_ = &s->s2c; tx_data_ = d0 + size_;
+      rx_ah_ = &s->c2s_arena; rx_arena_ = a0;
+      tx_ah_ = &s->s2c_arena; tx_arena_ = a0 + arena_size_;
     } else {
       tx_ = &s->c2s; tx_data_ = d0;
       rx_ = &s->s2c; rx_data_ = d0 + size_;
+      tx_ah_ = &s->c2s_arena; tx_arena_ = a0;
+      rx_ah_ = &s->s2c_arena; rx_arena_ = a0 + arena_size_;
     }
   }
   ~IpcChan() {
@@ -464,10 +645,97 @@ class IpcChan {
   }
 
   // Writer: serialized externally (connection write mutex) -> header and
-  // payload land contiguously in the byte stream.
+  // payload (or descriptor) land contiguously in the byte stream. Large
+  // payloads take the out-of-band arena path: ONE copy into the shared
+  // arena, a descriptor on the ring, the consumer reads in place.
   bool send_msg(const MsgHeader& h, const void* payload) {
+    if (payload && h.len >= kOobMinBytes && arena_size_) {
+      uint64_t off;
+      if (arena_alloc(h.len, &off)) {
+        std::memcpy(tx_arena_ + off, payload, h.len);
+        MsgHeader oh = h;
+        oh.flags = (uint8_t)(oh.flags | kFlagOob);
+        IpcDesc d{off};
+        if (!send(&oh, sizeof(oh))) return false;
+        oob_sent_.fetch_add(1, std::memory_order_relaxed);
+        return send(&d, sizeof(d));
+      }
+      if (broken_.load()) return false;
+      // payload larger than the arena can serve: stream via the ring
+    }
     if (!send(&h, sizeof(h))) return false;
     return h.len == 0 || send(payload, h.len);
+  }
+
+  // Reader-side message entry: receive the header and, for an
+  // out-of-band message, the descriptor — returning a validated arena
+  // reference with the transport-internal flag bit cleared, so
+  // everything above this layer sees the same header it would on TCP.
+  bool recv_msg_begin(MsgHeader* h, OobRef* oob) {
+    oob->ptr = nullptr;
+    oob->echo = false;
+    if (!recv(h, sizeof(*h))) return false;
+    if (!(h->flags & (kFlagOob | kFlagOobEcho))) return true;
+    bool echo = (h->flags & kFlagOobEcho) != 0;
+    IpcDesc d;
+    if (!recv(&d, sizeof(d))) return false;
+    h->flags = (uint8_t)(h->flags & ~(kFlagOob | kFlagOobEcho));
+    if (d.payload_off < sizeof(ABlk) || d.payload_off >= arena_size_ ||
+        d.payload_off + (uint64_t)h->len > arena_size_) {
+      // the >= arena_size_ test also kills the u64 wrap: a huge
+      // payload_off plus a u32 len could otherwise sum small and pass
+      // corrupt descriptor: fail the channel (same verdict as a torn
+      // TCP stream) rather than read out of the mapping
+      mark_broken();
+      return false;
+    }
+    oob->ptr = (echo ? tx_arena_ : rx_arena_) + d.payload_off;
+    oob->off = d.payload_off;
+    oob->len = h->len;
+    oob->echo = echo;
+    oob_recvd_.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  // Echo reply: header + descriptor naming a block in the PEER'S tx
+  // arena (the bytes it pushed) — no payload copy at all. The peer
+  // consumes and releases its own block.
+  bool send_msg_echo(const MsgHeader& h, uint64_t peer_off) {
+    MsgHeader oh = h;
+    oh.flags = (uint8_t)(oh.flags | kFlagOobEcho);
+    IpcDesc d{peer_off};
+    if (!send(&oh, sizeof(oh))) return false;
+    return send(&d, sizeof(d));
+  }
+
+  // Release one of OUR OWN tx-arena blocks after an echo reply handed
+  // it back (the local sender parked in arena_alloc is the waiter).
+  void oob_echo_release(uint64_t payload_off) {
+    ABlk* b = reinterpret_cast<ABlk*>(
+        tx_arena_ + payload_off - sizeof(ABlk));
+    b->state.store(1, std::memory_order_release);
+    tx_ah_->space_seq.fetch_add(1, std::memory_order_release);
+    if (tx_ah_->space_waiters.load() != 0)
+      futex_wake_u32(&tx_ah_->space_seq);
+  }
+
+  // Consumer release of an out-of-band block: after this the producer
+  // may reclaim and overwrite the bytes — callers must be DONE with
+  // OobRef::ptr.
+  void oob_release(uint64_t payload_off) {
+    ABlk* b = reinterpret_cast<ABlk*>(
+        rx_arena_ + payload_off - sizeof(ABlk));
+    b->state.store(1, std::memory_order_release);
+    rx_ah_->space_seq.fetch_add(1, std::memory_order_release);
+    if (rx_ah_->space_waiters.load() != 0)
+      futex_wake_u32(&rx_ah_->space_seq);
+  }
+
+  uint64_t oob_sent() const {
+    return oob_sent_.load(std::memory_order_relaxed);
+  }
+  uint64_t oob_recvd() const {
+    return oob_recvd_.load(std::memory_order_relaxed);
   }
 
   bool send(const void* p, size_t n) {
@@ -532,8 +800,9 @@ class IpcChan {
     return true;
   }
 
-  // Unblocks every waiter on both rings (local threads AND the peer —
-  // the peer then notices EOF on its fd). Used on Close/teardown.
+  // Unblocks every waiter on both rings and both arenas (local threads
+  // AND the peer — the peer then notices EOF on its fd). Used on
+  // Close/teardown.
   void mark_broken() {
     broken_.store(true);
     for (IpcRing* r : {tx_, rx_}) {
@@ -542,10 +811,82 @@ class IpcChan {
       r->space_seq.fetch_add(1);
       futex_wake_u32(&r->space_seq);
     }
+    if (arena_size_) {
+      for (ArenaHdr* a : {tx_ah_, rx_ah_}) {
+        a->space_seq.fetch_add(1);
+        futex_wake_u32(&a->space_seq);
+      }
+    }
   }
   bool broken() const { return broken_.load(); }
 
  private:
+  // Producer-side arena allocation (serialized by the connection write
+  // mutex, like the ring writer). Reclaims contiguous DONE blocks from
+  // the tail, wrap-fills the end of the region so a block never
+  // straddles the wrap, and parks on the arena's space futex when the
+  // consumer is behind. Returns false for payloads the arena can never
+  // hold (caller streams via the ring) or once the channel is broken.
+  bool arena_alloc(uint32_t len, uint64_t* payload_off) {
+    uint64_t need = (sizeof(ABlk) + (uint64_t)len + 63) & ~(uint64_t)63;
+    if (need > arena_size_ / 2) return false;
+    for (;;) {
+      if (broken_.load()) return false;
+      uint64_t head = tx_ah_->head.load(std::memory_order_relaxed);
+      uint64_t tail = tx_ah_->tail.load(std::memory_order_relaxed);
+      while (tail < head) {
+        ABlk* b = reinterpret_cast<ABlk*>(
+            tx_arena_ + (size_t)(tail % arena_size_));
+        if (b->state.load(std::memory_order_acquire) != 1) break;
+        tail += b->size;
+      }
+      tx_ah_->tail.store(tail, std::memory_order_relaxed);
+      uint64_t free_total = arena_size_ - (head - tail);
+      size_t off = (size_t)(head % arena_size_);
+      uint64_t contig = arena_size_ - off;
+      if (contig < need) {
+        if (free_total >= contig + need) {
+          ABlk* f = reinterpret_cast<ABlk*>(tx_arena_ + off);
+          f->size = contig;
+          f->state.store(1, std::memory_order_relaxed);  // born done
+          tx_ah_->head.store(head + contig,
+                             std::memory_order_relaxed);
+          continue;
+        }
+      } else if (free_total >= need) {
+        ABlk* b = reinterpret_cast<ABlk*>(tx_arena_ + off);
+        b->size = need;
+        b->reserved = 0;
+        b->state.store(0, std::memory_order_relaxed);
+        tx_ah_->head.store(head + need, std::memory_order_relaxed);
+        *payload_off = off + sizeof(ABlk);
+        return true;
+      }
+      // arena full: wait for the consumer to release blocks (bounded
+      // futex waits through the same helper as the rings, with peer
+      // liveness checks so a dead consumer fails the send). The
+      // predicate mirrors the admission condition above EXACTLY —
+      // including the wrap filler's extra `contig` bytes — so a wake
+      // that frees less than admission needs parks again instead of
+      // spinning the re-check loop.
+      uint64_t admit = (contig < need) ? contig + need : need;
+      if (!wait(nullptr, &tx_ah_->space_seq, &tx_ah_->space_waiters,
+                [&] {
+                  uint64_t t = tx_ah_->tail.load(
+                      std::memory_order_relaxed);
+                  while (t < head) {
+                    ABlk* b = reinterpret_cast<ABlk*>(
+                        tx_arena_ + (size_t)(t % arena_size_));
+                    if (b->state.load(std::memory_order_acquire) != 1)
+                      break;
+                    t += b->size;
+                  }
+                  return arena_size_ - (head - t) >= admit;
+                },
+                /*check_peer=*/true))
+        return false;
+    }
+  }
   template <typename Pred>
   bool wait(IpcRing*, std::atomic<uint32_t>* seq,
             std::atomic<uint32_t>* waiters, Pred ready, bool check_peer) {
@@ -591,10 +932,17 @@ class IpcChan {
   size_t map_len_;
   int fd_;
   uint64_t size_;
+  uint64_t arena_size_ = 0;
   IpcRing* tx_;
   IpcRing* rx_;
   uint8_t* tx_data_;
   uint8_t* rx_data_;
+  ArenaHdr* tx_ah_ = nullptr;
+  ArenaHdr* rx_ah_ = nullptr;
+  uint8_t* tx_arena_ = nullptr;
+  uint8_t* rx_arena_ = nullptr;
+  std::atomic<uint64_t> oob_sent_{0};
+  std::atomic<uint64_t> oob_recvd_{0};
   std::atomic<bool> broken_{false};
 };
 
@@ -618,6 +966,23 @@ static size_t ipc_ring_bytes() {
     if (v >= (64 << 10)) return (size_t)v;
   }
   return 8 << 20;
+}
+
+// Per-direction shared arena for the zero-copy large-message tier.
+// 0 disables the tier (ring-only, the pre-descriptor behavior); the
+// minimum keeps at least two kOobMinBytes blocks in flight.
+static size_t ipc_arena_bytes() {
+  if (const char* e = ::getenv("BYTEPS_IPC_ARENA_BYTES")) {
+    long v = std::atol(e);
+    if (v <= 0) return 0;
+    if (v < (long)(2 * (kOobMinBytes + 64))) v = 2 * (kOobMinBytes + 64);
+    // arena_alloc's block offsets stay 64-aligned only when the whole
+    // region is a multiple of 64 (head % arena_size at the wrap) — and
+    // the wrap filler needs >= sizeof(ABlk) contiguous bytes; round up
+    // so a hand-set odd size can't write the filler past the region
+    return (size_t)((v + 63) & ~63L);
+  }
+  return 64 << 20;
 }
 
 // 16-bit float conversions for summation. The reference's fp16 path
@@ -690,15 +1055,205 @@ static inline uint16_t float_to_bf16(float x) {
   return (uint16_t)(f >> 16);
 }
 
-// dtype-aware summation: dst += src. Plain loops; -O3 auto-vectorizes
-// (the reference uses OpenMP SIMD pragmas, cpu_reducer.cc:59-120).
-static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
+// ------------------------------------------------------------------ //
+// SIMD fold: the server's accumulate loop, runtime-dispatched
+//
+// The aggregation hot loop (dst += src over fp32/bf16) is the single
+// densest consumer of server CPU once the per-message copies are gone.
+// Three tiers — scalar / AVX2 / AVX-512 — compiled with per-function
+// target attributes so ONE binary carries all of them and picks at
+// runtime (__builtin_cpu_supports), overridable per Server with
+// BYTEPS_SIMD (auto | avx512 | avx2 | scalar/0/off; docs/env.md). The
+// reference gets the same effect from hand-written AVX in
+// cpu_reducer.cc:59-120.
+//
+// Numerics contract: BITWISE identity with the scalar loops. fp32 is
+// an elementwise add either way. bf16 widens to f32 (<<16), adds, and
+// narrows with EXACTLY float_to_bf16's round-to-nearest-even and NaN
+// quieting — the widen-fold-narrow shape, vectorized as integer ops on
+// the float bit patterns, so the SIMD-vs-scalar parity suite
+// (tests/test_native_plane.py) can assert equality bit for bit.
+// BYTEPS_SCALAR_ONLY (build.py BYTEPS_BUILD_SCALAR=1, the CI knob)
+// compiles the scalar tier alone.
+// ------------------------------------------------------------------ //
+
+enum SimdTier : int { kSimdScalar = 0, kSimdAvx2 = 2, kSimdAvx512 = 3 };
+
+static void fold_f32_scalar(float* d, const float* s, size_t n) {
+  for (size_t i = 0; i < n; ++i) d[i] += s[i];
+}
+
+static void fold_bf16_scalar(uint16_t* d, const uint16_t* s, size_t n) {
+  for (size_t i = 0; i < n; ++i)
+    d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
+}
+
+#if defined(__x86_64__) && !defined(BYTEPS_SCALAR_ONLY) && \
+    defined(__GNUC__)
+#define BYTEPS_HAVE_SIMD_FOLD 1
+
+__attribute__((target("avx2"))) static void fold_f32_avx2(
+    float* d, const float* s, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8)
+    _mm256_storeu_ps(d + i, _mm256_add_ps(_mm256_loadu_ps(d + i),
+                                          _mm256_loadu_ps(s + i)));
+  for (; i < n; ++i) d[i] += s[i];
+}
+
+// Narrow 8 f32 sums (bit patterns in `f`) to bf16 in the low 16 bits
+// of each lane, replicating float_to_bf16 exactly: NaN (abs >
+// 0x7f800000) -> (f >> 16) | 0x40 un-rounded; else f + 0x7fff +
+// ((f >> 16) & 1) then >> 16 (the carry into the exponent is the same
+// 32-bit wrap as the scalar's uint32_t add).
+__attribute__((target("avx2"))) static inline __m256i bf16_narrow8_avx2(
+    __m256i f) {
+  const __m256i abs_mask = _mm256_set1_epi32(0x7FFFFFFF);
+  const __m256i inf = _mm256_set1_epi32(0x7F800000);
+  const __m256i quiet = _mm256_set1_epi32(0x40);
+  const __m256i rnd = _mm256_set1_epi32(0x7FFF);
+  const __m256i one = _mm256_set1_epi32(1);
+  __m256i hi = _mm256_srli_epi32(f, 16);
+  __m256i is_nan = _mm256_cmpgt_epi32(_mm256_and_si256(f, abs_mask), inf);
+  __m256i nan_res = _mm256_or_si256(hi, quiet);
+  __m256i rounded = _mm256_srli_epi32(
+      _mm256_add_epi32(
+          f, _mm256_add_epi32(rnd, _mm256_and_si256(hi, one))),
+      16);
+  return _mm256_blendv_epi8(rounded, nan_res, is_nan);
+}
+
+__attribute__((target("avx2"))) static void fold_bf16_avx2(
+    uint16_t* d, const uint16_t* s, size_t n) {
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    // widen 16 bf16 -> 2x8 f32 bit patterns (<<16 == bf16_to_float)
+    __m256i d32lo = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128((const __m128i*)(d + i))), 16);
+    __m256i d32hi = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128((const __m128i*)(d + i + 8))), 16);
+    __m256i s32lo = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128((const __m128i*)(s + i))), 16);
+    __m256i s32hi = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128((const __m128i*)(s + i + 8))), 16);
+    __m256i flo = _mm256_castps_si256(
+        _mm256_add_ps(_mm256_castsi256_ps(d32lo),
+                      _mm256_castsi256_ps(s32lo)));
+    __m256i fhi = _mm256_castps_si256(
+        _mm256_add_ps(_mm256_castsi256_ps(d32hi),
+                      _mm256_castsi256_ps(s32hi)));
+    // pack 2x8 lanes (values <= 0xFFFF, so packus never saturates);
+    // packus interleaves 128-bit lanes -> permute restores order
+    __m256i packed = _mm256_packus_epi32(bf16_narrow8_avx2(flo),
+                                         bf16_narrow8_avx2(fhi));
+    packed = _mm256_permute4x64_epi64(packed, 0xD8);
+    _mm256_storeu_si256((__m256i*)(d + i), packed);
+  }
+  fold_bf16_scalar(d + i, s + i, n - i);
+}
+
+__attribute__((target("avx512f"))) static void fold_f32_avx512(
+    float* d, const float* s, size_t n) {
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16)
+    _mm512_storeu_ps(d + i, _mm512_add_ps(_mm512_loadu_ps(d + i),
+                                          _mm512_loadu_ps(s + i)));
+  for (; i < n; ++i) d[i] += s[i];
+}
+
+__attribute__((target("avx512f,avx512bw"))) static void fold_bf16_avx512(
+    uint16_t* d, const uint16_t* s, size_t n) {
+  const __m512i abs_mask = _mm512_set1_epi32(0x7FFFFFFF);
+  const __m512i inf = _mm512_set1_epi32(0x7F800000);
+  const __m512i quiet = _mm512_set1_epi32(0x40);
+  const __m512i rnd = _mm512_set1_epi32(0x7FFF);
+  const __m512i one = _mm512_set1_epi32(1);
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m512i d32 = _mm512_slli_epi32(
+        _mm512_cvtepu16_epi32(
+            _mm256_loadu_si256((const __m256i*)(d + i))), 16);
+    __m512i s32 = _mm512_slli_epi32(
+        _mm512_cvtepu16_epi32(
+            _mm256_loadu_si256((const __m256i*)(s + i))), 16);
+    __m512i f = _mm512_castps_si512(
+        _mm512_add_ps(_mm512_castsi512_ps(d32),
+                      _mm512_castsi512_ps(s32)));
+    __m512i hi = _mm512_srli_epi32(f, 16);
+    __mmask16 is_nan = _mm512_cmpgt_epi32_mask(
+        _mm512_and_si512(f, abs_mask), inf);
+    __m512i rounded = _mm512_srli_epi32(
+        _mm512_add_epi32(
+            f, _mm512_add_epi32(rnd, _mm512_and_si512(hi, one))),
+        16);
+    __m512i res = _mm512_mask_mov_epi32(rounded, is_nan,
+                                        _mm512_or_si512(hi, quiet));
+    _mm256_storeu_si256((__m256i*)(d + i),
+                        _mm512_cvtepi32_epi16(res));
+  }
+  fold_bf16_scalar(d + i, s + i, n - i);
+}
+#endif  // x86_64 && !BYTEPS_SCALAR_ONLY
+
+struct FoldKernels {
+  void (*f32)(float*, const float*, size_t) = fold_f32_scalar;
+  void (*bf16)(uint16_t*, const uint16_t*, size_t) = fold_bf16_scalar;
+  int tier = kSimdScalar;
+};
+
+static int simd_best_supported() {
+#ifdef BYTEPS_HAVE_SIMD_FOLD
+  if (__builtin_cpu_supports("avx512f") &&
+      __builtin_cpu_supports("avx512bw"))
+    return kSimdAvx512;
+  if (__builtin_cpu_supports("avx2")) return kSimdAvx2;
+#endif
+  return kSimdScalar;
+}
+
+// Resolve the fold tier from a BYTEPS_SIMD-style string. Read per
+// Server instance (like Throttle/Chaos) so SIMD-on and scalar servers
+// coexist in one test process. An explicit request for an unsupported
+// tier degrades to the best available rather than erroring: the knob
+// is a ceiling, not an ISA assertion.
+static FoldKernels resolve_fold_kernels(const char* want) {
+  int tier = simd_best_supported();
+  if (want && *want) {
+    std::string v(want);
+    for (char& c : v) c = (char)std::tolower((unsigned char)c);
+    if (v == "0" || v == "off" || v == "scalar" || v == "false")
+      tier = kSimdScalar;
+    else if (v == "avx2" && tier > kSimdAvx2)
+      tier = kSimdAvx2;
+    // "auto"/"avx512"/anything else: keep the detected best
+  }
+  FoldKernels k;
+  k.tier = tier;
+#ifdef BYTEPS_HAVE_SIMD_FOLD
+  if (tier == kSimdAvx512) {
+    k.f32 = fold_f32_avx512;
+    k.bf16 = fold_bf16_avx512;
+  } else if (tier == kSimdAvx2) {
+    k.f32 = fold_f32_avx2;
+    k.bf16 = fold_bf16_avx2;
+  }
+#endif
+  return k;
+}
+
+// dtype-aware summation: dst += src. fp32/bf16 ride the dispatched
+// SIMD kernels (bitwise-identical to the scalar loops by contract);
+// everything else keeps the plain loops -O3 auto-vectorizes (the
+// reference uses OpenMP SIMD pragmas, cpu_reducer.cc:59-120).
+static void sum_into(void* dst, const void* src, size_t bytes,
+                     uint32_t dtype, const FoldKernels& k) {
   switch (dtype) {
     case F32: {
-      float* d = (float*)dst;
-      const float* s = (const float*)src;
-      size_t n = bytes / 4;
-      for (size_t i = 0; i < n; ++i) d[i] += s[i];
+      k.f32((float*)dst, (const float*)src, bytes / 4);
       break;
     }
     case F64: {
@@ -737,11 +1292,7 @@ static void sum_into(void* dst, const void* src, size_t bytes, uint32_t dtype) {
       break;
     }
     case BF16: {
-      uint16_t* d = (uint16_t*)dst;
-      const uint16_t* s = (const uint16_t*)src;
-      size_t n = bytes / 2;
-      for (size_t i = 0; i < n; ++i)
-        d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
+      k.bf16((uint16_t*)dst, (const uint16_t*)src, bytes / 2);
       break;
     }
     case U16: {
@@ -1439,7 +1990,50 @@ struct Conn {
     if (ipc) return ipc->recv(p, n);
     return recv_all(fd, p, n);
   }
+  // echo reply (shm only): hand the peer's own pushed block back as
+  // the aggregate — 8 bytes on the ring, zero payload copies. The
+  // reply "bandwidth" is still throttle-charged: the evidence knob
+  // models served bytes, which the peer really does consume.
+  bool send_echo(const MsgHeader& h, uint64_t peer_off) {
+    if (thr) thr->charge(h.len);
+    std::lock_guard<Mu> lk(write_mu);
+    if (!ipc) return false;
+    return ipc->send_msg_echo(h, peer_off);
+  }
+  // transport-neutral message entry (conn-loop thread only): on the shm
+  // transport an out-of-band payload surfaces as an arena reference; on
+  // TCP oob stays empty and the payload follows on the stream.
+  bool recv_header(MsgHeader* h, OobRef* oob) {
+    if (ipc) return ipc->recv_msg_begin(h, oob);
+    oob->ptr = nullptr;
+    return recv_all(fd, h, sizeof(*h));
+  }
 };
+
+// Per-stage server accounting (recv -> queue-wait -> fold -> reply),
+// exposed over the C ABI (bps_server_stats) and mirrored into the
+// Python metrics snapshot's `server` section — so the next bound stage
+// of the data plane is measured, not guessed. All relaxed atomics:
+// totals, not synchronization.
+struct StageStats {
+  std::atomic<uint64_t> recv_ns{0};
+  std::atomic<uint64_t> recv_count{0};
+  std::atomic<uint64_t> queue_ns{0};
+  std::atomic<uint64_t> queue_count{0};
+  std::atomic<uint64_t> fold_ns{0};
+  std::atomic<uint64_t> fold_count{0};
+  std::atomic<uint64_t> fold_bytes{0};
+  std::atomic<uint64_t> reply_ns{0};
+  std::atomic<uint64_t> reply_count{0};
+  std::atomic<uint64_t> direct_recvs{0};  // zero-copy recv-into-store
+  std::atomic<uint64_t> oob_msgs{0};      // descriptor-ring payloads
+};
+
+static inline uint64_t now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 struct ParkedPull {
   std::shared_ptr<Conn> conn;
@@ -1451,10 +2045,18 @@ struct ParkedPull {
 struct KeyStore {
   Mu mu;                 // per-key lock: sums/copies of different
                                  // keys must not serialize each other
-  std::vector<uint8_t> accum;    // receiving buffer for the current round
-  std::vector<uint8_t> merged;   // async-mode authoritative weights
+  Buf accum;                     // receiving buffer for the current round
+  Buf merged;                    // async-mode authoritative weights
                                  // (mutated in place per push; sync-mode
                                  // pulls are served from `pub` instead)
+  // Zero-copy recv tier: the conn loop reserves this buffer under `mu`
+  // (direct_inflight guards a single reservation per key), receives
+  // the payload INTO it off-lock, and the engine adopts it by move —
+  // for the first push of a round the received bytes BECOME the
+  // accumulator with no copy and no allocation (buffers rotate
+  // direct_buf -> accum -> pub -> pool).
+  Buf direct_buf;                // guarded-by: mu (reservation)
+  bool direct_inflight = false;  // guarded-by: mu
   uint32_t len = 0;
   uint32_t dtype = F32;
   uint32_t init_count = 0;       // init pushes seen
@@ -1495,15 +2097,15 @@ struct KeyStore {
   // randomk homomorphic fast path: the round's aggregate in WIRE form
   // ([k idx][k vals], vals summed in place). Non-empty only while a
   // fast-path round is in flight.
-  std::vector<uint8_t> wire_accum;
+  Buf wire_accum;
   // Published aggregates (sync mode): swapped atomically under `mu` at
   // ALL_RECV, NEVER mutated afterwards — pulls send straight from the
   // shared buffer with no per-request copy (the reference caches per-key
   // response buffers for the same reason, server.cc:39-80); the refcount
   // keeps a buffer alive across an in-flight send when the next round
   // publishes a replacement.
-  std::shared_ptr<const std::vector<uint8_t>> pub;       // dense
-  std::shared_ptr<const std::vector<uint8_t>> pub_wire;  // compressed
+  std::shared_ptr<const Buf> pub;       // dense
+  std::shared_ptr<const Buf> pub_wire;  // compressed
 };
 
 struct EngineMsg {
@@ -1515,8 +2117,23 @@ struct EngineMsg {
   uint16_t sender;
   uint64_t epoch = 0;            // (round << 16) | attempt; 0 = unstamped
   uint32_t codec = 0;            // (plan_epoch << 8) | codec id; 0 = untagged
-  std::vector<uint8_t> payload;  // push data
+  Buf payload;                   // push data (owned; pooled)
+  // Out-of-band payload (shm descriptor tier): the bytes live in the
+  // peer's arena and are read IN PLACE by the fold; released through
+  // oob_chan after the handler runs. Mutually exclusive with payload.
+  const uint8_t* oob = nullptr;
+  uint32_t oob_len = 0;
+  uint64_t oob_off = 0;
+  IpcChan* oob_chan = nullptr;  // kept alive by `conn`
+  // Direct-recv tier: the payload was received straight into the key's
+  // reserved recv buffer (KeyStore::direct_buf) by the conn loop; the
+  // engine adopts it under ks.mu before dispatch.
+  bool direct = false;
+  uint64_t enq_ns = 0;  // queue-wait stage timestamp
   std::shared_ptr<Conn> conn;
+
+  const uint8_t* data() const { return oob ? oob : payload.data(); }
+  size_t size() const { return oob ? oob_len : payload.size(); }
 };
 
 class EngineQueue {
@@ -1573,14 +2190,30 @@ class Server {
          bool enable_schedule, int64_t debug_key = -1)
       : port_(port), num_workers_(num_workers),
         async_(async_mode), schedule_(enable_schedule),
-        debug_key_(debug_key) {
-    for (int i = 0; i < num_engine_threads; ++i) {
+        debug_key_(debug_key),
+        // per-Server fold tier (BYTEPS_SIMD; like Throttle/Chaos, read
+        // per instance so SIMD and scalar servers coexist in one test
+        // process)
+        kernels_(resolve_fold_kernels(::getenv("BYTEPS_SIMD"))) {
+    n_engines_ = num_engine_threads < 1 ? 1 : num_engine_threads;
+    engine_bytes_.reset(new std::atomic<uint64_t>[n_engines_]);
+    for (int i = 0; i < n_engines_; ++i) {
+      engine_bytes_[i].store(0);
       queues_.emplace_back(new EngineQueue(enable_schedule));
-      engine_bytes_.push_back(0);
     }
-    for (int i = 0; i < num_engine_threads; ++i) {
+    for (int i = 0; i < n_engines_; ++i) {
       engine_threads_.emplace_back([this, i] { EngineLoop(i); });
     }
+  }
+
+  // -- introspection (C ABI / metrics mirror) ----------------------- //
+  const StageStats& stats() const { return stats_; }
+  int simd_tier() const { return kernels_.tier; }
+  int num_engines() const { return n_engines_; }
+  uint64_t engine_fold_bytes(int i) const {
+    return (i >= 0 && i < n_engines_)
+               ? engine_bytes_[i].load(std::memory_order_relaxed)
+               : 0;
   }
 
   int Run() {
@@ -1642,22 +2275,76 @@ class Server {
 
  private:
   int ThreadForKey(uint64_t key, uint32_t len) {
-    // assign new keys to the least-loaded engine by accumulated bytes
-    // (reference: server.h:154-178)
+    // Assign new keys to the least-loaded engine by CUMULATIVE folded
+    // bytes (reference: server.h:154-178). The table accumulates every
+    // queued payload — not just each key's first message — so a key
+    // arriving after traffic has skewed the engines lands away from
+    // the hot one. The old assignment-time-only accounting tied on
+    // equal init lengths and could co-locate a new heavy key with an
+    // already-hot engine (tests/test_native_plane.py pins the one-hot
+    // case). Placement stays static per key (migration would reorder
+    // a key's folds across engine queues).
+    // Accounting lives HERE, for assigned and fresh keys alike (every
+    // message already holds assign_mu_ for the map lookup): one add per
+    // queued payload, never double-counted with a caller-side add.
     std::lock_guard<Mu> lk(assign_mu_);
     auto it = key_thread_.find(key);
-    if (it != key_thread_.end()) return it->second;
+    if (it != key_thread_.end()) {
+      engine_bytes_[it->second].fetch_add(len, std::memory_order_relaxed);
+      return it->second;
+    }
     int best = 0;
-    for (size_t i = 1; i < engine_bytes_.size(); ++i)
-      if (engine_bytes_[i] < engine_bytes_[best]) best = (int)i;
-    engine_bytes_[best] += len;
+    for (int i = 1; i < n_engines_; ++i)
+      if (engine_bytes_[i].load(std::memory_order_relaxed) <
+          engine_bytes_[best].load(std::memory_order_relaxed))
+        best = i;
+    engine_bytes_[best].fetch_add(len, std::memory_order_relaxed);
     key_thread_[key] = best;
     return best;
   }
 
+  // Attempt the zero-copy direct-recv reservation for a dense
+  // steady-state push: under ks.mu, claim the key's recv buffer so the
+  // payload lands straight in the bytes that will become (or fold
+  // into) the accumulator. Returns false (caller uses the pooled path)
+  // when the key is unknown/mismatched, compressed, async, or another
+  // direct recv is already in flight on it.
+  bool TryReserveDirect(const MsgHeader& h, uint32_t req, uint32_t dtype,
+                        uint8_t** dst) {
+    if (async_ || req != kDefaultPushPull || h.len == 0) return false;
+    KeyStore* ksp;
+    {
+      std::lock_guard<Mu> lk(stores_mu_);
+      auto it = stores_.find(h.key);
+      if (it == stores_.end()) return false;
+      ksp = &it->second;  // stable: stores_ never erases
+    }
+    std::lock_guard<Mu> lk(ksp->mu);
+    if (ksp->direct_inflight || ksp->len != h.len ||
+        ksp->dtype != dtype || !ksp->init_done ||
+        ksp->comp.type != CompressorCfg::NONE)
+      return false;
+    if (ksp->direct_buf.size() != h.len) {
+      if (ksp->direct_buf.capacity() < h.len)
+        ksp->direct_buf = pool_.lease(h.len);
+      else
+        ksp->direct_buf.resize(h.len);
+    }
+    ksp->direct_inflight = true;
+    *dst = ksp->direct_buf.data();
+    return true;
+  }
+
+  void ClearDirect(uint64_t key) {
+    KeyStore& ks = store_of(key);
+    std::lock_guard<Mu> lk(ks.mu);
+    ks.direct_inflight = false;
+  }
+
   void ConnLoop(std::shared_ptr<Conn> conn) {
     MsgHeader h;
-    while (conn->recv_bytes(&h, sizeof(h))) {
+    OobRef oob;
+    while (conn->recv_header(&h, &oob)) {
       if (h.magic != kMagic) {
         std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
         break;
@@ -1683,9 +2370,36 @@ class Server {
       decode_cmd(h.cmd, &req, &dtype);
       m.req = req;
       m.dtype = dtype;
-      if (h.len) {
-        m.payload.resize(h.len);
-        if (!conn->recv_bytes(m.payload.data(), h.len)) break;
+      if (oob.ptr) {
+        // descriptor tier: the payload already sits in the shared
+        // arena — no recv, no copy; the engine folds from it in place
+        m.oob = oob.ptr;
+        m.oob_len = oob.len;
+        m.oob_off = oob.off;
+        m.oob_chan = conn->ipc.get();
+        stats_.oob_msgs.fetch_add(1, std::memory_order_relaxed);
+        throttle_.charge(h.len);
+      } else if (h.len) {
+        uint64_t t0 = now_ns();
+        uint8_t* direct_dst = nullptr;
+        if ((h.op == PUSH || h.op == PUSHPULL) &&
+            TryReserveDirect(h, req, dtype, &direct_dst)) {
+          // zero-copy tier: the payload lands straight in the key's
+          // reserved recv buffer, which the engine will adopt as (or
+          // fold into) the accumulator
+          if (!conn->recv_bytes(direct_dst, h.len)) {
+            ClearDirect(h.key);  // the key must not stay reserved
+            break;
+          }
+          m.direct = true;
+          stats_.direct_recvs.fetch_add(1, std::memory_order_relaxed);
+        } else {
+          m.payload = pool_.lease(h.len);
+          if (!conn->recv_bytes(m.payload.data(), h.len)) break;
+        }
+        stats_.recv_ns.fetch_add(now_ns() - t0,
+                                 std::memory_order_relaxed);
+        stats_.recv_count.fetch_add(1, std::memory_order_relaxed);
         throttle_.charge(h.len);  // ingress side of the bandwidth cap
       }
       if (h.op == IPC_HELLO) {
@@ -1726,7 +2440,12 @@ class Server {
                    ? 0
                    : it->second.total_pushes.load(std::memory_order_relaxed);
       }
-      queues_[ThreadForKey(h.key, h.len)]->push(std::move(m), prio);
+      // ThreadForKey also accumulates h.len into engine_bytes_ — the
+      // placement signal AND the balance proof surface
+      // (bps_server_engine_bytes)
+      int eng = ThreadForKey(h.key, h.len);
+      m.enq_ns = now_ns();
+      queues_[eng]->push(std::move(m), prio);
     }
     // Failure detection (beyond the reference, which has none —
     // SURVEY.md §5.3): when the LAST connection of a worker closes and
@@ -1805,7 +2524,7 @@ class Server {
   }
 
   void HandleIpcHello(const std::shared_ptr<Conn>& conn, uint32_t rid,
-                      const std::vector<uint8_t>& payload) {
+                      const Buf& payload) {
     // Client offered a shm segment (its first message on this conn; no
     // requests are in flight). Map + validate, ACK over TCP, then hold
     // the mapping PENDING until the client's IPC_CONFIRM — the ACK must
@@ -1827,8 +2546,9 @@ class Server {
       if (base != MAP_FAILED) {
         IpcShm* s = reinterpret_cast<IpcShm*>(base);
         if (s->magic == kIpcMagic && s->ring_size >= (64 << 10) &&
-            (size_t)st.st_size ==
-                sizeof(IpcShm) + 2 * (size_t)s->ring_size) {
+            (size_t)st.st_size == sizeof(IpcShm) +
+                                      2 * (size_t)s->ring_size +
+                                      2 * (size_t)s->arena_size) {
           MsgHeader r = ReplyHeader(ACK, 0, 0, rid);
           conn->send_msg(r, nullptr);  // still TCP: ipc not yet set
           // pending until the client's IPC_CONFIRM commits it — the
@@ -1890,6 +2610,22 @@ class Server {
   void EngineLoop(int idx) {
     EngineMsg m;
     while (queues_[idx]->wait_pop(&m)) {
+      if (m.enq_ns) {
+        stats_.queue_ns.fetch_add(now_ns() - m.enq_ns,
+                                  std::memory_order_relaxed);
+        stats_.queue_count.fetch_add(1, std::memory_order_relaxed);
+      }
+      if (m.direct) {
+        // adopt the direct-recv buffer as the message payload (O(1)
+        // move — the received bytes travel pointer-only from here into
+        // the accumulator). Done BEFORE the dead-conn check so a dying
+        // conn's reservation is always consumed and the key unblocked.
+        KeyStore& ks = store_of(m.key);
+        std::lock_guard<Mu> lk(ks.mu);
+        m.payload = std::move(ks.direct_buf);
+        ks.direct_inflight = false;
+        m.direct = false;
+      }
       if (m.conn->dead.load()) {
         // queued behind a connection that already died: processing it
         // would re-pollute the round state OnWorkerDeparted rolled back
@@ -1898,23 +2634,34 @@ class Server {
         // re-check under ks.mu to close the check-then-act window.
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
-        continue;
+      } else {
+        switch (m.op) {
+          case INIT_PUSH: DoInit(m); break;
+          case PUSH: DoPush(m); break;
+          case PULL: DoPull(m); break;
+          case PUSHPULL: DoPush(m, /*fused=*/true); break;
+          case COMP_INIT: DoCompInit(m); break;
+          default: {
+            // Unknown op (version skew: a newer client against this
+            // server). Error-reply instead of dropping — a fused client
+            // would otherwise wait out its full request timeout on a
+            // request this server can never answer.
+            MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
+            m.conn->send_msg(r, nullptr);
+            break;
+          }
+        }
       }
-      switch (m.op) {
-        case INIT_PUSH: DoInit(m); break;
-        case PUSH: DoPush(m); break;
-        case PULL: DoPull(m); break;
-        case PUSHPULL: DoPush(m, /*fused=*/true); break;
-        case COMP_INIT: DoCompInit(m); break;
-        default:
-          // Unknown op (version skew: a newer client against this
-          // server). Error-reply instead of dropping — a fused client
-          // would otherwise wait out its full request timeout on a
-          // request this server can never answer.
-          MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
-          m.conn->send_msg(r, nullptr);
-          break;
+      // epilogue: out-of-band arena blocks release only AFTER the fold
+      // consumed them; un-adopted payload buffers recycle to the pool
+      // (the "fold scratch" of the zero-copy recv path)
+      if (m.oob_chan) {
+        m.oob_chan->oob_release(m.oob_off);
+        m.oob_chan = nullptr;
+        m.oob = nullptr;
       }
+      if (!m.payload.empty()) pool_.put(std::move(m.payload));
+      m.conn.reset();
     }
   }
 
@@ -2024,7 +2771,7 @@ class Server {
         m.conn->send_msg(r, nullptr);
         return;
       }
-      if (ks.len != (uint32_t)m.payload.size() || ks.dtype != m.dtype) {
+      if (ks.len != (uint32_t)m.size() || ks.dtype != m.dtype) {
         // fresh key, or re-init with a new length (tensor resize) OR a
         // new dtype (two 4-byte types swap under one key): reset the
         // whole aggregation state — a mere dtype retag would keep
@@ -2041,11 +2788,13 @@ class Server {
         ks.parked_inits.clear();
         ks.init_count = 0;
         ks.init_done = false;
-        ks.len = (uint32_t)m.payload.size();
+        ks.len = (uint32_t)m.size();
         ks.dtype = m.dtype;
         ks.accum.assign(ks.len, 0);
-        ks.merged = m.payload;  // init value (typically zeros or weights)
-        ks.pub = std::make_shared<std::vector<uint8_t>>(m.payload);
+        // init value (typically zeros or weights); assign() covers both
+        // the owned-payload and the shm-arena (out-of-band) cases
+        ks.merged.assign(m.data(), m.data() + m.size());
+        ks.pub = std::make_shared<Buf>(ks.merged);
         ks.worker_push_count.assign(num_workers_, 0);
         ks.pull_abort.assign(num_workers_, 0);
         ks.last_round.assign(num_workers_, 0);
@@ -2110,7 +2859,7 @@ class Server {
       CompressorCfg cfg;
       if (!async_ &&
           CompressorCfg::Parse(
-              std::string((const char*)m.payload.data(), m.payload.size()),
+              std::string((const char*)m.data(), m.size()),
               &cfg) &&
           ks.len == cfg.n * 4 && ks.dtype == F32) {
         ok = true;
@@ -2142,7 +2891,7 @@ class Server {
             // publish a compressed view of the current aggregate so a
             // pull that precedes the first compressed round is
             // answerable
-            auto w = std::make_shared<std::vector<uint8_t>>(cfg.WireLen());
+            auto w = std::make_shared<Buf>(cfg.WireLen());
             uint32_t wl = ks.comp.Compress((const float*)ks.pub->data(),
                                            w->data(), ks.completed_rounds,
                                            ks.round_idx);
@@ -2182,18 +2931,18 @@ class Server {
   // caller's generic path finishes the round correctly.
   bool RandomkFastPush(EngineMsg& m, KeyStore& ks) {
     const uint32_t k = ks.comp.k;
-    const uint8_t* payload = m.payload.data();
+    const uint8_t* payload = m.data();
     const int32_t* idx = (const int32_t*)payload;
     const float* val = (const float*)(payload + 4 * (size_t)k);
     if (ks.recv_count == 0) {
-      ks.wire_accum.assign(payload, payload + m.payload.size());
+      ks.wire_accum.assign(payload, payload + m.size());
       ks.round_idx.assign(idx, idx + k);
       return true;
     }
     if (!ks.wire_accum.empty() &&
         std::memcmp(ks.wire_accum.data(), idx, 4 * (size_t)k) == 0) {
       float* acc = (float*)(ks.wire_accum.data() + 4 * (size_t)k);
-      for (uint32_t i = 0; i < k; ++i) acc[i] += val[i];
+      kernels_.f32(acc, val, k);
       return true;
     }
     if (!ks.wire_accum.empty()) {
@@ -2214,6 +2963,14 @@ class Server {
   // the parked_pulls flush ran without us but the re-check then sees
   // completed_rounds caught up and answers immediately, so the race is
   // benign (no lost reply).
+  // Fold-stage accounting (per-stage server timing + the fold_ab
+  // bench's HARD bytes-folded proof): one call per payload folded.
+  void RecordFold(uint64_t t0, size_t bytes) {
+    stats_.fold_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+    stats_.fold_count.fetch_add(1, std::memory_order_relaxed);
+    stats_.fold_bytes.fetch_add(bytes, std::memory_order_relaxed);
+  }
+
   void FusedReply(KeyStore& ks, EngineMsg& m, bool compressed) {
     bool ready;
     {
@@ -2241,10 +2998,10 @@ class Server {
         return;
       }
       if (ks.comp.type == CompressorCfg::RANDOMK &&
-          m.payload.size() == ks.comp.WireLen()) {
+          m.size() == ks.comp.WireLen()) {
         // bounds-check indices, then try the O(k) wire-form aggregation
         bool valid = true;
-        const int32_t* idx = (const int32_t*)m.payload.data();
+        const int32_t* idx = (const int32_t*)m.data();
         for (uint32_t i = 0; i < ks.comp.k; ++i)
           if (idx[i] < 0 || (uint32_t)idx[i] >= ks.comp.n) {
             valid = false;
@@ -2258,7 +3015,9 @@ class Server {
           m.conn->send_msg(r, nullptr);
           return;
         }
+        uint64_t t0 = now_ns();
         if (RandomkFastPush(m, ks)) {
+          RecordFold(t0, m.size());
           ks.total_pushes++;
           if (m.sender < ks.worker_push_count.size())
             ks.worker_push_count[m.sender]++;
@@ -2268,10 +3027,11 @@ class Server {
           if ((int)ks.recv_count >= num_workers_) {
             // ALL_RECV: the wire accumulator IS the compressed
             // aggregate; scatter it once for the dense published view
-            auto w = std::make_shared<std::vector<uint8_t>>(
+            auto w = std::make_shared<Buf>(
                 std::move(ks.wire_accum));
             ks.wire_accum.clear();
-            auto d = std::make_shared<std::vector<uint8_t>>(ks.len, 0);
+            auto d = std::make_shared<Buf>();
+            d->resize(ks.len);  // ScatterWire zero-fills it whole
             ScatterWire(w->data(), ks.comp.k, (float*)d->data(),
                         ks.comp.n);
             DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
@@ -2291,7 +3051,7 @@ class Server {
       if (num_workers_ == 1 && ks.recv_count == 0 &&
           (ks.comp.type == CompressorCfg::ONEBIT ||
            ks.comp.type == CompressorCfg::TOPK) &&
-          ks.comp.ValidLen(m.payload.size())) {
+          ks.comp.ValidLen(m.size())) {
         // single-worker round: the aggregate IS the payload, and for
         // these codecs recompress(decompress(p)) is bit-stable (onebit:
         // signs unchanged, scale = mean|±scale| = scale; topk: same
@@ -2300,28 +3060,35 @@ class Server {
         // memcpy and the recompress pass. The 1-worker analogue of the
         // dense path's first-copy publish. (randomk has its own wire-
         // form path above; dithering is NOT requantization-stable.)
-        auto d = std::make_shared<std::vector<uint8_t>>();
+        auto d = std::make_shared<Buf>();
         // buffer-steal only for onebit: its Decompress is infallible
         // after ValidLen, so the published aggregate can't be clobbered
         // by a failing decode (topk can reject bad indices mid-scatter)
         if (ks.comp.type == CompressorCfg::ONEBIT && ks.pub &&
             ks.pub.use_count() == 1 && ks.pub->size() == ks.len) {
           *d = std::move(
-              *std::const_pointer_cast<std::vector<uint8_t>>(ks.pub));
+              *std::const_pointer_cast<Buf>(ks.pub));
           ks.pub.reset();
         } else {
           d->resize(ks.len);
         }
-        if (ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
+        uint64_t t0 = now_ns();
+        if (ks.comp.Decompress(m.data(), (uint32_t)m.size(),
                                (float*)d->data(), &ks.round_idx)) {
+          RecordFold(t0, m.size());
           ks.total_pushes++;
           if (m.sender < ks.worker_push_count.size())
             ks.worker_push_count[m.sender]++;
           if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
           RecordRound(ks, m);
           DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
-          auto w = std::make_shared<std::vector<uint8_t>>(
-              std::move(m.payload));
+          // publish the pushed wire by move (owned payload) or by one
+          // copy out of the shm arena (out-of-band payload)
+          auto w = std::make_shared<Buf>();
+          if (m.oob)
+            w->assign(m.data(), m.data() + m.size());
+          else
+            *w = std::move(m.payload);
           ks.pub = std::move(d);
           ks.pub_wire = std::move(w);
           ks.round_codec = 0;  // round completed without recv_count ever
@@ -2333,7 +3100,8 @@ class Server {
         }
         // invalid wire: fall through to the generic path's error report
       }
-      if (!ks.comp.Decompress(m.payload.data(), (uint32_t)m.payload.size(),
+      uint64_t t_fold = now_ns();
+      if (!ks.comp.Decompress(m.data(), (uint32_t)m.size(),
                               ks.scratch.data(),
                               ks.recv_count == 0 ? &ks.round_idx : nullptr)) {
         // Decompress validates the length itself (exact for the fixed
@@ -2341,7 +3109,7 @@ class Server {
         std::fprintf(stderr,
                      "[bps-server] compressed push rejected key=%llu "
                      "len=%zu bound=%u\n",
-                     (unsigned long long)m.key, m.payload.size(),
+                     (unsigned long long)m.key, m.size(),
                      ks.comp.WireLen());
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
@@ -2364,9 +3132,9 @@ class Server {
         std::memcpy(accum, ks.scratch.data(),
                     ks.comp.n * sizeof(float));
       } else {
-        for (uint32_t i = 0; i < ks.comp.n; ++i)
-          accum[i] += ks.scratch[i];
+        kernels_.f32(accum, ks.scratch.data(), ks.comp.n);
       }
+      RecordFold(t_fold, m.size());
       ks.recv_count++;
       if ((int)ks.recv_count >= num_workers_) {
         // ALL_RECV: recompress the dense aggregate (server.cc:345-375 with
@@ -2375,17 +3143,17 @@ class Server {
         // pulls keep working), then restore a full-size accum for the
         // next round's first scratch memcpy — stealing the previous
         // published buffer when no in-flight send still references it
-        auto d = std::make_shared<std::vector<uint8_t>>(
+        auto d = std::make_shared<Buf>(
             std::move(ks.accum));
         DebugPrint("RECOMPRESS", m.key, d->data(), ks.len, F32);
-        auto w = std::make_shared<std::vector<uint8_t>>(ks.comp.WireLen());
+        auto w = std::make_shared<Buf>(ks.comp.WireLen());
         uint32_t wl = ks.comp.Compress((const float*)d->data(), w->data(),
                                        ks.completed_rounds, ks.round_idx);
         w->resize(wl);  // varint wires are variable-length
         if (ks.pub && ks.pub.use_count() == 1 &&
             ks.pub->size() == ks.len) {
           ks.accum = std::move(
-              *std::const_pointer_cast<std::vector<uint8_t>>(ks.pub));
+              *std::const_pointer_cast<Buf>(ks.pub));
         } else {
           ks.accum.assign(ks.len, 0);
         }
@@ -2430,18 +3198,18 @@ class Server {
         if (!CodecTagOk(ks, m)) break;  // rowsparse rides the dense mode
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
-        if (m.payload.size() < 8) break;
+        if (m.size() < 8) break;
         uint32_t nrows, width;
-        std::memcpy(&nrows, m.payload.data(), 4);
-        std::memcpy(&width, m.payload.data() + 4, 4);
+        std::memcpy(&nrows, m.data(), 4);
+        std::memcpy(&width, m.data() + 4, 4);
         if (width == 0) break;
         size_t want = 8 + (size_t)nrows * 4 + (size_t)nrows * width * 4;
-        if (m.payload.size() != want) break;
+        if (m.size() != want) break;
         uint64_t total_rows = ks.len / ((uint64_t)width * 4);
         if (total_rows * width * 4 != ks.len) break;  // width mismatch
-        const int32_t* ids = (const int32_t*)(m.payload.data() + 8);
+        const int32_t* ids = (const int32_t*)(m.data() + 8);
         const float* vals =
-            (const float*)(m.payload.data() + 8 + (size_t)nrows * 4);
+            (const float*)(m.data() + 8 + (size_t)nrows * 4);
         bool bad = false;  // validate BEFORE touching the store
         for (uint32_t i = 0; i < nrows; ++i)
           if (ids[i] < 0 || (uint64_t)ids[i] >= total_rows) { bad = true;
@@ -2454,10 +3222,13 @@ class Server {
         RecordRound(ks, m);
         if (async_) {
           // async: fold rows straight into the authoritative weights
+          // (per-row SIMD f32 fold, like the sync path below)
+          uint64_t t0 = now_ns();
           float* w = (float*)ks.merged.data();
           for (uint32_t i = 0; i < nrows; ++i)
-            for (uint32_t j = 0; j < width; ++j)
-              w[(size_t)ids[i] * width + j] += vals[(size_t)i * width + j];
+            kernels_.f32(w + (size_t)ids[i] * width,
+                         vals + (size_t)i * width, width);
+          RecordFold(t0, m.size());
           ks.completed_rounds++;
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
@@ -2469,15 +3240,15 @@ class Server {
           if (ks.accum.size() != ks.len) ks.accum.assign(ks.len, 0);
           std::memset(ks.accum.data(), 0, ks.len);
         }
+        uint64_t t0 = now_ns();
         float* accum = (float*)ks.accum.data();
-        for (uint32_t i = 0; i < nrows; ++i) {
-          float* dst = accum + (size_t)ids[i] * width;
-          const float* src = vals + (size_t)i * width;
-          for (uint32_t j = 0; j < width; ++j) dst[j] += src[j];
-        }
+        for (uint32_t i = 0; i < nrows; ++i)
+          kernels_.f32(accum + (size_t)ids[i] * width,
+                       vals + (size_t)i * width, width);
+        RecordFold(t0, m.size());
         ks.recv_count++;
         if ((int)ks.recv_count >= num_workers_) {
-          auto d = std::make_shared<std::vector<uint8_t>>(
+          auto d = std::make_shared<Buf>(
               std::move(ks.accum));
           DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
           ks.pub = std::move(d);
@@ -2492,7 +3263,7 @@ class Server {
     }
     if (!ok)
       std::fprintf(stderr, "[bps-server] sparse push rejected key=%llu "
-                   "len=%zu\n", (unsigned long long)m.key, m.payload.size());
+                   "len=%zu\n", (unsigned long long)m.key, m.size());
     if (!ok || !fused) {
       MsgHeader r =
           ReplyHeader(ACK, (uint8_t)(ok ? 0 : 1), 0, m.rid, m.key);
@@ -2506,6 +3277,7 @@ class Server {
 
   void DoPush(EngineMsg& m, bool fused = false) {
     std::vector<ParkedPull> flush;
+    bool echo_ok = false;  // single-worker fused shm echo fast path
     KeyStore& ks = store_of(m.key);
     if (m.req == kRowSparsePushPull) {
       DoPushSparse(m, ks, fused);
@@ -2538,13 +3310,13 @@ class Server {
         m.conn->send_msg(r, nullptr);
         return;
       }
-      if (ks.len == 0 || m.payload.size() != ks.len) {
+      if (ks.len == 0 || m.size() != ks.len) {
         // uninitialized OR size mismatch (stale partitioning after a
         // tensor resize): error-reply; memcpy/sum with the wrong length
         // would corrupt the heap
         std::fprintf(stderr,
                      "[bps-server] push rejected key=%llu len=%zu store=%u\n",
-                     (unsigned long long)m.key, m.payload.size(), ks.len);
+                     (unsigned long long)m.key, m.size(), ks.len);
         // flags bit0 = error: reply instead of dropping, so the client
         // raises instead of hanging on a never-acked request
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
@@ -2564,40 +3336,73 @@ class Server {
         RecordRound(ks, m);
         if (async_) {
           // async: sum straight into merged (server.cc:315-319)
-          sum_into(ks.merged.data(), m.payload.data(), m.payload.size(),
-                   ks.dtype);
+          uint64_t t0 = now_ns();
+          sum_into(ks.merged.data(), m.data(), m.size(), ks.dtype,
+                   kernels_);
+          RecordFold(t0, m.size());
           ks.completed_rounds++;
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
         } else {
           DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV",
-                     m.key, m.payload.data(), (uint32_t)m.payload.size(),
-                     ks.dtype);
+                     m.key, m.data(), (uint32_t)m.size(), ks.dtype);
+          uint64_t t0 = now_ns();
+          // captured BEFORE the adopt-move below empties m.payload
+          size_t fold_len = m.size();
           if (ks.recv_count == 0) {
-            // first push of the round ADOPTS the payload buffer (no
-            // copy; the reference memcpys here, server.cc:329-333 — a
-            // buffer move is the TPU-host upgrade since the payload
-            // vector is already ours)
-            ks.accum = std::move(m.payload);
+            if (m.oob) {
+              // out-of-band first push: ONE copy out of the shared
+              // arena into the (pool-recycled) accumulator — the shm
+              // analogue of the direct-recv adopt below
+              if (ks.accum.size() != ks.len) {
+                if (ks.accum.capacity() < ks.len)
+                  ks.accum = pool_.lease(ks.len);
+                else
+                  ks.accum.resize(ks.len);
+              }
+              std::memcpy(ks.accum.data(), m.data(), m.size());
+            } else {
+              // first push of the round ADOPTS the payload buffer (no
+              // copy; the reference memcpys here, server.cc:329-333).
+              // On the direct-recv tier the bytes were received
+              // STRAIGHT into this buffer — socket to accumulator with
+              // zero intermediate copies.
+              ks.accum = std::move(m.payload);
+            }
           } else {
-            sum_into(ks.accum.data(), m.payload.data(), m.payload.size(),
-                     ks.dtype);
+            sum_into(ks.accum.data(), m.data(), m.size(), ks.dtype,
+                     kernels_);
           }
+          RecordFold(t0, fold_len);
           ks.recv_count++;
           if ((int)ks.recv_count >= num_workers_) {
             // ALL_RECV: publish by MOVING the accumulator into the
             // shared published slot (no copy); accum is left empty —
             // the next round's first push adopts its own payload buffer
-            // anyway
-            auto d = std::make_shared<std::vector<uint8_t>>(
+            // anyway. The REPLACED published buffer, once no in-flight
+            // send pins it, recycles into the payload pool — closing
+            // the pool -> direct_buf/payload -> accum -> pub -> pool
+            // rotation at zero steady-state allocations.
+            auto d = std::make_shared<Buf>(
                 std::move(ks.accum));
             DebugPrint("ALL_RECV", m.key, d->data(), ks.len, ks.dtype);
+            auto old = std::move(ks.pub);
             ks.pub = std::move(d);
+            if (old && old.use_count() == 1)
+              pool_.put(std::move(*std::const_pointer_cast<Buf>(old)));
             ks.recv_count = 0;
             ks.round_codec = 0;
             ks.completed_rounds++;
             chaos_.round_completed();
             flush.swap(ks.parked_pulls);
+            // Echo eligibility: a single-worker round just completed
+            // from THIS out-of-band payload, so the published
+            // aggregate is bit-identical to the bytes still sitting
+            // in the client's c2s arena block — the fused reply can
+            // hand that block back as a descriptor instead of copying
+            // the payload into the s2c arena (m.oob implies the conn
+            // committed the shm upgrade).
+            echo_ok = fused && m.oob != nullptr && num_workers_ == 1;
           }
         }
       }
@@ -2612,7 +3417,35 @@ class Server {
     }
     for (auto& p : flush) AnswerPull(ks, p);
     // fused: the aggregate IS the reply — park or answer instead of ACK
-    if (fused) FusedReply(ks, m, /*compressed=*/false);
+    if (fused) {
+      if (echo_ok) {
+        // zero-copy echo reply: 8 ring bytes instead of a payload
+        // copy; on success the c2s block's ownership transfers to the
+        // client (it releases after copying into its own out buffer),
+        // so the engine epilogue must NOT release it here. A chaos
+        // drop or send failure keeps ownership local — the epilogue
+        // release then runs as usual and the client retries.
+        if (chaos_.swallow_reply()) {
+          std::fprintf(stderr,
+                       "[bps-server] CHAOS: dropped echo reply rid=%u "
+                       "sender=%u\n", m.rid, (unsigned)m.sender);
+        } else {
+          MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, m.rid, 0, 0,
+                                    (uint32_t)m.size());
+          uint64_t t0 = now_ns();
+          bool sent = m.conn->send_echo(r, m.oob_off);
+          stats_.reply_ns.fetch_add(now_ns() - t0,
+                                    std::memory_order_relaxed);
+          stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
+          if (sent) {
+            m.oob_chan = nullptr;  // client now owns the block
+            m.oob = nullptr;
+          }
+        }
+      } else {
+        FusedReply(ks, m, /*compressed=*/false);
+      }
+    }
   }
 
   bool PullReady(KeyStore& ks, uint16_t sender) {
@@ -2635,14 +3468,18 @@ class Server {
     if (async_) {
       // async: merged mutates in place on every push; snapshot under the
       // key lock so the send reads a consistent weight vector
-      std::vector<uint8_t> snapshot;
+      Buf snapshot;
       {
         std::lock_guard<Mu> lk(ks.mu);
         snapshot = ks.merged;
       }
       MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, p.rid, 0, 0,
                                 (uint32_t)snapshot.size());
+      uint64_t t0 = now_ns();
       p.conn->send_msg(r, snapshot.data());
+      stats_.reply_ns.fetch_add(now_ns() - t0,
+                                std::memory_order_relaxed);
+      stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
       return;
     }
     // sync: zero-copy — ALL_RECV swaps the published shared_ptr and never
@@ -2650,7 +3487,7 @@ class Server {
     // outside the key lock; the refcount pins it across the send even if
     // the next round publishes a replacement (reference: cached per-key
     // response buffers, server.cc:39-80)
-    std::shared_ptr<const std::vector<uint8_t>> snap;
+    std::shared_ptr<const Buf> snap;
     {
       std::lock_guard<Mu> lk(ks.mu);
       snap = p.compressed ? ks.pub_wire : ks.pub;
@@ -2662,7 +3499,13 @@ class Server {
     }
     MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, p.rid, 0, 0,
                               (uint32_t)snap->size());
+    // reply stage: header + shared aggregate leave in one gathered
+    // sendmsg (TCP) or land once in the shm arena (descriptor tier) —
+    // no assembly copy on either transport
+    uint64_t t0 = now_ns();
     p.conn->send_msg(r, snap->data());
+    stats_.reply_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+    stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
   }
 
   void DoPull(EngineMsg& m) {
@@ -2731,9 +3574,16 @@ class Server {
 
   std::vector<std::unique_ptr<EngineQueue>> queues_;
   std::vector<std::thread> engine_threads_;
-  std::vector<uint64_t> engine_bytes_;
+  int n_engines_ = 1;
+  // cumulative queued payload bytes per engine: written once per
+  // message inside ThreadForKey (under assign_mu_); atomic because
+  // bps_server_engine_bytes reads without the lock
+  std::unique_ptr<std::atomic<uint64_t>[]> engine_bytes_;
   std::unordered_map<uint64_t, int> key_thread_;
   Mu assign_mu_;
+  FoldKernels kernels_;  // BYTEPS_SIMD, resolved per Server
+  StageStats stats_;     // per-stage data-plane accounting
+  BufPool pool_;         // recycled payload/fold-scratch buffers
 
   std::unordered_map<uint64_t, KeyStore> stores_;
   Mu stores_mu_;  // guards only the map itself; data ops take the
@@ -3239,9 +4089,24 @@ class ServerConn {
     return rc;
   }
 
+ public:
+  // client-side transport proof surface: out-of-band descriptor
+  // messages sent/received on this conn's shm channel (0 on TCP)
+  uint64_t oob_sent() const { return chan_ ? chan_->oob_sent() : 0; }
+  uint64_t oob_recvd() const { return chan_ ? chan_->oob_recvd() : 0; }
+
  private:
   bool rx(void* p, size_t n) {
     return chan_ ? chan_->recv(p, n) : recv_all(fd_, p, n);
+  }
+
+  // transport-neutral reply entry: on the shm channel an out-of-band
+  // aggregate surfaces as an arena reference (copied ONCE into the
+  // waiter's caller-owned buffer below); on TCP oob stays empty.
+  bool rx_header(MsgHeader* h, OobRef* oob) {
+    if (chan_) return chan_->recv_msg_begin(h, oob);
+    oob->ptr = nullptr;
+    return recv_all(fd_, h, sizeof(*h));
   }
 
   // Offer a fresh shm segment over the just-established TCP conn and wait
@@ -3253,7 +4118,8 @@ class ServerConn {
     std::snprintf(name, sizeof(name), "/bps-ipc-%d-%u", (int)::getpid(),
                   seq.fetch_add(1));
     size_t ring = ipc_ring_bytes();
-    size_t total = sizeof(IpcShm) + 2 * ring;
+    size_t arena = ipc_arena_bytes();
+    size_t total = sizeof(IpcShm) + 2 * ring + 2 * arena;
     int sfd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
     if (sfd < 0) return;
     if (::ftruncate(sfd, (off_t)total) != 0) {
@@ -3270,6 +4136,7 @@ class ServerConn {
     }
     IpcShm* s = reinterpret_cast<IpcShm*>(base);  // pages arrive zeroed
     s->ring_size = (uint32_t)ring;
+    s->arena_size = (uint64_t)arena;
     s->magic = kIpcMagic;
     MsgHeader h = ReplyHeader(IPC_HELLO, 0, sender, 0, 0, 0,
                               (uint32_t)std::strlen(name));
@@ -3301,7 +4168,8 @@ class ServerConn {
 
   void RecvLoop() {
     MsgHeader h;
-    while (rx(&h, sizeof(h))) {
+    OobRef oob;
+    while (rx_header(&h, &oob)) {
       std::shared_ptr<Waiter> w;
       {
         std::lock_guard<Mu> lk(waiters_mu_);
@@ -3311,19 +4179,38 @@ class ServerConn {
           waiters_.erase(it);
         }
       }
-      if (!w) {  // unknown rid: drain payload
-        std::vector<uint8_t> junk(h.len);
-        if (h.len && !rx(junk.data(), h.len)) break;
+      if (!w) {  // unknown rid: drain (or release) the payload
+        if (oob.ptr) {
+          if (oob.echo)
+            chan_->oob_echo_release(oob.off);
+          else
+            chan_->oob_release(oob.off);
+        } else if (h.len) {
+          junk_.resize(h.len);  // reused scratch: recv loop is 1 thread
+          if (!rx(junk_.data(), h.len)) break;
+        }
         continue;
       }
       bool ok = true;
       bool len_mismatch = false;
       if (h.len) {
-        if (w->out && h.len <= w->out_len) {
+        if (oob.ptr) {
+          // descriptor-tier reply: the aggregate sits in the shared
+          // arena — ONE copy into the caller's (arena-leased) buffer,
+          // then release; no ring transit, no intermediate staging
+          if (w->out && h.len <= w->out_len)
+            std::memcpy(w->out, oob.ptr, h.len);
+          else if (w->out)
+            len_mismatch = true;
+          if (oob.echo)
+            chan_->oob_echo_release(oob.off);
+          else
+            chan_->oob_release(oob.off);
+        } else if (w->out && h.len <= w->out_len) {
           ok = rx(w->out, h.len);
         } else {
-          std::vector<uint8_t> junk(h.len);
-          ok = rx(junk.data(), h.len);
+          junk_.resize(h.len);
+          ok = rx(junk_.data(), h.len);
           // a reply LARGER than the waiter's buffer was drained, not
           // delivered (e.g. a tensor resize raced an in-flight pull):
           // reporting success would hand the caller h.len > out_len
@@ -3391,6 +4278,7 @@ class ServerConn {
 
   int fd_ = -1;
   std::unique_ptr<IpcChan> chan_;  // set before recv_thread_ spawns
+  Buf junk_;  // RecvLoop-only drain scratch (reused, never per-message)
   CompletionQueue* cq_ = nullptr;  // Client-owned; set before Connect
   Mu send_mu_;
   std::thread recv_thread_;
@@ -3561,6 +4449,20 @@ class Client {
     return n;
   }
 
+  // Out-of-band descriptor traffic summed over every striped conn —
+  // the client-side proof that the zero-copy shm tier engaged.
+  void TransportStats(uint64_t* oob_sent, uint64_t* oob_recvd) const {
+    uint64_t snt = 0, rcv = 0;
+    for (auto& g : groups_)
+      for (auto& c : g->conns)
+        if (c) {
+          snt += c->oob_sent();
+          rcv += c->oob_recvd();
+        }
+    *oob_sent = snt;
+    *oob_recvd = rcv;
+  }
+
   int TotalConns() const {
     int n = 0;
     for (auto& g : groups_) n += (int)g->conns.size();
@@ -3629,6 +4531,34 @@ void* bps_server_create_dbg(int port, int num_workers, int engine_threads,
 }
 
 int bps_server_run(void* s) { return ((bps::Server*)s)->Run(); }
+
+// Per-stage server data-plane counters (docs/observability.md `server`
+// section): out[0]=recv_ns [1]=recv_count [2]=queue_ns [3]=queue_count
+// [4]=fold_ns [5]=fold_count [6]=fold_bytes [7]=reply_ns
+// [8]=reply_count [9]=direct_recvs [10]=oob_msgs [11]=simd_tier
+// [12]=engine_threads. Returns slots filled (layout append-only).
+int bps_server_stats(void* s, uint64_t* out, int max_n) {
+  auto* srv = (bps::Server*)s;
+  const bps::StageStats& st = srv->stats();
+  uint64_t v[13] = {
+      st.recv_ns.load(),  st.recv_count.load(),  st.queue_ns.load(),
+      st.queue_count.load(), st.fold_ns.load(),  st.fold_count.load(),
+      st.fold_bytes.load(),  st.reply_ns.load(), st.reply_count.load(),
+      st.direct_recvs.load(), st.oob_msgs.load(),
+      (uint64_t)srv->simd_tier(), (uint64_t)srv->num_engines()};
+  int n = max_n < 13 ? max_n : 13;
+  for (int i = 0; i < n; ++i) out[i] = v[i];
+  return n;
+}
+
+// Cumulative queued payload bytes per engine thread — the balance
+// proof for byte-weighted key placement. Returns engines filled.
+int bps_server_engine_bytes(void* s, uint64_t* out, int max_n) {
+  auto* srv = (bps::Server*)s;
+  int n = srv->num_engines() < max_n ? srv->num_engines() : max_n;
+  for (int i = 0; i < n; ++i) out[i] = srv->engine_fold_bytes(i);
+  return n;
+}
 
 void bps_server_destroy(void* s) { delete (bps::Server*)s; }
 
@@ -3738,6 +4668,19 @@ int bps_client_barrier(void* c) { return ((bps::Client*)c)->Barrier(); }
 
 int bps_client_ipc_conns(void* c) { return ((bps::Client*)c)->IpcConns(); }
 
+// Client transport counters: out[0]=ipc conns, out[1]=total conns,
+// out[2]=oob descriptor messages sent, out[3]=oob received. Returns
+// how many slots were filled (layout is append-only).
+int bps_client_transport_stats(void* c, uint64_t* out, int max_n) {
+  auto* cl = (bps::Client*)c;
+  uint64_t v[4] = {(uint64_t)cl->IpcConns(), (uint64_t)cl->TotalConns(),
+                   0, 0};
+  cl->TransportStats(&v[2], &v[3]);
+  int n = max_n < 4 ? max_n : 4;
+  for (int i = 0; i < n; ++i) out[i] = v[i];
+  return n;
+}
+
 int bps_client_total_conns(void* c) {
   return ((bps::Client*)c)->TotalConns();
 }
@@ -3789,5 +4732,32 @@ int bps_codec_decompress(void* h, const uint8_t* in, uint32_t len,
 }
 
 void bps_codec_destroy(void* h) { delete (bps::CompressorCfg*)h; }
+
+// ---------------------------------------------------------------- //
+// SIMD fold probe: the parity-test surface for the dispatched
+// accumulate kernels (tests/test_native_plane.py asserts every
+// available tier is BITWISE identical to the scalar loop).
+// ---------------------------------------------------------------- //
+
+// Best tier this host+build supports: 0 scalar, 2 AVX2, 3 AVX-512.
+int bps_simd_best() { return bps::simd_best_supported(); }
+
+// dst += src over nbytes of `dtype` (DataType wire code) using the
+// requested tier (-1 = auto). Returns the tier actually used, or -1
+// when the request names a tier this host/build cannot run (the
+// parity suite skips, never silently tests the wrong kernel).
+int bps_fold_probe(int dtype, void* dst, const void* src,
+                   uint64_t nbytes, int tier) {
+  int best = bps::simd_best_supported();
+  if (tier > best) return -1;
+  const char* want = nullptr;
+  if (tier == bps::kSimdScalar) want = "scalar";
+  else if (tier == bps::kSimdAvx2) want = "avx2";
+  else if (tier == bps::kSimdAvx512) want = "avx512";
+  bps::FoldKernels k = bps::resolve_fold_kernels(want);
+  if (tier >= 0 && k.tier != tier) return -1;
+  bps::sum_into(dst, src, (size_t)nbytes, (uint32_t)dtype, k);
+  return k.tier;
+}
 
 }  // extern "C"

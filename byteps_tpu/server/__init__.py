@@ -7,18 +7,124 @@ reference: byteps/server/__init__.py:21-27, launcher/launch.py:241-249).
 
 The server itself is native C++ (byteps_tpu/native/ps.cc): engine threads,
 per-key stores, first-copy/sum/all-recv aggregation, parked pulls, sync +
-async modes. This package holds the thin Python entry and the worker-side
-client (client.py).
+async modes. This package holds the thin Python entry, the worker-side
+client (client.py), and the in-process stats mirror below: servers that
+run inside this interpreter (the loopback test/bench topology) register
+their native handle while serving, so ``stage_stats()`` can read the
+per-stage data-plane counters (recv → queue-wait → fold → reply, plus
+the SIMD tier and the zero-copy tier engagement) that surface as the
+``server`` section of ``bps.get_metrics()`` (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-from typing import Optional
+import threading
+from typing import Dict, List, Optional
 
 from ..config import Config
 from ..native.build import build
+
+# native handles of servers currently serving IN THIS PROCESS
+# (run_server registers around its blocking Run); remote/subprocess
+# servers are invisible here by construction — their counters belong to
+# their own process's snapshot
+_live_mu = threading.Lock()
+_live: list = []  # [(lib, ptr), ...]; every access under _live_mu
+
+# bps_server_stats slot layout (append-only contract with native/ps.cc)
+_STAT_SLOTS = (
+    "recv_ns", "recv_count", "queue_ns", "queue_count", "fold_ns",
+    "fold_count", "fold_bytes", "reply_ns", "reply_count",
+    "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
+)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.bps_server_create_dbg.restype = ctypes.c_void_p
+    lib.bps_server_create_dbg.argtypes = [ctypes.c_int] * 5 + [
+        ctypes.c_int64]
+    lib.bps_server_run.argtypes = [ctypes.c_void_p]
+    lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "bps_server_stats"):
+        # guarded: a stale .so predating the stats ABI must still serve
+        lib.bps_server_stats.restype = ctypes.c_int
+        lib.bps_server_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int]
+        lib.bps_server_engine_bytes.restype = ctypes.c_int
+        lib.bps_server_engine_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int]
+    return lib
+
+
+def stage_stats() -> Dict[str, int]:
+    """Raw per-stage counters summed over every live in-process server
+    (zeros when none — remote fleets export from their own process).
+    ``simd_tier``/``engine_threads`` report the max across servers (one
+    topology per process in practice)."""
+    out = {k: 0 for k in _STAT_SLOTS}
+    buf = (ctypes.c_uint64 * len(_STAT_SLOTS))()
+    # the native calls run UNDER _live_mu: run_server destroys its
+    # handle under the same lock, so a metrics poll racing a server
+    # shutdown reads live-or-absent, never freed (use-after-free)
+    with _live_mu:
+        n_live = len(_live)
+        for lib, ptr in _live:
+            if not hasattr(lib, "bps_server_stats"):
+                continue
+            n = lib.bps_server_stats(ptr, buf, len(_STAT_SLOTS))
+            for i in range(n):
+                k = _STAT_SLOTS[i]
+                if k in ("simd_tier", "engine_threads"):
+                    out[k] = max(out[k], int(buf[i]))
+                else:
+                    out[k] += int(buf[i])
+    out["live"] = n_live
+    return out
+
+
+def engine_stats() -> List[List[int]]:
+    """Cumulative queued payload bytes per engine thread, one list per
+    live in-process server — the balance-proof surface for the
+    byte-weighted key→engine placement (tests/test_native_plane.py)."""
+    out: List[List[int]] = []
+    buf = (ctypes.c_uint64 * 64)()
+    with _live_mu:  # see stage_stats: excludes a concurrent destroy
+        for lib, ptr in _live:
+            if not hasattr(lib, "bps_server_engine_bytes"):
+                continue
+            n = lib.bps_server_engine_bytes(ptr, buf, 64)
+            out.append([int(buf[i]) for i in range(n)])
+    return out
+
+
+def stage_section() -> Dict[str, float]:
+    """The ``server`` section of ``bps.get_metrics()``: per-stage walls
+    in milliseconds plus counts, the fold-byte total (the fold_ab
+    bench's HARD proof counter), zero-copy tier engagement, the active
+    SIMD tier, and how many servers are live in this process. Keys are
+    fixed whether or not a server is local, so the documented schema
+    resolves on every deployment."""
+    raw = stage_stats()
+    return {
+        "recv_ms": raw["recv_ns"] / 1e6,
+        "recv_count": raw["recv_count"],
+        "queue_wait_ms": raw["queue_ns"] / 1e6,
+        "queue_count": raw["queue_count"],
+        "fold_ms": raw["fold_ns"] / 1e6,
+        "fold_count": raw["fold_count"],
+        "fold_bytes": raw["fold_bytes"],
+        "reply_ms": raw["reply_ns"] / 1e6,
+        "reply_count": raw["reply_count"],
+        "direct_recvs": raw["direct_recvs"],
+        "oob_msgs": raw["oob_msgs"],
+        "simd_tier": raw["simd_tier"],
+        "engine_threads": raw["engine_threads"],
+        "live": raw["live"],
+    }
 
 
 def run_server(port: Optional[int] = None,
@@ -28,12 +134,7 @@ def run_server(port: Optional[int] = None,
     if port is None:
         server_id = int(os.environ.get("BYTEPS_SERVER_ID", "0"))
         port = config.scheduler_port + server_id
-    lib = ctypes.CDLL(build())
-    lib.bps_server_create_dbg.restype = ctypes.c_void_p
-    lib.bps_server_create_dbg.argtypes = [ctypes.c_int] * 5 + [
-        ctypes.c_int64]
-    lib.bps_server_run.argtypes = [ctypes.c_void_p]
-    lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
+    lib = _bind(ctypes.CDLL(build()))
     # per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
     # + BYTEPS_SERVER_DEBUG_KEY, server.cc:120-144,439-442)
     debug_key = -1
@@ -45,6 +146,19 @@ def run_server(port: Optional[int] = None,
         1 if config.enable_async else 0,
         1 if config.server_enable_schedule else 0,
         debug_key)
-    rc = lib.bps_server_run(srv)
-    lib.bps_server_destroy(srv)
+    entry = (lib, srv)
+    with _live_mu:
+        _live.append(entry)
+    try:
+        rc = lib.bps_server_run(srv)
+    finally:
+        # unregister AND destroy under the lock: stage_stats() /
+        # engine_stats() read the handle under _live_mu, so destroying
+        # outside it would free a pointer a poll is mid-read on
+        with _live_mu:
+            try:
+                _live.remove(entry)
+            except ValueError:
+                pass
+            lib.bps_server_destroy(srv)
     return rc

@@ -96,6 +96,12 @@ def _load_lib() -> ctypes.CDLL:
         lib.bps_client_server_dead.restype = ctypes.c_int
         lib.bps_client_server_dead.argtypes = [ctypes.c_void_p,
                                                ctypes.c_int]
+    if hasattr(lib, "bps_client_transport_stats"):
+        # guarded like the probes above (stale-.so version skew)
+        lib.bps_client_transport_stats.restype = ctypes.c_int
+        lib.bps_client_transport_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
     lib.bps_client_ipc_conns.argtypes = [ctypes.c_void_p]
@@ -294,6 +300,30 @@ class PSClient:
         if self._closed:
             raise RuntimeError("PSClient is closed")
         return int(self._lib.bps_client_ipc_conns(self._handle))
+
+    def transport_stats(self) -> dict:
+        """Client-side transport counters: shm-upgraded vs total
+        connections, and how many messages rode the zero-copy
+        descriptor (out-of-band arena) tier each direction —
+        ``oob_sent`` counts large pushes whose payload the server folds
+        IN PLACE from the shared arena, ``oob_recvd`` counts aggregate
+        replies copied once from the arena straight into the caller's
+        buffer. Zeros (with conns populated) when the transport is TCP
+        or the payloads are below the descriptor threshold; all zeros
+        on a stale native lib predating the ABI."""
+        if self._closed:
+            raise RuntimeError("transport_stats on a closed PSClient")
+        out = {"ipc_conns": 0, "total_conns": 0, "oob_sent": 0,
+               "oob_recvd": 0}
+        if not hasattr(self._lib, "bps_client_transport_stats"):
+            return out
+        buf = (ctypes.c_uint64 * 4)()
+        n = self._lib.bps_client_transport_stats(self._handle, buf, 4)
+        for i, k in enumerate(("ipc_conns", "total_conns", "oob_sent",
+                               "oob_recvd")):
+            if i < n:
+                out[k] = int(buf[i])
+        return out
 
     # ------------------------------------------------------------ #
     # per-server health (the elastic/failover plane)

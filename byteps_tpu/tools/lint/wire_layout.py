@@ -94,6 +94,70 @@ class WireLayoutRule(Rule):
         findings += self._check_header_mirror(project, hdr, rel_cc)
         findings += self._check_codec_ids(project, text, rel_cc)
         findings += self._check_dtypes(project, text, rel_cc)
+        findings += self._check_ipc_desc(project, text, rel_cc)
+        return findings
+
+    # -- IpcDesc (shm descriptor-ring framing) ------------------------- #
+
+    def _check_ipc_desc(self, project: Project, cc_text: str,
+                        rel_cc: str) -> List[Finding]:
+        """The out-of-band descriptor that rides the shm ring in place
+        of large payloads. It never crosses a language boundary (both
+        ring endpoints are the same .so), so a Python mirror is
+        OPTIONAL — but the struct itself must stay machine-checkable
+        (fixed-width fields + a matching static_assert, the same
+        internal-consistency bar as MsgHeader), and IF a mirror
+        (``IPC_DESC_FMT``) exists anywhere it must pack to the same
+        size. Guards the 8B->16B drift class inside the C++ side."""
+        findings: List[Finding] = []
+        desc = cpp.parse_header(cc_text, "IpcDesc")
+        if desc is None:
+            return findings  # tree predates the descriptor tier
+        if desc.computed_size is None:
+            findings.append(Finding(
+                self.name, rel_cc, desc.line,
+                "IpcDesc contains a non-fixed-width field type; ring "
+                "framing must use uint8_t..uint64_t only"))
+            return findings
+        if desc.asserted_size is None:
+            findings.append(Finding(
+                self.name, rel_cc, desc.line,
+                f"missing static_assert(sizeof(IpcDesc) == "
+                f"{desc.computed_size}) next to the struct"))
+        elif desc.asserted_size != desc.computed_size:
+            findings.append(Finding(
+                self.name, rel_cc, desc.assert_line,
+                f"static_assert says sizeof(IpcDesc) == "
+                f"{desc.asserted_size} but the declared fields sum to "
+                f"{desc.computed_size}"))
+        for path in project.py_files():
+            tree = project.tree(path)
+            if tree is None:
+                continue
+            node_line = _module_constants(tree).get("IPC_DESC_FMT")
+            if node_line is None:
+                continue
+            node, line = node_line
+            rel = project.rel(path)
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                findings.append(Finding(
+                    self.name, rel, line,
+                    "IPC_DESC_FMT is not a str literal"))
+                continue
+            try:
+                size = struct.calcsize(node.value)
+            except struct.error:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"IPC_DESC_FMT {node.value!r} is not a valid "
+                    f"struct format"))
+                continue
+            if size != desc.computed_size:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"IPC_DESC_FMT packs {size} bytes but native "
+                    f"IpcDesc is {desc.computed_size} bytes"))
         return findings
 
     # -- WIRE_MAGIC / WIRE_HEADER_FMT / WIRE_HEADER_BYTES -------------- #

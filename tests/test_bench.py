@@ -86,6 +86,14 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "wire_twoop_requests": 144,
                     "wire_request_ratio": 0.5,
                     "wire_half_proof": True}, None
+        if name == "fold_ab":
+            return {"fold_simd_gbps": 6.1,
+                    "fold_scalar_gbps": 3.2,
+                    "fold_simd_tier": 3,
+                    "fold_bytes_per_arm": 805306368,
+                    "fold_bytes_equal": True,
+                    "fold_direct_recvs": 96,
+                    "fold_oob_msgs": 120}, None
         if name == "shard_ab":
             return {"shard_on_step_ms": 3.9,
                     "shard_off_step_ms": 4.2,
@@ -123,8 +131,8 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:4] == ["pushpull_throttled", "scaling", "churn_ab",
-                             "codec_adapt_ab"]
+    assert cpu_calls[:5] == ["pushpull_throttled", "scaling", "churn_ab",
+                             "codec_adapt_ab", "fold_ab"]
     assert out["codec_adapt_proof"] is True
     assert out["codec_adapt_throttled_switches"] == 2
     assert out["codec_adapt_unthrottled_switches"] == 0
@@ -136,6 +144,8 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     assert out["stream_ttfp_on_ms"] == 0.9
     assert out["wire_fused_step_ms"] == 3.6
     assert out["wire_request_ratio"] == 0.5
+    assert out["fold_simd_gbps"] == 6.1
+    assert out["fold_bytes_equal"] is True
     assert out["shard_on_step_ms"] == 3.9
     assert out["shard_reduction_ratio"] == 8.0
     assert out["pushpull_throttled_2srv_gbps"] == 0.2
@@ -152,7 +162,12 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
 def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     def script(name, calls):
         if name == "probe":
-            return None, "timeout"
+            # the staged probe ATTRIBUTES the wedge (the BENCH_r03-r05
+            # rc=3 class): stage name + real traceback in the result
+            return {"ok": False, "stage": "tiny_ones",
+                    "error": ("Traceback (most recent call last):\n"
+                              "  ...\nRuntimeError: backend wedged in "
+                              "jnp.ones")}, None
         if name in ("train", "pushpull_tpu"):
             raise AssertionError("device phase must not run unprobed")
         if name == "pushpull":
@@ -179,6 +194,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"wire_fused_step_ms": 3.6,
                     "wire_twoop_step_ms": 4.1,
                     "wire_request_ratio": 0.5}, None
+        if name == "fold_ab":
+            return {"fold_simd_gbps": 6.1,
+                    "fold_scalar_gbps": 3.2,
+                    "fold_bytes_equal": True}, None
         if name == "shard_ab":
             return {"shard_on_step_ms": 3.9,
                     "shard_off_step_ms": 4.2,
@@ -200,7 +219,7 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     assert out["value"] is None and out["mfu"] is None
     # CPU numbers still land
     assert out["pushpull_dense_gbps"] == 3.0
-    assert out["phase_errors"]["probe"] == "timeout"
+    assert out["phase_errors"]["probe"].startswith("bad probe")
     # attempts spread across the run: start + after each CPU phase +
     # budget-derived final rounds (the loop keeps retrying while budget
     # remains — ending with unused budget is strictly worse; the cap is
@@ -209,19 +228,50 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 11 CPU phases + finals
-    assert calls.count("probe") == 12 + n_final
+    # start + one attempt after each of the 12 CPU phases + finals
+    assert calls.count("probe") == 13 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
-        "after_churn_ab", "after_codec_adapt_ab", "after_pushpull",
-        "after_pushpull_2srv",
+        "after_churn_ab", "after_codec_adapt_ab", "after_fold_ab",
+        "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_stream_ab",
         "after_wire_ab", "after_shard_ab",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
-    assert all(d.get("err") == "timeout" for d in probes)
+    # the wedged stage and its traceback ride every diag entry — a dead
+    # round is attributable from BENCH_rNN.json alone
+    assert all(d.get("probe_stage") == "tiny_ones" for d in probes)
+    assert all("RuntimeError: backend wedged" in d.get("probe_error", "")
+               for d in probes)
     assert any(str(d.get("at", "")).startswith("final_wait")
                for d in out["tunnel_diag"])
+
+
+def test_phase_probe_attributes_wedges(bench, monkeypatch):
+    """The staged probe (the BENCH_r03-r05 rc=3 wedge satellite): a
+    healthy backend passes all three stages; a RAISING stage returns
+    the real traceback; a HUNG stage returns within its own deadline
+    carrying the worker's live stack — never a bare watchdog kill."""
+    out = bench.phase_probe()
+    assert out["ok"] is True and out["stage"] == "done"
+    assert out["tiny_ok"] is True
+
+    def boom():
+        raise RuntimeError("tunnel wedged in jnp.ones")
+
+    monkeypatch.setattr(bench, "_setup_device_backend", boom)
+    out = bench.phase_probe()
+    assert out["ok"] is False and out["stage"] == "backend"
+    assert "RuntimeError: tunnel wedged" in out["error"]
+
+    import threading as _t
+
+    monkeypatch.setenv("BENCH_PROBE_STAGE_S", "0.5")
+    monkeypatch.setattr(bench, "_setup_device_backend",
+                        lambda: _t.Event().wait())  # hangs forever
+    out = bench.phase_probe()
+    assert out["ok"] is False and out["stage"] == "backend"
+    assert "hung" in out["error"] and "Event().wait()" in out["error"]
 
 
 def test_late_recovery_lands_train(bench, monkeypatch, capsys):
@@ -338,7 +388,7 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "churn_ab",
-                            "codec_adapt_ab", "arena_ab",
+                            "codec_adapt_ab", "fold_ab", "arena_ab",
                             "metrics_ab", "stream_ab", "wire_ab",
                             "shard_ab", "scaling"}
 

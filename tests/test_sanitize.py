@@ -53,11 +53,25 @@ def worker(w):
     c.init_tensor(rctx, np.zeros(1024, np.float32))
     fctx = r.init_tensor("fusedep", 1024 * 4, DataType.FLOAT32)
     c.init_tensor(fctx, np.zeros(1024, np.float32))
+    # descriptor-tier key (>= 64KB): over the shm transport the payload
+    # rides the ring as an 8-byte descriptor and the server folds it IN
+    # PLACE from the shared arena — worker 0's push lands in the key's
+    # accumulator (zero-copy first fold), worker 1's goes through the
+    # per-engine fold SCRATCH, and the test env's small arena forces the
+    # block ring to wrap+reclaim while both workers race. The perf-PR
+    # additions (SIMD fold, OOB descriptors, buffer pool) are all inside
+    # this loop's shadow under the sanitizer.
+    octx = r.init_tensor("oob", 24 * 1024 * 4, DataType.FLOAT32)
+    c.init_tensor(octx, np.zeros(24 * 1024, np.float32))
     for step in range(15):
         for ctx in ctxs:
             x = rng.randn(3000).astype(np.float32)
             c.push_pull(ctx, x, average=True, num_workers=2)
         ct.push_pull(rng.randn(2048).astype(np.float32))
+        # descriptor-tier round: arena in-place fold + fold scratch +
+        # block reclaim, raced by both workers every step
+        c.push_pull(octx, rng.randn(24 * 1024).astype(np.float32),
+                    average=True, num_workers=2)
         # async-push path (detached waiters drain in RecvLoop while the
         # paired pull waits on the same key-affine conn): the round-4
         # concurrency addition, stressed under the sanitizer like the
@@ -211,6 +225,9 @@ def test_sanitized_loopback_stress(tmp_path, mode):
         "BYTEPS_SANITIZE": mode,
         "LD_PRELOAD": runtime,
         opts_var: opts,
+        # small arena: the stress's 96KB descriptor-tier rounds wrap
+        # and reclaim the block ring many times under the sanitizer
+        "BYTEPS_IPC_ARENA_BYTES": str(512 << 10),
         # jax under sanitizers is hopeless; the stress uses numpy only
         "JAX_PLATFORMS": "cpu",
     }
