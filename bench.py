@@ -408,7 +408,13 @@ def _loopback_ps(num_servers: int):
     GlobalState, bps.init(). Yields the initialized ``byteps_tpu``
     module; teardown shuts the worker down and joins the servers. One
     definition so a rendezvous/teardown fix lands in every phase at
-    once."""
+    once.
+
+    ``bench.py --trace-dir DIR`` (BENCH_TRACE_DIR in phase children):
+    ANY phase riding this scaffolding also captures the fused fleet
+    Chrome trace (worker spans + wire-sampled server stage spans,
+    clock-aligned + rid-linked; docs/timeline.md) and drops it next to
+    the JSON result as ``DIR/<phase>[.N].trace.json`` at teardown."""
     _force_cpu()
     import threading
 
@@ -416,6 +422,15 @@ def _loopback_ps(num_servers: int):
     from byteps_tpu.core.state import GlobalState
     from byteps_tpu.server import run_server
     from byteps_tpu.utils.net import free_port
+
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:
+        # full-window worker tracing + server wire sampling, unless the
+        # phase itself pinned the knobs (trace_ab owns its own arms)
+        os.environ.setdefault("BYTEPS_TRACE_ON", "1")
+        os.environ.setdefault("BYTEPS_TRACE_START_STEP", "0")
+        os.environ.setdefault("BYTEPS_TRACE_END_STEP", "1000000000")
+        os.environ.setdefault("BYTEPS_TRACE_SAMPLE", "4")
 
     ports = []
     while len(ports) < num_servers:
@@ -444,6 +459,25 @@ def _loopback_ps(num_servers: int):
     try:
         yield bps
     finally:
+        if trace_dir:
+            try:
+                # BEFORE shutdown: the drain + clock probes need the
+                # live client. Several _loopback_ps per phase (A/B
+                # arms) each get their own numbered artifact.
+                phase = os.environ.get("BENCH_PHASE", "phase")
+                os.makedirs(trace_dir, exist_ok=True)
+                path = os.path.join(trace_dir, f"{phase}.trace.json")
+                n = 1
+                while os.path.exists(path):
+                    path = os.path.join(trace_dir,
+                                        f"{phase}.{n}.trace.json")
+                    n += 1
+                out = bps.dump_fused_trace(path)
+                if out:
+                    sys.stderr.write(f"[bench] fused trace: {out}\n")
+            except Exception as e:  # noqa: BLE001 - aux artifact
+                sys.stderr.write(f"[bench] fused-trace dump failed: "
+                                 f"{e!r}\n")
         bps.shutdown()
         for t in servers:
             t.join(timeout=20)
@@ -961,6 +995,100 @@ def phase_metrics_ab(steps: int = 6, reps: int = 3) -> dict:
             "metrics_last_step_report": {
                 k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in last.items()}}
+
+
+def phase_trace_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the fleet observability trace plane (BYTEPS_TRACE_SAMPLE +
+    BYTEPS_TRACE_ON; docs/timeline.md): the same model/batch trained
+    through the loopback PS with full worker tracing + every-8th-
+    request server wire sampling vs both off, INTERLEAVED reps
+    (host-load drift lands on both arms), best-of step wall per arm.
+    The acceptance bar is sampling overhead <= 2% of step wall. The ON
+    arm also proves the plane ENGAGED (not vacuously cheap): the
+    server's trace ring must hold records (drained over the wire
+    control op) and the fused dump must carry rid flow links."""
+    import gc
+    import json as _json
+    import tempfile
+
+    def run(enabled: bool, walls: list, proof: dict):
+        os.environ["BYTEPS_TRACE_ON"] = "1" if enabled else "0"
+        os.environ["BYTEPS_TRACE_START_STEP"] = "0"
+        os.environ["BYTEPS_TRACE_END_STEP"] = "1000000000"
+        os.environ["BYTEPS_TRACE_SAMPLE"] = "8" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # the metrics_ab layout: 4MB leaves ride their own keys
+            # through every traced stage, biases keep the fused bucket
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            if enabled and not proof:
+                state = get_state()
+                st = state.ps_client.server_stats(0, timeout_s=5)
+                proof["server_records"] = int(
+                    st["trace_records"]) if st else 0
+                tmp = os.path.join(tempfile.mkdtemp(prefix="bpstr"),
+                                   "fused.json")
+                out = bps.dump_fused_trace(tmp)
+                links = 0
+                if out:
+                    with open(out) as f:
+                        links = _json.load(f).get(
+                            "metadata", {}).get("rid_flow_links", 0)
+                proof["rid_links"] = int(links)
+
+    keys = ("BYTEPS_TRACE_ON", "BYTEPS_TRACE_START_STEP",
+            "BYTEPS_TRACE_END_STEP", "BYTEPS_TRACE_SAMPLE")
+    prior = {k: os.environ.get(k) for k in keys}
+    on_walls, off_walls, proof = [], [], {}
+    try:
+        for _ in range(reps):
+            run(True, on_walls, proof)
+            run(False, off_walls, {})
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    on_ms = min(on_walls) * 1e3
+    off_ms = min(off_walls) * 1e3
+    return {"trace_on_step_ms": round(on_ms, 2),
+            "trace_off_step_ms": round(off_ms, 2),
+            "trace_overhead_pct": round(
+                (on_ms - off_ms) / off_ms * 100.0, 2) if off_ms else None,
+            "trace_server_records": proof.get("server_records"),
+            "trace_rid_links": proof.get("rid_links")}
 
 
 def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
@@ -1587,6 +1715,7 @@ _PHASES = {
     "codec_adapt_ab": phase_codec_adapt_ab,
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
+    "trace_ab": phase_trace_ab,
     "stream_ab": phase_stream_ab,
     "wire_ab": phase_wire_ab,
     "fold_ab": phase_fold_ab,
@@ -1614,6 +1743,8 @@ def _child_main(name: str) -> None:
         wd = threading.Timer(budget, _fire)
         wd.daemon = True
         wd.start()
+    # name the phase for aux artifacts (--trace-dir's fused traces)
+    os.environ["BENCH_PHASE"] = name
     result = _PHASES[name]()
     print(_MARK + json.dumps(result), flush=True)
     # Do not rely on clean interpreter teardown (daemon threads / device
@@ -1670,6 +1801,19 @@ def _run_phase(name: str, timeout_s: float):
 
 
 def main() -> None:
+    # --trace-dir DIR: every phase riding _loopback_ps also emits its
+    # fused fleet Chrome trace (docs/timeline.md) next to the JSON
+    # result, as DIR/<phase>[.N].trace.json. Exported through the env
+    # so phase CHILDREN (separate processes) inherit it.
+    argv = list(sys.argv)
+    if "--trace-dir" in argv:
+        i = argv.index("--trace-dir")
+        if i + 1 >= len(argv):
+            sys.stderr.write("bench.py: --trace-dir needs a directory\n")
+            sys.exit(2)
+        os.environ["BENCH_TRACE_DIR"] = os.path.abspath(argv[i + 1])
+        del argv[i:i + 2]
+        sys.argv = argv
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         _child_main(sys.argv[2])
         return
@@ -1697,6 +1841,11 @@ def main() -> None:
         "metrics_on_step_ms": None,
         "metrics_off_step_ms": None,
         "metrics_overhead_pct": None,
+        "trace_on_step_ms": None,
+        "trace_off_step_ms": None,
+        "trace_overhead_pct": None,
+        "trace_server_records": None,
+        "trace_rid_links": None,
         "stream_on_step_ms": None,
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
@@ -1900,6 +2049,12 @@ def main() -> None:
                             # frozen (BYTEPS_METRICS=0) step wall — the
                             # <=2% observability-overhead guard
                             ("metrics_ab", 240.0),
+                            # fleet-trace A/B: full worker tracing +
+                            # server wire sampling vs off — the <=2%
+                            # sampling-overhead guard, plus the
+                            # engaged-proof (server trace records +
+                            # rid flow links in the fused dump)
+                            ("trace_ab", 240.0),
                             # COMPUTE/PUSH/UPDATE pipeline A/B: stream
                             # export + sharded apply on vs off, step
                             # wall + time-to-first-push

@@ -39,7 +39,8 @@ __all__ = [
     "push_pull", "push_pull_async", "poll", "synchronize", "broadcast",
     "declare_tensor", "profiler_step",
     "get_pushpull_speed", "get_metrics", "get_step_reports",
-    "get_arena_stats",
+    "get_arena_stats", "get_fleet_metrics", "dump_flight_record",
+    "dump_fused_trace",
     "Config", "DataType", "QueueType", "Status",
 ]
 
@@ -113,6 +114,43 @@ def get_metrics() -> dict:
     """
     state = get_state()
     return state.metrics.snapshot()
+
+
+def get_fleet_metrics() -> dict:
+    """The fleet-wide metrics snapshot: the worker's full
+    ``get_metrics()`` registry with the ``fleet`` section populated —
+    one per-stage stats dict PER SERVER (keyed by server index), pulled
+    over the STATS_PULL control op when the servers are out-of-process
+    (subprocess/remote fleets stop being black boxes) and from the
+    in-process mirror otherwise. ``fleet.source`` says which path
+    answered (``wire`` / ``local`` / ``none``). The same section backs
+    the Prometheus endpoint's ``byteps_fleet_*{server="<idx>"}``
+    series, so scraping and calling can never disagree
+    (docs/observability.md)."""
+    return get_metrics()
+
+
+def dump_flight_record(path: Optional[str] = None) -> Optional[str]:
+    """Write the merged crash flight record (worker event ring + every
+    reachable server's ring, clock-aligned into one causal timeline) as
+    JSON; returns the path, or None when the recorder is off
+    (``BYTEPS_FLIGHT_RECORDER=0``) and no server has events. Also fired
+    automatically on SIGTERM and on fatal wire errors — the fail-fast
+    error message names the dump (docs/fault-tolerance.md)."""
+    from .core import flight
+    return flight.dump(path=path, reason="api")
+
+
+def dump_fused_trace(path: Optional[str] = None) -> Optional[str]:
+    """Emit the fused fleet Chrome trace (docs/timeline.md): the
+    worker's comm spans plus every server's wire-sampled stage spans
+    (``BYTEPS_TRACE_SAMPLE``), clock-aligned and rid-linked on one
+    timeline. Returns the written path, or None when tracing never
+    produced events (tracer off, sample 0)."""
+    tracer = get_state().tracer
+    if tracer is None:
+        return None
+    return tracer.dump(path=path)
 
 
 def get_step_reports() -> list:

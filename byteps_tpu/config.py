@@ -198,6 +198,21 @@ class Config:
     # shutdown: device (XLA) trace for TensorBoard/Perfetto, with the
     # host comm spans mirrored in as TraceAnnotations (SURVEY §5.1 note)
     jax_profiler_dir: str = ""            # BYTEPS_JAX_PROFILER_DIR
+    # --- fleet observability plane (rebuild addition; docs/timeline.md
+    # fused timeline + docs/observability.md "fleet"). trace_sample:
+    # the server records every Nth data request's recv→queue-wait→fold
+    # →reply span tuple into a native ring (0 = off) drained by the
+    # TRACE_DRAIN control op and fused — clock-aligned and rid-linked —
+    # into the worker's Chrome trace by Tracer.dump(). trace_ring
+    # bounds that ring. flight_recorder arms the bounded structured
+    # event ring (worker ring here, native ring on every server; ring
+    # capacity flight_ring) dumped on SIGTERM / fatal wire errors or
+    # via bps.dump_flight_record() into flight_dir. ---
+    trace_sample: int = 0                 # BYTEPS_TRACE_SAMPLE
+    trace_ring: int = 4096                # BYTEPS_TRACE_RING
+    flight_recorder: bool = True          # BYTEPS_FLIGHT_RECORDER
+    flight_ring: int = 2048               # BYTEPS_FLIGHT_RING
+    flight_dir: str = "./flight"          # BYTEPS_FLIGHT_DIR
     telemetry_on: bool = True             # BYTEPS_TELEMETRY_ON
     debug_sample_tensor: str = ""         # BYTEPS_DEBUG_SAMPLE_TENSOR
 
@@ -266,6 +281,11 @@ class Config:
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             jax_profiler_dir=_env_str("BYTEPS_JAX_PROFILER_DIR", ""),
+            trace_sample=_env_int("BYTEPS_TRACE_SAMPLE", 0),
+            trace_ring=_env_int("BYTEPS_TRACE_RING", 4096),
+            flight_recorder=_env_bool("BYTEPS_FLIGHT_RECORDER", True),
+            flight_ring=_env_int("BYTEPS_FLIGHT_RING", 2048),
+            flight_dir=_env_str("BYTEPS_FLIGHT_DIR", "./flight"),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             metrics_on=_env_bool("BYTEPS_METRICS", True),

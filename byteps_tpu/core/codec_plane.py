@@ -383,6 +383,10 @@ class CodecPlane:
         plan.epoch += 1
         if self._m_switches is not None:
             self._m_switches.inc()
+        from . import flight
+        flight.record("codec_switch", key=ctx.declared_key,
+                      detail=f"{ctx.name} {prev}->{tier} "
+                             f"epoch={plan.epoch}")
         log.info("codec plane: %r %s -> %s (plan epoch %d)",
                  ctx.name, prev, tier, plan.epoch)
 

@@ -1,0 +1,205 @@
+"""Crash flight recorder — the worker half of the fault-plane timeline.
+
+A bounded, preallocated ring of structured events (wire retries, server
+failovers, key migrations, codec switches, round failures) that the
+fault paths record as they happen, dumped as JSON on SIGTERM / fatal
+wire errors or on demand via ``bps.dump_flight_record()``. The native
+server keeps the mirror-image ring (``native/ps.cc`` FlightRec:
+replay-dedup hits, codec rejects, chaos injections, worker departures),
+snapshot-drained over the FLIGHT_DRAIN control op and merged into the
+same dump — chaos-test debugging becomes a causal timeline instead of
+log archaeology (docs/fault-tolerance.md, docs/observability.md).
+
+Module-level singleton by design: the recording sites (scheduler retry
+path, registry migration, codec plane) must not need plumbing to emit
+an event — ``flight.record(...)`` is always safe, a no-op until
+``configure()`` arms it at ``bps.init()`` (BYTEPS_FLIGHT_RECORDER,
+default on). The ring slots are preallocated and recording is one lock
++ a tuple store: cheap enough for fault paths, which are off the hot
+path by definition.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder", "configure", "get_recorder", "record",
+    "set_server_collector", "dump", "install_signal_handler",
+]
+
+
+class FlightRecorder:
+    """Fixed-capacity drop-oldest event ring. Each event is
+    ``(ts_ns, kind, key, rid, detail)`` with ``ts_ns`` on the same
+    steady clock (``time.monotonic_ns``) the native rings and the
+    clock-offset estimator use, so worker and server events sort onto
+    one causal timeline."""
+
+    def __init__(self, capacity: int = 2048, enabled: bool = True):
+        self.capacity = max(16, int(capacity))
+        self.enabled = enabled
+        self._mu = threading.Lock()
+        self._slots: List[Optional[tuple]] = [None] * self.capacity
+        self._w = 0        # guarded-by: _mu (total events ever recorded)
+        self._dropped = 0  # guarded-by: _mu
+
+    def record(self, kind: str, key: int = 0, rid: int = 0,
+               detail: str = "") -> None:
+        if not self.enabled:
+            return
+        ev = (time.monotonic_ns(), str(kind), int(key), int(rid),
+              str(detail)[:256])
+        with self._mu:
+            if self._w >= self.capacity:
+                self._dropped += 1
+            self._slots[self._w % self.capacity] = ev
+            self._w += 1
+
+    def events(self) -> List[dict]:
+        """Ring contents, oldest first (non-destructive — like the
+        server's FLIGHT_DRAIN, a read never steals a crash dump's
+        evidence)."""
+        with self._mu:
+            w = self._w
+            start = max(0, w - self.capacity)
+            evs = [self._slots[i % self.capacity] for i in range(start, w)]
+        return [{"ts_ns": e[0], "kind": e[1], "key": e[2], "rid": e[3],
+                 "detail": e[4]} for e in evs if e is not None]
+
+    def snapshot(self) -> dict:
+        """The ``flight`` section of ``bps.get_metrics()`` (fixed keys,
+        docs/observability.md schema)."""
+        with self._mu:
+            return {"enabled": self.enabled, "capacity": self.capacity,
+                    "events": self._w, "dropped": self._dropped}
+
+
+# armed by configure() at bps.init(); a disabled recorder makes every
+# record() a flag check, so call sites never branch
+_recorder = FlightRecorder(enabled=False)
+# () -> [{"server": idx, "offset_ns": o, "events": [...]}] — set by
+# core/state.py when a PS client with the control ops is connected;
+# best-effort (a dead fleet dumps worker events alone)
+_server_collector: Optional[Callable[[], list]] = None
+_dump_dir = "./flight"
+_prev_sigterm = None
+_handler_installed = False
+
+
+def configure(capacity: int = 2048, enabled: bool = True,
+              dump_dir: str = "./flight") -> FlightRecorder:
+    """Fresh recorder per init lifecycle (counters start clean, like
+    the metrics registry); returns it for the state to own."""
+    global _recorder, _dump_dir, _server_collector
+    _recorder = FlightRecorder(capacity=capacity, enabled=enabled)
+    _dump_dir = dump_dir
+    _server_collector = None
+    return _recorder
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, key: int = 0, rid: int = 0, detail: str = "") -> None:
+    """THE event entry point for fault-path call sites (scheduler
+    retries/failovers, registry migrations, codec switches)."""
+    _recorder.record(kind, key=key, rid=rid, detail=detail)
+
+
+def set_server_collector(fn: Optional[Callable[[], list]]) -> None:
+    global _server_collector
+    _server_collector = fn
+
+
+def dump(path: Optional[str] = None, reason: str = "manual"
+         ) -> Optional[str]:
+    """Write the merged flight record as JSON and return its path
+    (None when the recorder is disabled and no server has events).
+
+    Shape: worker events plus a per-server section (snapshot-drained
+    over FLIGHT_DRAIN when a collector is wired), and one ``merged``
+    causal timeline — server timestamps mapped onto the worker's
+    steady clock via each server's estimated offset, then everything
+    sorted by aligned time. Best-effort by construction: a dead fleet
+    still dumps the worker's half."""
+    worker_events = _recorder.events()
+    servers = []
+    if _server_collector is not None:
+        try:
+            servers = _server_collector() or []
+        except Exception:  # noqa: BLE001 - the dump must never raise
+            servers = []
+    if not _recorder.enabled and not any(
+            s.get("events") for s in servers):
+        return None
+    merged = [dict(e, source="worker") for e in worker_events]
+    for entry in servers:
+        off = int(entry.get("offset_ns", 0))
+        for e in entry.get("events", []):
+            merged.append({
+                "ts_ns": int(e.get("ts_ns", 0)) - off,  # aligned
+                "kind": e.get("kind"), "key": e.get("key", 0),
+                "rid": e.get("rid", 0),
+                "detail": f"sender={e.get('sender', 0)} "
+                          f"detail={e.get('detail', 0)}",
+                "source": f"server{entry.get('server', 0)}"})
+    merged.sort(key=lambda e: e["ts_ns"])
+    out_path = path
+    if out_path is None:
+        os.makedirs(_dump_dir, exist_ok=True)
+        out_path = os.path.join(
+            _dump_dir, f"flight-{os.getpid()}.json")
+    else:
+        parent = os.path.dirname(os.path.abspath(out_path))
+        os.makedirs(parent, exist_ok=True)
+    doc = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "recorded_at_monotonic_ns": time.monotonic_ns(),
+        "worker": {"events": worker_events,
+                   "stats": _recorder.snapshot()},
+        "servers": servers,
+        "merged": merged,
+    }
+    try:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, default=str)
+    except OSError:
+        return None
+    return out_path
+
+
+def install_signal_handler() -> None:
+    """Dump the flight record on SIGTERM (the fleet-kill shape), then
+    chain to whatever handler was installed before us. Main-thread
+    only (signal.signal raises elsewhere); idempotent."""
+    global _prev_sigterm, _handler_installed
+    if _handler_installed:
+        return
+
+    def _on_term(signum, frame):
+        path = dump(reason="SIGTERM")
+        if path:
+            import sys
+            sys.stderr.write(
+                f"[byteps_tpu] SIGTERM: flight record dumped to "
+                f"{path}\n")
+        prev = _prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        _prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+        _handler_installed = True
+    except ValueError:
+        pass  # not the main thread (embedded/test harness): skip

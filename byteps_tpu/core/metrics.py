@@ -48,7 +48,7 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "StepReport", "StepProfiler", "classify_step",
+    "StepReport", "StepProfiler", "classify_step", "server_attribution",
     "prometheus_text", "start_http_server",
 ]
 
@@ -307,6 +307,19 @@ class StepReport:
     # dispatch wall — the gathers themselves complete asynchronously
     # under XLA, overlapped with later pulls). 0.0 when no leaf sharded.
     allgather_ms: float = 0.0
+    # Server attribution (fleet observability plane): per-stage server
+    # walls accrued DURING this step, summed over the fleet — deltas of
+    # the per-stage counters the StepProfiler's fleet probe snapshots
+    # at the step boundaries (in-process mirror or the STATS_PULL wire
+    # op). Same units as pull_total_ms (sums over this step's
+    # requests), so classify_step can split a PULL-bound verdict into
+    # queue-wait-bound / fold-bound / wire-bound. None = no probe (no
+    # fleet reachable), never silently 0.
+    pull_total_ms: Optional[float] = None
+    server_recv_ms: Optional[float] = None
+    server_queue_ms: Optional[float] = None
+    server_fold_ms: Optional[float] = None
+    server_reply_ms: Optional[float] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -319,6 +332,34 @@ def _p95(samples: List[float]) -> Optional[float]:
     return s[min(len(s) - 1, int(0.95 * len(s)))]
 
 
+def server_attribution(r: StepReport) -> Optional[tuple]:
+    """Split a step's PULL time across the server's stages. Returns
+    ``(sub_verdict, queue_ms, fold_ms, wire_ms)`` or None when the
+    probe didn't run.
+
+    The arithmetic: the worker's PULL samples measure submit →
+    completion per partition, so their SUM is comparable with the
+    fleet's per-stage wall DELTAS over the same step. ``wire`` is
+    everything the server didn't account for as queue-wait or fold —
+    payload recv, the aggregate reply send (both inflate under a
+    throttled/slow transport) and true time on the network:
+    ``wire = recv + reply + max(0, pull_total - all server stages)``.
+    Whichever of queue-wait / fold / wire dominates names the
+    sub-verdict — the exact sensor an autoscaler needs ("queue-wait-
+    bound: add a server" vs "wire-bound: the network is the wall")."""
+    if r.server_queue_ms is None or r.pull_total_ms is None:
+        return None
+    recv = r.server_recv_ms or 0.0
+    reply = r.server_reply_ms or 0.0
+    queue = r.server_queue_ms or 0.0
+    fold = r.server_fold_ms or 0.0
+    residual = max(0.0, r.pull_total_ms - (recv + queue + fold + reply))
+    wire = recv + reply + residual
+    sub = max((("queue-wait", queue), ("fold", fold), ("wire", wire)),
+              key=lambda kv: kv[1])
+    return f"{sub[0]}-bound", queue, fold, wire
+
+
 def classify_step(r: StepReport) -> str:
     """Straggler/stall diagnosis: name the stage the step is bound on.
 
@@ -328,7 +369,13 @@ def classify_step(r: StepReport) -> str:
     time (``pull_wait_ms`` — many medium pulls serializing reads as a
     stall even when no single partition's p95 does). Queue pressure
     annotates the verdict. Returns e.g. ``"PULL-bound: pull p95 41.0ms
-    vs compute 12.0ms; queue depth peaked 37"``."""
+    vs compute 12.0ms; queue depth peaked 37"``.
+
+    With the fleet probe's server attribution present, a PULL-bound
+    verdict additionally names the server stage that ate the time:
+    ``"PULL-bound/queue-wait-bound: ... (server queue-wait 30.1ms,
+    fold 4.2ms, wire 6.7ms)"`` — the split ROADMAP item 3's
+    autoscaler consumes."""
     pull_sig = max(r.pull_p95_ms or 0.0, r.pull_wait_ms or 0.0)
     candidates = {
         "COMPUTE": r.compute_ms,
@@ -344,12 +391,21 @@ def classify_step(r: StepReport) -> str:
         label = "pull wait"  # the aggregate drain block decided it
     else:
         label = f"{bound.lower()} p95"
-    parts = [f"{bound}-bound: {label} {candidates[bound]:.1f}ms"]
+    attribution = server_attribution(r) if bound == "PULL" else None
+    if attribution is not None:
+        parts = [f"{bound}-bound/{attribution[0]}: "
+                 f"{label} {candidates[bound]:.1f}ms"]
+    else:
+        parts = [f"{bound}-bound: {label} {candidates[bound]:.1f}ms"]
     if bound != "COMPUTE":
         parts.append(f"vs compute {r.compute_ms:.1f}ms")
     else:
         comm = max(candidates["PUSH"], candidates["PULL"])
         parts.append(f"vs comm p95 {comm:.1f}ms")
+    if attribution is not None:
+        _, queue, fold, wire = attribution
+        parts.append(f"(server queue-wait {queue:.1f}ms, "
+                     f"fold {fold:.1f}ms, wire {wire:.1f}ms)")
     msg = " ".join(parts)
     extras = []
     if r.queue_depth_peak:
@@ -370,11 +426,14 @@ class _StepBuilder:
     not per-byte — contention is negligible)."""
 
     __slots__ = ("step", "t0", "_mu", "stage_samples", "queue_peak",
-                 "credit_stalls", "marks", "pull_wait_s")
+                 "credit_stalls", "marks", "pull_wait_s", "fleet_base")
 
     def __init__(self, step: int):
         self.step = step
         self.t0 = time.perf_counter()
+        # fleet per-stage counter snapshot at step start (train-thread
+        # only, set by StepProfiler.begin_step); None = no probe
+        self.fleet_base: Optional[Dict[str, int]] = None
         self._mu = threading.Lock()
         # stage samples / queue peak / stalls arrive from scheduler pool
         # threads; marks and pull_wait_s are train-thread-only by
@@ -415,15 +474,34 @@ class StepProfiler:
     belong to no step's critical path."""
 
     def __init__(self, window: int = 64, enabled: bool = True,
-                 stall_diag: bool = False, tracer=None):
+                 stall_diag: bool = False, tracer=None,
+                 fleet_probe=None):
         import collections
         self.enabled = enabled
         self.stall_diag = stall_diag
         self._tracer = tracer
+        # () -> {"recv_ns", "queue_ns", "fold_ns", "reply_ns"} summed
+        # over the reachable fleet (in-process mirror or STATS_PULL),
+        # or None. Snapshotted at both step boundaries; the deltas are
+        # the StepReport's server-attribution fields. Wired by
+        # core/state.py; None = no attribution (fields stay None).
+        self._fleet_probe = fleet_probe
+        # end_step's probe doubles as the NEXT step's baseline (steps
+        # are contiguous), so a remote fleet pays ONE probe sweep per
+        # step, not two; train-thread only, like the builder marks
+        self._probe_cache: Optional[dict] = None
         self._mu = threading.Lock()
         self._reports = collections.deque(maxlen=max(1, window))  # guarded-by: _mu
         self._current: Optional[_StepBuilder] = None  # guarded-by: _mu
         self._step_no = 0                             # guarded-by: _mu
+
+    def _probe_fleet(self) -> Optional[dict]:
+        if self._fleet_probe is None:
+            return None
+        try:
+            return self._fleet_probe()
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            return None
 
     def begin_step(self) -> Optional[_StepBuilder]:
         if not self.enabled:
@@ -431,7 +509,14 @@ class StepProfiler:
         with self._mu:
             self._step_no += 1
             self._current = _StepBuilder(self._step_no)
-            return self._current
+            cur = self._current
+        # outside _mu: the probe may do a small wire RPC; the previous
+        # end_step's reading is this step's baseline when available
+        cur.fleet_base = self._probe_cache
+        self._probe_cache = None
+        if cur.fleet_base is None:
+            cur.fleet_base = self._probe_fleet()
+        return cur
 
     def current(self) -> Optional[_StepBuilder]:
         # racy read by design: scheduler threads sample whatever step is
@@ -448,6 +533,19 @@ class StepProfiler:
         with b._mu:
             samples = {k: list(v) for k, v in b.stage_samples.items()}
             queue_peak, stalls = b.queue_peak, b.credit_stalls
+        # server attribution: delta the fleet's per-stage counters over
+        # the step (ns -> ms); pull_total is the comparable worker-side
+        # sum (each PULL sample is one partition's submit→completion)
+        srv = {}
+        if b.fleet_base is not None:
+            end = self._probe_fleet()
+            self._probe_cache = end  # next begin_step's baseline
+            if end is not None:
+                srv = {k: max(0, end.get(k, 0) - b.fleet_base.get(k, 0))
+                       / 1e6
+                       for k in ("recv_ns", "queue_ns", "fold_ns",
+                                 "reply_ns")}
+        pull_total = sum(samples.get("PULL", [])) if srv else None
         r = StepReport(
             step=b.step,
             wall_ms=wall,
@@ -468,6 +566,11 @@ class StepProfiler:
             h2d_update_p95_ms=_p95(samples.get("H2D_UPDATE", [])),
             pull_wait_ms=b.pull_wait_s * 1e3,
             allgather_ms=sum(samples.get("ALLGATHER", [])),
+            pull_total_ms=pull_total,
+            server_recv_ms=srv.get("recv_ns"),
+            server_queue_ms=srv.get("queue_ns"),
+            server_fold_ms=srv.get("fold_ns"),
+            server_reply_ms=srv.get("reply_ns"),
         )
         with self._mu:
             self._reports.append(r)
@@ -553,6 +656,22 @@ def prometheus_text(registry: MetricsRegistry) -> str:
         lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{pn}_sum {h['sum']}")
         lines.append(f"{pn}_count {h['count']}")
+    # fleet section: per-server sub-dicts export as ONE labeled series
+    # per metric (`byteps_fleet_fold_ms{server="0"} ...`) from the same
+    # snapshot path as bps.get_fleet_metrics() — scraping the endpoint
+    # and calling the API can never disagree about the fleet
+    fleet = snap.get("fleet")
+    if isinstance(fleet, dict):
+        for metric in sorted({k for s in fleet.get("server", {}).values()
+                              if isinstance(s, dict) for k in s}):
+            pn = _prom_name(f"fleet_{metric}")
+            lines.append(f"# TYPE {pn} gauge")
+            for idx, per in sorted(fleet.get("server", {}).items()):
+                v = per.get(metric) if isinstance(per, dict) else None
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    lines.append(f'{pn}{{server="{idx}"}} {v}')
     for section, values in snap.items():
         if section in ("enabled", "counters", "gauges", "histograms",
                        "steps"):

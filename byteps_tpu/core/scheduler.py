@@ -743,21 +743,26 @@ class PipelineScheduler:
         the server folds it at most once), re-routing via the registry
         when the assigned server is dead; after the retry budget, fail
         the round with a clear bounded-time error."""
+        from . import flight
         if (self._stopping or task.attempt >= self._retry_max
                 or not self._retryable(err)):
             if task.attempt > 0 and self._retryable(err):
                 budget_ms = sum(
                     min(self._backoff_ms * (2 ** a), self._backoff_cap_ms)
                     for a in range(task.attempt))
-                err = RuntimeError(
+                err = self._fatal_wire_error(task, RuntimeError(
                     f"push_pull {task.ctx.name!r} key={task.key} failed "
                     f"after {task.attempt + 1} attempts over "
                     f"~{budget_ms:.0f}ms of backoff "
                     f"(BYTEPS_WIRE_RETRY={self._retry_max}, "
-                    f"BYTEPS_WIRE_BACKOFF_MS={self._backoff_ms:g}): {err}")
+                    f"BYTEPS_WIRE_BACKOFF_MS={self._backoff_ms:g}): "
+                    f"{err}"))
             self._finish(task, err)
             return
         task.attempt += 1
+        flight.record("wire_retry", key=task.key,
+                      detail=f"{task.ctx.name} attempt={task.attempt} "
+                             f"server={task.partition.server} err={err}")
         if self._m_retries is not None:
             self._m_retries.inc()
         # the reply scratch may be half-written garbage: abandon it so
@@ -780,7 +785,10 @@ class PipelineScheduler:
             try:
                 self._prepare_retry(task)
             except Exception as e:  # noqa: BLE001 - forwarded to waiter
-                self._finish(task, e)
+                # the dead-fleet fail-fast lands HERE (migrate_server
+                # raising "fleet is gone"): it must carry the flight-
+                # dump pointer like the retry-budget exhaustion does
+                self._finish(task, self._fatal_wire_error(task, e))
                 return
             entry = self._do_wire if self._fused else self._do_push
             self._submit_stage(self._push_pool, entry, task)
@@ -794,6 +802,27 @@ class PipelineScheduler:
                 return
             self._pending_retries[id(task)] = (timer, task)
         timer.start()
+
+    def _fatal_wire_error(self, task: PartitionTask,
+                          err: Exception) -> Exception:
+        """A round is about to fail for good (retry budget exhausted,
+        or the whole fleet is gone): record it, dump the flight record
+        (best-effort — a dead fleet still dumps the worker's half of
+        the causal timeline), and return the error with the dump path
+        appended so the operator starts from the timeline instead of
+        log archaeology (docs/fault-tolerance.md)."""
+        from . import flight
+        flight.record("round_failed", key=task.key,
+                      detail=f"{task.ctx.name} "
+                             f"attempts={task.attempt + 1} err={err}")
+        try:
+            dump_path = flight.dump(reason="wire-fail-fast")
+        except Exception:  # noqa: BLE001 - never mask the real error
+            dump_path = None
+        if not dump_path:
+            return err
+        return RuntimeError(
+            f"{err} — flight record dumped to {dump_path}")
 
     def _prepare_retry(self, task: PartitionTask) -> None:
         """Pre-flight for a retry: when the native client reports the
@@ -848,6 +877,7 @@ class PipelineScheduler:
         # partition of the same dead server blocks here until the
         # routing table is fully re-targeted, so its post-call
         # partition.server read never observes a half-applied migration
+        from . import flight
         with self._failover_mu:
             if srv in self._migrated_servers or self._registry is None:
                 return
@@ -860,6 +890,12 @@ class PipelineScheduler:
                 # the adoptive servers have no stores for the migrated
                 # keys: the next ensure_init must re-init-push them there
                 invalidate(migrated)
+            flight.record("server_failover", key=srv,
+                          detail=f"server={srv} migrated_keys="
+                                 f"{len(migrated)}")
+            for k in migrated:
+                flight.record("key_migration", key=k,
+                              detail=f"from_server={srv}")
             if self._m_failovers is not None:
                 self._m_failovers.inc()
                 self._m_migrations.inc(len(migrated))
@@ -929,10 +965,15 @@ class PipelineScheduler:
         # reply must fail, not leave the output tail unwritten; wire
         # (device-compressed) and codec replies are variable-length
         exact = task.stack is None and task.pull_len is None
+        span_token = None
         if self._tracer:
             # end() runs on the reactor thread: skip the per-thread
-            # profiler-annotation mirror, keep the Chrome-trace span
-            self._tracer.begin(name, span, cross_thread=True)
+            # profiler-annotation mirror, keep the Chrome-trace span.
+            # The token pins the later rid annotation to THIS span
+            # incarnation (a fast reply can close it, and the next
+            # round can even reopen the key, before we annotate).
+            span_token = self._tracer.begin(name, span,
+                                            cross_thread=True)
         t0 = time.perf_counter()
 
         def _complete_dense(t: PartitionTask) -> None:
@@ -974,7 +1015,7 @@ class PipelineScheduler:
 
         try:
             try:
-                self._client.zpushpull_async(
+                rid = self._client.zpushpull_async(
                     task.partition.server, task.key, buf, reply, task.cmd,
                     on_done, epoch=task.epoch, codec=task.codec)
             except TypeError:
@@ -983,11 +1024,11 @@ class PipelineScheduler:
                 # time — an untagged push just skips server validation,
                 # an unstamped one falls back to positional counting
                 try:
-                    self._client.zpushpull_async(
+                    rid = self._client.zpushpull_async(
                         task.partition.server, task.key, buf, reply,
                         task.cmd, on_done, epoch=task.epoch)
                 except TypeError:
-                    self._client.zpushpull_async(
+                    rid = self._client.zpushpull_async(
                         task.partition.server, task.key, buf, reply,
                         task.cmd, on_done)
         except Exception as e:  # noqa: BLE001
@@ -995,6 +1036,15 @@ class PipelineScheduler:
                 self._tracer.end(name, span)
             self._fail_or_retry(task, e)
             return
+        if self._tracer and span_token and isinstance(rid, int) and rid:
+            # the native send reported this request's wire rid: stamp
+            # it onto this round's span (open, or just closed by a fast
+            # reply — the token guarantees never a LATER round's span)
+            # — the id server-side trace spans carry, which the fused
+            # timeline flow-links on (docs/timeline.md). Fake/stale
+            # clients report none.
+            self._tracer.annotate(name, span, token=span_token, rid=rid,
+                                  server=task.partition.server)
         # send wall only — the request is on the wire and this thread is
         # free; the aggregation wait shows up in the PULL sample above
         self._stage_done(task, "PUSH", t0)

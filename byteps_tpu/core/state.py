@@ -161,6 +161,7 @@ class GlobalState:
         self.scheduler = None        # PipelineScheduler over ps_client
         self.handles = None          # HandleManager for the async API
         self.codec_plane = None      # adaptive codec plane (codec_plane.py)
+        self.flight = None           # crash flight recorder (flight.py)
         # persistent host staging arena (core/arena.py); replaced with an
         # enabled instance at init() when BYTEPS_STAGING_ARENA is on —
         # a disabled arena hands out fresh buffers with identical
@@ -211,6 +212,29 @@ class GlobalState:
             # is remote, so the documented schema resolves everywhere
             from ..server import stage_section
             self.metrics.section("server", stage_section)
+            # fleet section: per-server registry snapshots — over the
+            # STATS_PULL control op when a fleet-capable client is
+            # connected (subprocess/remote servers stop being black
+            # boxes), in-process mirror otherwise (docs/observability
+            # .md "fleet"); bps.get_fleet_metrics() and the Prometheus
+            # endpoint both read this one section
+            self.metrics.section("fleet", self._fleet_section)
+            # fresh breakers per init (per-step probe + snapshot sweep)
+            self._fleet_probe_tripped = False
+            self._fleet_section_tripped = False
+            # crash flight recorder (core/flight.py): bounded event
+            # ring armed per lifecycle; events flow in from the fault
+            # paths module-level (no plumbing), the dump merges every
+            # server's native ring via the collector below
+            from . import flight as flight_mod
+            self.flight = flight_mod.configure(
+                capacity=self.config.flight_ring,
+                enabled=self.config.flight_recorder,
+                dump_dir=self.config.flight_dir)
+            self.metrics.section("flight", self.flight.snapshot)
+            if self.config.flight_recorder:
+                flight_mod.install_signal_handler()
+            flight_mod.set_server_collector(self._collect_server_flight)
             # codec-plane instruments exist on every deployment (the
             # docs/observability.md schema guard resolves them), whether
             # or not the adaptive plane itself is enabled below
@@ -267,8 +291,15 @@ class GlobalState:
                 window=self.config.step_report_window,
                 enabled=self.config.metrics_on,
                 stall_diag=self.config.stall_diag,
-                tracer=self.tracer)
+                tracer=self.tracer,
+                fleet_probe=self._fleet_stage_probe)
             self.metrics.section("steps", self.profiler.snapshot)
+            if self.tracer is not None:
+                # fused-timeline hook: Tracer.dump() drains every
+                # server's wire-sampled span ring + clock offset
+                # through this (docs/timeline.md)
+                self.tracer.set_server_collector(
+                    self._collect_server_traces)
             if self.config.jax_profiler_dir and not self._jax_profiling:
                 # device (XLA) trace for TensorBoard/Perfetto alongside
                 # the Chrome comm timeline (SURVEY §5.1 TPU note); host
@@ -370,6 +401,150 @@ class GlobalState:
             self.arena.reset()
             self.initialized = False
             self.suspended = False
+
+    # ------------------------------------------------------------------ #
+    # fleet observability plane (docs/observability.md "fleet",
+    # docs/timeline.md fused timeline)
+    # ------------------------------------------------------------------ #
+
+    def _fleet_client(self):
+        """The PS client iff it speaks the observability control ops
+        (None otherwise — the fleet surfaces then cover in-process
+        servers only)."""
+        client = self.ps_client
+        if client is not None and getattr(client, "supports_fleet",
+                                          False):
+            return client
+        return None
+
+    def _fleet_section(self) -> dict:
+        """The ``fleet`` snapshot section: one derived per-stage stats
+        dict per reachable server, keyed by server index. Wire
+        (STATS_PULL) when a fleet-capable client is connected — the
+        SAME surface for in-process, subprocess and remote servers —
+        with the in-process mirror as the fallback so a server-role
+        process still self-reports.
+
+        Snapshot callers (``get_metrics()``, every Prometheus scrape)
+        must stay cheap even against a wedged fleet: each pull is
+        bounded at 1s, and the first sweep that exceeds 2.5s trips a
+        lifecycle breaker that drops the wire path (local mirror /
+        empty thereafter, one log line) — same discipline as the
+        per-step probe's breaker."""
+        from ..server import derive_stage_section, per_server_stats
+        servers: dict = {}
+        source = "none"
+        client = None if getattr(self, "_fleet_section_tripped", False) \
+            else self._fleet_client()
+        if client is not None:
+            t0 = time.monotonic()
+            for s in range(self.config.num_servers):
+                try:
+                    raw = client.server_stats(s, timeout_s=1)
+                except Exception:  # noqa: BLE001 - dead server: skip
+                    raw = None
+                if raw is not None:
+                    servers[str(s)] = derive_stage_section(raw)
+            elapsed = time.monotonic() - t0
+            if elapsed > 2.5:
+                self._fleet_section_tripped = True
+                log.warning(
+                    "fleet snapshot sweep took %.1fs — dropping the "
+                    "wire path for this lifecycle (in-process mirror "
+                    "only)", elapsed)
+            if servers:
+                source = "wire"
+        if not servers:
+            for i, raw in enumerate(per_server_stats()):
+                servers[str(i)] = derive_stage_section(raw)
+            if servers:
+                source = "local"
+        return {"workers": max(1, self.config.num_workers),
+                "servers": len(servers), "source": source,
+                "server": servers}
+
+    def _fleet_stage_probe(self):
+        """Per-step server-attribution probe (StepProfiler): cumulative
+        per-stage ns summed over the fleet, or None when no server is
+        reachable. In-process mirror first — a ctypes read, cheap
+        enough for every step boundary (the metrics_ab ≤2% bar) — the
+        wire op only when the fleet is genuinely out-of-process.
+
+        The wire path runs ON THE TRAIN THREAD (step boundaries), so
+        it is belt-and-braces bounded: 1s per-request timeout, and a
+        one-way breaker — the first sweep that takes >250ms (a wedged-
+        but-connected server, a congested control path) disables wire
+        probing for the rest of this lifecycle with one log line.
+        Attribution then reads None; the measurement plane must never
+        become the cost it measures."""
+        from ..server import stage_stats
+        raw = stage_stats()
+        keys = ("recv_ns", "queue_ns", "fold_ns", "reply_ns")
+        if raw.get("live"):
+            return {k: raw[k] for k in keys}
+        if getattr(self, "_fleet_probe_tripped", False):
+            return None
+        client = self._fleet_client()
+        if client is None:
+            return None
+        t0 = time.monotonic()
+        tot = dict.fromkeys(keys, 0)
+        seen = False
+        for s in range(self.config.num_servers):
+            try:
+                st = client.server_stats(s, timeout_s=1)
+            except Exception:  # noqa: BLE001 - dead server: skip
+                st = None
+            if st is None:
+                continue
+            seen = True
+            for k in keys:
+                tot[k] += st[k]
+        elapsed = time.monotonic() - t0
+        if elapsed > 0.25:
+            self._fleet_probe_tripped = True
+            log.warning(
+                "fleet stage probe took %.0fms — disabling per-step "
+                "server attribution for this lifecycle (fleet metrics "
+                "snapshots are unaffected)", elapsed * 1e3)
+        return tot if seen else None
+
+    def _sweep_fleet(self, drain_name: str, payload_key: str,
+                     probes: int) -> list:
+        """THE per-server drain+probe sweep behind both dump hooks:
+        drain each server's ring (``drain_name``: ``drain_trace`` /
+        ``drain_flight``), clock-probe it, and assemble the
+        ``{server, offset_ns, err_ns, <payload_key>}`` entries the
+        fusers consume. Best-effort per server — a dead one
+        contributes nothing. One definition so a breaker / probe
+        tweak / elastic-index fix lands in both dumps at once."""
+        client = self._fleet_client()
+        if client is None:
+            return []
+        out = []
+        for s in range(self.config.num_servers):
+            try:
+                probe = client.clock_probe(s, probes=probes,
+                                           timeout_s=2)
+                recs = getattr(client, drain_name)(s, timeout_s=2)
+            except Exception:  # noqa: BLE001 - dead server: skip
+                continue
+            if not recs:
+                continue
+            off, err = probe if probe is not None else (0, 0)
+            out.append({"server": s, "offset_ns": off, "err_ns": err,
+                        payload_key: recs})
+        return out
+
+    def _collect_server_traces(self) -> list:
+        """Tracer.dump() hook: every server's wire-sampled span records
+        plus its estimated clock offset (utils/tracing.py)."""
+        return self._sweep_fleet("drain_trace", "records", probes=8)
+
+    def _collect_server_flight(self) -> list:
+        """flight.dump() hook: every server's flight-ring snapshot plus
+        its clock offset, for the merged causal timeline."""
+        return self._sweep_fleet("drain_flight", "events", probes=4)
 
     def suspend(self) -> None:
         """Elastic suspend (operations.cc:114-119): tear down comm state but

@@ -281,6 +281,15 @@ enum Op : uint8_t {
   // for the aggregation wait) with one request and a completion-queue
   // reply. A push-stage error replies ACK with flags=1 instead.
   PUSHPULL = 11,
+  // Observability control plane (docs/timeline.md, docs/observability
+  // .md "fleet"): header-only requests handled INLINE by the conn loop
+  // — they must never queue behind data-plane folds (a stats poll that
+  // waits out a 256MB fold would measure itself). Values are wire
+  // contract, mirrored by server/client.py WIRE_CTRL_OPS.
+  STATS_PULL = 12,    // reply: u64 slot vector (kStatSlotNames order)
+  TRACE_DRAIN = 13,   // reply: packed TraceRec[] (destructive read)
+  FLIGHT_DRAIN = 14,  // reply: packed FlightRec[] (snapshot, kept)
+  CLOCK_PROBE = 15,   // reply: {recv_ns, send_ns} steady-clock echo
 };
 
 enum ReqType : uint32_t {
@@ -2035,11 +2044,177 @@ static inline uint64_t now_ns() {
       .count();
 }
 
-struct ParkedPull {
-  std::shared_ptr<Conn> conn;
+// ------------------------------------------------------------------ //
+// observability plane: wire-sampled trace ring + crash flight ring
+// ------------------------------------------------------------------ //
+
+// One sampled request's server-side life, the PR-11 stage counters
+// DE-aggregated (BYTEPS_TRACE_SAMPLE = record every Nth data request;
+// 0 = off). kind 0 is the request span — t0..t3 are recv (header
+// seen), enqueue, dequeue (fold start) and handler-done on THIS
+// server's steady clock; kind 1 is a reply-send event (t0 = send
+// instant, the rest 0) emitted when this rid's aggregate finally
+// leaves, which for a parked fused reply is a different engine
+// invocation entirely — the worker-side fuser joins the two by
+// (rid, sender). Layout is wire contract: drained over TRACE_DRAIN
+// and parsed by server/__init__.py TRACE_REC_FMT (byteps-lint
+// slot-layout diffs kTraceRecFields against the mirror).
+#pragma pack(push, 1)
+struct TraceRec {
+  uint64_t key;
+  uint64_t t0;
+  uint64_t t1;
+  uint64_t t2;
+  uint64_t t3;
   uint32_t rid;
   uint16_t sender;
+  uint8_t op;
+  uint8_t kind;  // 0 = request span, 1 = reply send
+};
+#pragma pack(pop)
+static_assert(sizeof(TraceRec) == 48, "trace record layout");
+// append-only field manifest (bps-lint wire-layout: diffed against the
+// Python mirror _TRACE_REC_FIELDS both directions)
+static const char* const kTraceRecFields[] = {
+    "key", "t0", "t1", "t2", "t3", "rid", "sender", "op", "kind"};
+
+// One structured fault-plane event (always on, bounded, allocation-
+// free): replay-dedup hits, codec-tag rejects, chaos injections,
+// worker departures, pull aborts — the causal trail a crash dump needs
+// where today there is only interleaved stderr. Snapshot-drained over
+// FLIGHT_DRAIN (non-destructive: a metrics poll must not steal the
+// events a later crash dump wants). Layout is wire contract, mirrored
+// by server/__init__.py FLIGHT_REC_FMT.
+#pragma pack(push, 1)
+struct FlightRec {
+  uint64_t ts_ns;
+  uint64_t key;
+  uint64_t detail;  // kind-specific: round, victim count, rate*1e6...
+  uint32_t rid;
+  uint16_t sender;
+  uint8_t kind;
+  uint8_t pad;
+};
+#pragma pack(pop)
+static_assert(sizeof(FlightRec) == 32, "flight record layout");
+static const char* const kFlightRecFields[] = {
+    "ts_ns", "key", "detail", "rid", "sender", "kind", "pad"};
+
+// bps_server_stats / STATS_PULL slot layout — the append-only contract
+// with server/__init__.py _STAT_SLOTS, enforced until PR 10 only by a
+// comment and now machine-checked: byteps-lint's slot-layout check
+// diffs this manifest against the Python mirror both directions, and
+// bps_server_stat_name() exposes it at runtime so a test can assert
+// the loaded .so agrees with the mirror it was built from.
+static const char* const kStatSlotNames[] = {
+    "recv_ns", "recv_count", "queue_ns", "queue_count", "fold_ns",
+    "fold_count", "fold_bytes", "reply_ns", "reply_count",
+    "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
+    "trace_records", "trace_dropped", "flight_records",
+    "flight_dropped"};
+static constexpr size_t kNumStatSlots =
+    sizeof(kStatSlotNames) / sizeof(kStatSlotNames[0]);
+
+// Event kinds (wire contract; server/__init__.py FLIGHT_KIND_NAMES).
+enum FlightKind : uint8_t {
+  kFlightReplayDedup = 1,
+  kFlightCodecReject = 2,
+  kFlightChaosDrop = 3,
+  kFlightWorkerDeparted = 4,
+  kFlightPullAbort = 5,
+  kFlightUnknownOp = 6,
+};
+
+// Control-pull reply size limits — wire contract: the CLIENT sizes its
+// reply buffers from the mirror (server/client.py WIRE_CTRL_LIMITS,
+// machine-checked by the slot-layout lint), and an oversized reply is
+// drained-not-delivered by the recv loop (silently empty drains). The
+// trace drain pages in kCtrlDrainBatch batches (destructive: the
+// client loops until short); the flight snapshot is one shot, so its
+// cap must cover a whole default ring.
+enum CtrlLimits : uint32_t {
+  kCtrlDrainBatch = 1024,
+  kCtrlFlightDrainMax = 4096,
+};
+
+// Fixed-capacity drop-oldest ring, preallocated at construction — the
+// record path after warmup is one small mutex + a slot store (the
+// trace path is sampled and the flight path is rare, so a leaf mutex
+// beats a lock-free scheme nobody can audit). Readers either CONSUME
+// (trace: each span fuses once) or SNAPSHOT (flight: the crash dump
+// must still see what a poll already read).
+template <typename Rec>
+class EventRing {
+ public:
+  explicit EventRing(size_t cap) : cap_(cap < 16 ? 16 : cap) {
+    buf_.resize(cap_);
+  }
+
+  void push(const Rec& r) {
+    std::lock_guard<Mu> lk(mu_);
+    buf_[w_ % cap_] = r;
+    ++w_;
+    ++total_;
+    if (w_ - r_ > cap_) {
+      dropped_ += (w_ - r_) - cap_;
+      r_ = w_ - cap_;
+    }
+  }
+
+  // Copy up to max_recs records into out; consume=true advances the
+  // read cursor (trace: the client loops batches until the ring is
+  // empty), false leaves the ring intact (flight) and returns the
+  // NEWEST window — a crash dump that cannot take everything must get
+  // the events nearest the crash, not the oldest survivors.
+  size_t drain(Rec* out, size_t max_recs, bool consume) {
+    std::lock_guard<Mu> lk(mu_);
+    size_t avail = w_ - r_;
+    size_t n = avail < max_recs ? avail : max_recs;
+    uint64_t start = consume ? r_ : (w_ - n);
+    for (size_t i = 0; i < n; ++i) out[i] = buf_[(start + i) % cap_];
+    if (consume) r_ += n;
+    return n;
+  }
+
+  uint64_t total() const {
+    std::lock_guard<Mu> lk(mu_);
+    return total_;
+  }
+  uint64_t dropped() const {
+    std::lock_guard<Mu> lk(mu_);
+    return dropped_;
+  }
+
+ private:
+  size_t cap_;
+  mutable Mu mu_;
+  std::vector<Rec> buf_;  // guarded-by: mu_ (preallocated, never grows)
+  uint64_t w_ = 0;        // guarded-by: mu_
+  uint64_t r_ = 0;        // guarded-by: mu_
+  uint64_t total_ = 0;    // guarded-by: mu_
+  uint64_t dropped_ = 0;  // guarded-by: mu_
+};
+
+struct ParkedPull {
+  std::shared_ptr<Conn> conn;
+  uint32_t rid = 0;
+  uint16_t sender = 0;
   bool compressed = false;
+  // trace carry: the request was wire-sampled, so the (possibly much
+  // later) reply send emits its kind-1 TraceRec — rid-joined with the
+  // request span by the worker-side fuser
+  uint8_t traced = 0;
+  // key carried for the flight/trace planes (a chaos-dropped reply
+  // names the partition it starved, rid+key-matchable worker-side)
+  uint64_t key = 0;
+  ParkedPull() = default;
+  // explicit ctor (not aggregate init): trailing fields grew twice now
+  // and -Wmissing-field-initializers + 10 brace sites is exactly the
+  // drift the ReplyHeader() factory exists to avoid
+  ParkedPull(std::shared_ptr<Conn> c, uint32_t r, uint16_t s,
+             bool comp = false, uint8_t tr = 0, uint64_t k = 0)
+      : conn(std::move(c)), rid(r), sender(s), compressed(comp),
+        traced(tr), key(k) {}
 };
 
 struct KeyStore {
@@ -2130,6 +2305,12 @@ struct EngineMsg {
   // engine adopts it under ks.mu before dispatch.
   bool direct = false;
   uint64_t enq_ns = 0;  // queue-wait stage timestamp
+  // wire-sampled trace span (BYTEPS_TRACE_SAMPLE): recv_ns stamps the
+  // header's arrival in the conn loop, deq_ns the engine dequeue; the
+  // handler-done stamp closes the kind-0 TraceRec in EngineLoop
+  uint8_t traced = 0;
+  uint64_t recv_ns = 0;
+  uint64_t deq_ns = 0;
   std::shared_ptr<Conn> conn;
 
   const uint8_t* data() const { return oob ? oob : payload.data(); }
@@ -2194,7 +2375,25 @@ class Server {
         // per-Server fold tier (BYTEPS_SIMD; like Throttle/Chaos, read
         // per instance so SIMD and scalar servers coexist in one test
         // process)
-        kernels_(resolve_fold_kernels(::getenv("BYTEPS_SIMD"))) {
+        kernels_(resolve_fold_kernels(::getenv("BYTEPS_SIMD"))),
+        // observability plane, read per instance like the chaos knobs:
+        // BYTEPS_TRACE_SAMPLE = record every Nth data request into the
+        // trace ring (0 = off); ring capacities bound the footprint
+        trace_sample_([] {
+          const char* e = ::getenv("BYTEPS_TRACE_SAMPLE");
+          long v = e && *e ? std::atol(e) : 0;
+          return v < 0 ? 0L : v;
+        }()),
+        trace_ring_([] {
+          const char* e = ::getenv("BYTEPS_TRACE_RING");
+          long v = e && *e ? std::atol(e) : 4096;
+          return (size_t)(v < 16 ? 16 : v);
+        }()),
+        flight_ring_([] {
+          const char* e = ::getenv("BYTEPS_FLIGHT_RING");
+          long v = e && *e ? std::atol(e) : 2048;
+          return (size_t)(v < 16 ? 16 : v);
+        }()) {
     n_engines_ = num_engine_threads < 1 ? 1 : num_engine_threads;
     engine_bytes_.reset(new std::atomic<uint64_t>[n_engines_]);
     for (int i = 0; i < n_engines_; ++i) {
@@ -2210,6 +2409,26 @@ class Server {
   const StageStats& stats() const { return stats_; }
   int simd_tier() const { return kernels_.tier; }
   int num_engines() const { return n_engines_; }
+
+  // THE one slot-vector definition, shared by bps_server_stats (in-
+  // process mirror) and the STATS_PULL wire reply so the two surfaces
+  // cannot drift. Order is the append-only kStatSlotNames contract.
+  int stat_slots(uint64_t* out, int max_n) const {
+    const StageStats& st = stats_;
+    uint64_t v[kNumStatSlots] = {
+        st.recv_ns.load(),      st.recv_count.load(),
+        st.queue_ns.load(),     st.queue_count.load(),
+        st.fold_ns.load(),      st.fold_count.load(),
+        st.fold_bytes.load(),   st.reply_ns.load(),
+        st.reply_count.load(),  st.direct_recvs.load(),
+        st.oob_msgs.load(),     (uint64_t)simd_tier(),
+        (uint64_t)n_engines_,   trace_ring_.total(),
+        trace_ring_.dropped(),  flight_ring_.total(),
+        flight_ring_.dropped()};
+    int n = max_n < (int)kNumStatSlots ? max_n : (int)kNumStatSlots;
+    for (int i = 0; i < n; ++i) out[i] = v[i];
+    return n;
+  }
   uint64_t engine_fold_bytes(int i) const {
     return (i >= 0 && i < n_engines_)
                ? engine_bytes_[i].load(std::memory_order_relaxed)
@@ -2370,6 +2589,17 @@ class Server {
       decode_cmd(h.cmd, &req, &dtype);
       m.req = req;
       m.dtype = dtype;
+      // wire-sampled trace span (BYTEPS_TRACE_SAMPLE = every Nth data
+      // request): stamp arrival BEFORE the payload recv, so the span's
+      // recv stage covers the payload transfer the aggregate recv_ns
+      // counter also measures
+      if (trace_sample_ > 0 &&
+          (h.op == PUSH || h.op == PULL || h.op == PUSHPULL) &&
+          trace_seq_.fetch_add(1, std::memory_order_relaxed) %
+                  (uint64_t)trace_sample_ == 0) {
+        m.traced = 1;
+        m.recv_ns = now_ns();
+      }
       if (oob.ptr) {
         // descriptor tier: the payload already sits in the shared
         // arena — no recv, no copy; the engine folds from it in place
@@ -2423,6 +2653,15 @@ class Server {
         std::fprintf(stderr,
                      "[bps-server] ipc upgrade abandoned (no confirm)\n");
       }
+      if (h.op == CLOCK_PROBE) {
+        HandleClockProbe(conn, h.rid);
+        continue;
+      }
+      if (h.op == STATS_PULL || h.op == TRACE_DRAIN ||
+          h.op == FLIGHT_DRAIN) {
+        HandleControlPull(conn, h.rid, h.op);
+        continue;
+      }
       if (h.op == BARRIER) {
         HandleBarrier(std::move(m));
         continue;
@@ -2472,6 +2711,7 @@ class Server {
   }
 
   void OnWorkerDeparted(int sender) {
+    Flight(kFlightWorkerDeparted, 0, 0, (uint16_t)sender);
     std::fprintf(stderr,
                  "[bps-server] worker %d departed (all connections "
                  "closed); failing parked requests\n", sender);
@@ -2570,6 +2810,74 @@ class Server {
     }
   }
 
+  // ---- observability control ops (conn-loop inline: these must not
+  // queue behind data-plane folds — a stats poll that waits out a
+  // 256MB fold would be measuring itself) ---------------------------- //
+
+  void HandleClockProbe(const std::shared_ptr<Conn>& conn, uint32_t rid) {
+    // NTP-style echo on THIS server's steady clock: t1 = request seen
+    // (header-only op, so handler entry IS arrival to within the op
+    // dispatch), t2 = reply about to hit the transport. The client
+    // brackets with its own t0/t3; offset = ((t1-t0)+(t2-t3))/2 with
+    // error bounded by rtt/2 (utils/tracing.py estimate_clock_offset).
+    uint64_t echo[2];
+    echo[0] = now_ns();
+    MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                              (uint32_t)sizeof(echo));
+    echo[1] = now_ns();
+    conn->send_msg(r, echo);
+  }
+
+  void HandleControlPull(const std::shared_ptr<Conn>& conn, uint32_t rid,
+                         uint8_t op) {
+    if (op == STATS_PULL) {
+      // full per-stage registry snapshot over the wire: the remote
+      // half of bps.get_fleet_metrics() (same slot vector as the
+      // in-process bps_server_stats mirror, by construction)
+      uint64_t v[kNumStatSlots];
+      int n = stat_slots(v, (int)kNumStatSlots);
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                                (uint32_t)(n * sizeof(uint64_t)));
+      conn->send_msg(r, v);
+      return;
+    }
+    if (op == TRACE_DRAIN) {
+      // destructive batch drain: each sampled span fuses into exactly
+      // one timeline; the client loops until a short batch
+      std::vector<TraceRec> recs(kCtrlDrainBatch);
+      size_t n = trace_ring_.drain(recs.data(), kCtrlDrainBatch, true);
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                                (uint32_t)(n * sizeof(TraceRec)));
+      conn->send_msg(r, recs.data());
+      return;
+    }
+    // FLIGHT_DRAIN: snapshot, never consumes — a metrics poll must not
+    // steal the events a later crash dump needs. One shot, NEWEST
+    // window (EventRing::drain non-consume): the cap covers a whole
+    // default ring, and an over-provisioned ring still dumps the
+    // events nearest the fault.
+    std::vector<FlightRec> recs(kCtrlFlightDrainMax);
+    size_t n = flight_ring_.drain(recs.data(), kCtrlFlightDrainMax,
+                                  false);
+    MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                              (uint32_t)(n * sizeof(FlightRec)));
+    conn->send_msg(r, recs.data());
+  }
+
+  // flight-plane event (bounded ring, drop-oldest): the structured
+  // counterpart of the stderr lines the fault paths already print
+  void Flight(uint8_t kind, uint64_t key, uint32_t rid, uint16_t sender,
+              uint64_t detail = 0) {
+    FlightRec r{};
+    r.ts_ns = now_ns();
+    r.key = key;
+    r.detail = detail;
+    r.rid = rid;
+    r.sender = sender;
+    r.kind = kind;
+    flight_ring_.push(r);
+  }
+
   void HandleBarrier(EngineMsg&& m) {
     std::vector<ParkedPull> release;
     {
@@ -2615,6 +2923,7 @@ class Server {
                                   std::memory_order_relaxed);
         stats_.queue_count.fetch_add(1, std::memory_order_relaxed);
       }
+      if (m.traced) m.deq_ns = now_ns();
       if (m.direct) {
         // adopt the direct-recv buffer as the message payload (O(1)
         // move — the received bytes travel pointer-only from here into
@@ -2646,11 +2955,30 @@ class Server {
             // server). Error-reply instead of dropping — a fused client
             // would otherwise wait out its full request timeout on a
             // request this server can never answer.
+            Flight(kFlightUnknownOp, m.key, m.rid, m.sender, m.op);
             MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
             m.conn->send_msg(r, nullptr);
             break;
           }
         }
+      }
+      if (m.traced) {
+        // kind-0 request span: recv → enqueue → dequeue → handler done
+        // (the de-aggregated recv/queue-wait/fold stage counters); the
+        // reply leg, which for a parked fused reply happens in a later
+        // engine invocation, records as its own kind-1 event rid-joined
+        // by the worker-side fuser
+        TraceRec t{};
+        t.key = m.key;
+        t.t0 = m.recv_ns;
+        t.t1 = m.enq_ns;
+        t.t2 = m.deq_ns;
+        t.t3 = now_ns();
+        t.rid = m.rid;
+        t.sender = m.sender;
+        t.op = m.op;
+        t.kind = 0;
+        trace_ring_.push(t);
       }
       // epilogue: out-of-band arena blocks release only AFTER the fold
       // consumed them; un-adopted payload buffers recycle to the pool
@@ -2683,6 +3011,7 @@ class Server {
     if (m.sender >= ks.last_round.size() ||
         rnd > ks.last_round[m.sender])
       return false;
+    Flight(kFlightReplayDedup, m.key, m.rid, m.sender, rnd);
     std::fprintf(stderr,
                  "[bps-server] dedup: replayed push key=%llu sender=%u "
                  "round=%llu attempt=%llu (already folded)\n",
@@ -2719,6 +3048,7 @@ class Server {
                    "COMP_INIT)\n",
                    (unsigned long long)m.key, (unsigned)m.sender,
                    (unsigned)id, (unsigned)want);
+      Flight(kFlightCodecReject, m.key, m.rid, m.sender, m.codec);
       return false;
     }
     if (!async_) {
@@ -2731,6 +3061,7 @@ class Server {
                      "(worker codec plans disagree) — refusing to fold\n",
                      (unsigned long long)m.key, (unsigned)m.sender,
                      ks.round_codec, m.codec);
+        Flight(kFlightCodecReject, m.key, m.rid, m.sender, m.codec);
         return false;
       }
     }
@@ -2977,9 +3308,12 @@ class Server {
       std::lock_guard<Mu> lk(ks.mu);
       ready = PullReady(ks, m.sender);
       if (!ready)
-        ks.parked_pulls.push_back({m.conn, m.rid, m.sender, compressed});
+        ks.parked_pulls.push_back(
+            {m.conn, m.rid, m.sender, compressed, m.traced, m.key});
     }
-    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender, compressed});
+    if (ready)
+      AnswerPull(ks,
+                 {m.conn, m.rid, m.sender, compressed, m.traced, m.key});
   }
 
   void DoPushCompressed(EngineMsg& m, KeyStore& ks, bool fused) {
@@ -3426,6 +3760,7 @@ class Server {
         // drop or send failure keeps ownership local — the epilogue
         // release then runs as usual and the client retries.
         if (chaos_.swallow_reply()) {
+          Flight(kFlightChaosDrop, m.key, m.rid, m.sender);
           std::fprintf(stderr,
                        "[bps-server] CHAOS: dropped echo reply rid=%u "
                        "sender=%u\n", m.rid, (unsigned)m.sender);
@@ -3441,6 +3776,7 @@ class Server {
             m.oob_chan = nullptr;  // client now owns the block
             m.oob = nullptr;
           }
+          TraceReply({m.conn, m.rid, m.sender, false, m.traced, m.key});
         }
       } else {
         FusedReply(ks, m, /*compressed=*/false);
@@ -3455,11 +3791,25 @@ class Server {
     return ks.completed_rounds >= pushed;
   }
 
+  // kind-1 reply trace event for a sampled request whose aggregate just
+  // left — rid-joins with its kind-0 request span in the fused timeline
+  void TraceReply(const ParkedPull& p) {
+    if (!p.traced) return;
+    TraceRec t{};
+    t.t0 = now_ns();
+    t.rid = p.rid;
+    t.sender = p.sender;
+    t.op = PULL_REPLY;
+    t.kind = 1;
+    trace_ring_.push(t);
+  }
+
   void AnswerPull(KeyStore& ks, const ParkedPull& p) {
     // chaos injection point: delay, then (deterministically) drop the
     // aggregate reply — the requester times out and retries; the epoch
     // dedup above guarantees the retry can't double-count
     if (chaos_.swallow_reply()) {
+      Flight(kFlightChaosDrop, p.key, p.rid, p.sender);
       std::fprintf(stderr,
                    "[bps-server] CHAOS: dropped reply rid=%u sender=%u\n",
                    p.rid, (unsigned)p.sender);
@@ -3480,6 +3830,7 @@ class Server {
       stats_.reply_ns.fetch_add(now_ns() - t0,
                                 std::memory_order_relaxed);
       stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
+      TraceReply(p);
       return;
     }
     // sync: zero-copy — ALL_RECV swaps the published shared_ptr and never
@@ -3506,6 +3857,7 @@ class Server {
     p.conn->send_msg(r, snap->data());
     stats_.reply_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
     stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
+    TraceReply(p);
   }
 
   void DoPull(EngineMsg& m) {
@@ -3525,6 +3877,7 @@ class Server {
         // pushed: serving the previous round's aggregate would be a
         // silent stale read — error so the worker retries the round
         ks.pull_abort[m.sender] = 0;
+        Flight(kFlightPullAbort, m.key, m.rid, m.sender);
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
@@ -3533,7 +3886,8 @@ class Server {
                (comp && ks.comp.type == CompressorCfg::NONE);
       ready = !uninit && PullReady(ks, m.sender);
       if (!uninit && !ready) {
-        ks.parked_pulls.push_back({m.conn, m.rid, m.sender, comp});
+        ks.parked_pulls.push_back(
+            {m.conn, m.rid, m.sender, comp, m.traced, m.key});
       }
     }
     if (uninit) {
@@ -3545,7 +3899,8 @@ class Server {
       m.conn->send_msg(r, nullptr);
       return;
     }
-    if (ready) AnswerPull(ks, {m.conn, m.rid, m.sender, comp});
+    if (ready)
+      AnswerPull(ks, {m.conn, m.rid, m.sender, comp, m.traced, m.key});
   }
 
   // per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
@@ -3583,6 +3938,12 @@ class Server {
   Mu assign_mu_;
   FoldKernels kernels_;  // BYTEPS_SIMD, resolved per Server
   StageStats stats_;     // per-stage data-plane accounting
+  // observability plane (members are mutable-free: EventRing locks
+  // internally, so handlers record from any thread)
+  long trace_sample_;               // BYTEPS_TRACE_SAMPLE; 0 = off
+  std::atomic<uint64_t> trace_seq_{0};
+  EventRing<TraceRec> trace_ring_;
+  EventRing<FlightRec> flight_ring_;
   BufPool pool_;         // recycled payload/fold-scratch buffers
 
   std::unordered_map<uint64_t, KeyStore> stores_;
@@ -3674,6 +4035,15 @@ class CompletionQueue {
   std::deque<CompletionRec> q_;
   bool closed_ = false;
 };
+
+// Request ids are unique across EVERY connection of this process (not
+// merely per conn, the waiter-table requirement): a server-side trace
+// record's rid then names exactly one worker request, which is what
+// lets the fused timeline draw a flow arrow from a worker PUSHPULL
+// span to the server's recv/queue/fold spans without guessing which
+// striped conn carried it. u32 wrap at 4B requests is fine — live
+// rids are only ever the handful in flight.
+static std::atomic<uint32_t> g_next_rid{1};
 
 struct Waiter {
   // Raw pthread primitives with EXPLICIT init/destroy — not std::mutex.
@@ -3865,7 +4235,7 @@ class ServerConn {
     pthread_mutex_lock(&w->mu);
     w->detached = true;
     pthread_mutex_unlock(&w->mu);
-    uint32_t rid = next_rid_.fetch_add(1);
+    uint32_t rid = g_next_rid.fetch_add(1);
     {
       std::lock_guard<Mu> lk(waiters_mu_);
       // re-check under the sweep's mutex: a poison landing between the
@@ -3903,7 +4273,8 @@ class ServerConn {
   bool RequestFused(uint64_t key, uint32_t cmd, uint16_t sender,
                     const void* data, uint32_t len, void* out,
                     uint32_t out_len, uint64_t ticket,
-                    uint64_t epoch = 0, uint32_t codec = 0) {
+                    uint64_t epoch = 0, uint32_t codec = 0,
+                    uint32_t* rid_out = nullptr) {
     if (sticky_err_.load()) return false;
     auto w = AcquireWaiter();
     pthread_mutex_lock(&w->mu);
@@ -3913,7 +4284,8 @@ class ServerConn {
     w->out_len = out_len;
     w->sent_at = std::chrono::steady_clock::now();
     pthread_mutex_unlock(&w->mu);
-    uint32_t rid = next_rid_.fetch_add(1);
+    uint32_t rid = g_next_rid.fetch_add(1);
+    if (rid_out) *rid_out = rid;  // the trace-plane flow-link id
     {
       std::lock_guard<Mu> lk(waiters_mu_);
       // same re-check-under-lock as RequestAsync: a poison landing
@@ -4009,18 +4381,22 @@ class ServerConn {
   // presumed dead — the signal the worker-side failover consumes.
   bool dead() const { return sticky_err_.load(); }
 
-  // blocking request: returns got_len or ~0u on failure
+  // blocking request: returns got_len or ~0u on failure.
+  // ``timeout_s_override`` > 0 bounds THIS request's wait instead of
+  // the process-latched BYTEPS_CLIENT_TIMEOUT_S — control-plane pulls
+  // (stats/trace/flight/clock) ride it so a wedged server costs a
+  // metrics poll seconds, never the data plane's 600s budget.
   uint32_t Request(uint8_t op, uint64_t key, uint32_t cmd, uint16_t sender,
                    const void* data, uint32_t len, void* out,
                    uint32_t out_len, uint64_t epoch = 0,
-                   uint32_t codec = 0) {
+                   uint32_t codec = 0, long timeout_s_override = -1) {
     if (sticky_err_.load()) return ~0u;
     auto w = AcquireWaiter();
     pthread_mutex_lock(&w->mu);
     w->out = out;
     w->out_len = out_len;
     pthread_mutex_unlock(&w->mu);
-    uint32_t rid = next_rid_.fetch_add(1);
+    uint32_t rid = g_next_rid.fetch_add(1);
     {
       std::lock_guard<Mu> lk(waiters_mu_);
       // same re-check-under-lock as RequestAsync: close the window
@@ -4050,10 +4426,12 @@ class ServerConn {
     // would otherwise wedge the worker forever. A dead connection already
     // fails fast (RecvLoop's fail-all); this bounds the wedge case.
     // BYTEPS_CLIENT_TIMEOUT_S <= 0 restores infinite waits.
-    static const long timeout_s = [] {
+    static const long env_timeout_s = [] {
       const char* e = ::getenv("BYTEPS_CLIENT_TIMEOUT_S");
       return e && *e ? std::atol(e) : 600L;
     }();
+    const long timeout_s =
+        timeout_s_override > 0 ? timeout_s_override : env_timeout_s;
     pthread_mutex_lock(&w->mu);
     bool done = waiter_wait_done(w.get(), timeout_s);
     if (!done) {
@@ -4288,7 +4666,7 @@ class ServerConn {
   // freed while the conn lives — the TSAN-verified fix for the
   // destroyed-mutex address-reuse report
   std::vector<std::shared_ptr<Waiter>> waiter_pool_;
-  std::atomic<uint32_t> next_rid_{1};
+  // (rids come from the process-global g_next_rid: see its comment)
   // set by a rejected detached (async) push: the conn is poisoned —
   // every later Request fails fast instead of wedging on a round the
   // server will never complete
@@ -4341,12 +4719,45 @@ class Client {
   // `codec`: adaptive-plan wire tag, 0 = untagged (MsgHeader::codec).
   int PushPull(int server, uint64_t key, const void* data, uint32_t len,
                uint32_t cmd, void* out, uint32_t out_len,
-               uint64_t ticket, uint64_t epoch, uint32_t codec = 0) {
+               uint64_t ticket, uint64_t epoch, uint32_t codec = 0,
+               uint32_t* rid_out = nullptr) {
     return pick(server, key)->RequestFused(key, cmd, worker_id_, data,
                                            len, out, out_len, ticket,
-                                           epoch, codec)
+                                           epoch, codec, rid_out)
                ? 0
                : -1;
+  }
+
+  // ---- observability control plane --------------------------------- //
+
+  // Blocking control pull (STATS_PULL / TRACE_DRAIN / FLIGHT_DRAIN) on
+  // conn 0 of the server's group, with its OWN bounded timeout so a
+  // wedged server costs a poll seconds, not the data-plane budget.
+  // Returns the reply length or -1.
+  int Ctrl(int server, uint8_t op, void* out, uint32_t out_cap,
+           long timeout_s) {
+    if (server < 0 || server >= (int)groups_.size()) return -1;
+    uint32_t r = groups_[server]->conns[0]->Request(
+        op, 0, 0, worker_id_, nullptr, 0, out, out_cap, 0, 0,
+        timeout_s > 0 ? timeout_s : 5);
+    return r == ~0u ? -1 : (int)r;
+  }
+
+  // One NTP-style clock probe: out = {t0 client-send, t1 server-recv,
+  // t2 server-send, t3 client-recv}, all steady-clock ns (t0/t3 on the
+  // client's clock, t1/t2 on the server's). Returns 0 or -1.
+  int ClockProbe(int server, uint64_t* out4, long timeout_s) {
+    if (server < 0 || server >= (int)groups_.size()) return -1;
+    uint64_t echo[2] = {0, 0};
+    out4[0] = now_ns();
+    uint32_t r = groups_[server]->conns[0]->Request(
+        CLOCK_PROBE, 0, 0, worker_id_, nullptr, 0, echo, sizeof(echo),
+        0, 0, timeout_s > 0 ? timeout_s : 5);
+    out4[3] = now_ns();
+    if (r != sizeof(echo)) return -1;
+    out4[1] = echo[0];
+    out4[2] = echo[1];
+    return 0;
   }
 
   // True when every striped connection to `server` is dead (transport
@@ -4533,23 +4944,24 @@ void* bps_server_create_dbg(int port, int num_workers, int engine_threads,
 int bps_server_run(void* s) { return ((bps::Server*)s)->Run(); }
 
 // Per-stage server data-plane counters (docs/observability.md `server`
-// section): out[0]=recv_ns [1]=recv_count [2]=queue_ns [3]=queue_count
-// [4]=fold_ns [5]=fold_count [6]=fold_bytes [7]=reply_ns
-// [8]=reply_count [9]=direct_recvs [10]=oob_msgs [11]=simd_tier
-// [12]=engine_threads. Returns slots filled (layout append-only).
+// section). Slot order is the append-only kStatSlotNames contract —
+// machine-checked against the Python _STAT_SLOTS mirror by byteps-lint
+// and readable at runtime via bps_server_stat_name(). Returns slots
+// filled. The SAME vector answers the STATS_PULL wire op, so the
+// in-process and remote surfaces cannot drift.
 int bps_server_stats(void* s, uint64_t* out, int max_n) {
-  auto* srv = (bps::Server*)s;
-  const bps::StageStats& st = srv->stats();
-  uint64_t v[13] = {
-      st.recv_ns.load(),  st.recv_count.load(),  st.queue_ns.load(),
-      st.queue_count.load(), st.fold_ns.load(),  st.fold_count.load(),
-      st.fold_bytes.load(),  st.reply_ns.load(), st.reply_count.load(),
-      st.direct_recvs.load(), st.oob_msgs.load(),
-      (uint64_t)srv->simd_tier(), (uint64_t)srv->num_engines()};
-  int n = max_n < 13 ? max_n : 13;
-  for (int i = 0; i < n; ++i) out[i] = v[i];
-  return n;
+  return ((bps::Server*)s)->stat_slots(out, max_n);
 }
+
+// Runtime view of the slot-layout manifest: name of slot i (nullptr
+// out of range) and the slot count — lets a test assert the LOADED .so
+// agrees with the Python mirror it is parsed by.
+const char* bps_server_stat_name(int i) {
+  if (i < 0 || (size_t)i >= bps::kNumStatSlots) return nullptr;
+  return bps::kStatSlotNames[i];
+}
+
+int bps_server_stat_count() { return (int)bps::kNumStatSlots; }
 
 // Cumulative queued payload bytes per engine thread — the balance
 // proof for byte-weighted key placement. Returns engines filled.
@@ -4632,6 +5044,42 @@ int bps_client_pushpull_async(void* c, int server, uint64_t key,
                               uint32_t codec) {
   return ((bps::Client*)c)->PushPull(server, key, data, len, cmd, out,
                                      out_len, ticket, epoch, codec);
+}
+
+// Fused PUSHPULL with the wire rid reported back through `rid_out` —
+// the flow-link id the fused timeline uses to tie this worker span to
+// the server's trace spans. A NEW export rather than a new parameter
+// on bps_client_pushpull_async: an older Python against this .so keeps
+// its exact old signature, and a newer Python against an older .so
+// falls back via hasattr (the usual version-skew discipline).
+int bps_client_pushpull_async2(void* c, int server, uint64_t key,
+                               const void* data, uint32_t len,
+                               uint32_t cmd, void* out, uint32_t out_len,
+                               uint64_t ticket, uint64_t epoch,
+                               uint32_t codec, uint32_t* rid_out) {
+  return ((bps::Client*)c)->PushPull(server, key, data, len, cmd, out,
+                                     out_len, ticket, epoch, codec,
+                                     rid_out);
+}
+
+// Blocking observability control pull against one server: `op` is
+// STATS_PULL (12), TRACE_DRAIN (13) or FLIGHT_DRAIN (14); the reply
+// payload lands in `out` and the call returns its length (-1 on
+// failure). `timeout_s` bounds THIS request (<=0 -> 5s) independently
+// of BYTEPS_CLIENT_TIMEOUT_S — a wedged server costs a poll seconds.
+int bps_client_ctrl(void* c, int server, int op, void* out,
+                    uint32_t out_cap, int timeout_s) {
+  return ((bps::Client*)c)->Ctrl(server, (uint8_t)op, out, out_cap,
+                                 timeout_s);
+}
+
+// One NTP-style clock probe against `server`: fills out4 with {t0
+// client-send, t1 server-recv, t2 server-send, t3 client-recv} steady-
+// clock ns. The Python side aggregates several probes and keeps the
+// min-RTT one (utils/tracing.py estimate_clock_offset). Returns 0/-1.
+int bps_client_clock_probe(void* c, int server, uint64_t* out4,
+                           int timeout_s) {
+  return ((bps::Client*)c)->ClockProbe(server, out4, timeout_s);
 }
 
 // 1 when every striped connection to `server` is dead (transport EOF /

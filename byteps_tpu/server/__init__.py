@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import threading
 from typing import Dict, List, Optional
 
@@ -33,12 +34,47 @@ from ..native.build import build
 _live_mu = threading.Lock()
 _live: list = []  # [(lib, ptr), ...]; every access under _live_mu
 
-# bps_server_stats slot layout (append-only contract with native/ps.cc)
+# bps_server_stats / STATS_PULL slot layout — append-only contract with
+# native/ps.cc kStatSlotNames, machine-checked both directions by
+# byteps-lint's slot-layout check (tools/lint/wire_layout.py); the same
+# vector answers the STATS_PULL wire op, so this mirror parses the
+# remote fleet's snapshots too.
 _STAT_SLOTS = (
     "recv_ns", "recv_count", "queue_ns", "queue_count", "fold_ns",
     "fold_count", "fold_bytes", "reply_ns", "reply_count",
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
+    "trace_records", "trace_dropped", "flight_records",
+    "flight_dropped",
 )
+
+# Wire-sampled trace record (native/ps.cc TraceRec, drained over the
+# TRACE_DRAIN control op). Field order/packing is wire contract; the
+# lint slot-layout check diffs _TRACE_REC_FIELDS against the native
+# kTraceRecFields manifest and TRACE_REC_FMT against the struct size.
+# kind 0 = request span (t0 recv, t1 enqueue, t2 dequeue/fold start,
+# t3 handler done), kind 1 = reply send (t0 = send instant).
+TRACE_REC_FMT = "<QQQQQIHBB"
+TRACE_REC_BYTES = 48
+_TRACE_REC_FIELDS = (
+    "key", "t0", "t1", "t2", "t3", "rid", "sender", "op", "kind",
+)
+assert struct.calcsize(TRACE_REC_FMT) == TRACE_REC_BYTES
+
+# Server-side flight-recorder record (native/ps.cc FlightRec, drained
+# over FLIGHT_DRAIN — a SNAPSHOT read: polls never steal the events a
+# crash dump needs). Same lint discipline as the trace record.
+FLIGHT_REC_FMT = "<QQQIHBB"
+FLIGHT_REC_BYTES = 32
+_FLIGHT_REC_FIELDS = (
+    "ts_ns", "key", "detail", "rid", "sender", "kind", "pad",
+)
+assert struct.calcsize(FLIGHT_REC_FMT) == FLIGHT_REC_BYTES
+
+# native/ps.cc enum FlightKind — event names for the merged dump
+FLIGHT_KIND_NAMES = {
+    1: "replay_dedup", 2: "codec_reject", 3: "chaos_drop",
+    4: "worker_departed", 5: "pull_abort", 6: "unknown_op",
+}
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -57,7 +93,64 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bps_server_engine_bytes.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int]
+    if hasattr(lib, "bps_server_stat_name"):
+        # runtime view of the slot-layout manifest (guarded: stale .so)
+        lib.bps_server_stat_name.restype = ctypes.c_char_p
+        lib.bps_server_stat_name.argtypes = [ctypes.c_int]
+        lib.bps_server_stat_count.restype = ctypes.c_int
+        lib.bps_server_stat_count.argtypes = []
     return lib
+
+
+def native_stat_slot_names() -> List[str]:
+    """The LOADED .so's slot-name manifest (empty on a stale .so) —
+    lets a test assert the binary agrees with the ``_STAT_SLOTS``
+    mirror that parses it, beyond the source-level lint check."""
+    lib = _bind(ctypes.CDLL(build()))
+    if not hasattr(lib, "bps_server_stat_name"):
+        return []
+    return [lib.bps_server_stat_name(i).decode()
+            for i in range(lib.bps_server_stat_count())]
+
+
+def parse_stat_slots(raw) -> Dict[str, int]:
+    """u64 slot vector (ctypes array, bytes, or int sequence) ->
+    name->value dict under the append-only ``_STAT_SLOTS`` contract —
+    THE one parser for both the in-process mirror and the STATS_PULL
+    wire reply."""
+    if isinstance(raw, (bytes, bytearray, memoryview)):
+        raw = struct.unpack(f"<{len(raw) // 8}Q", bytes(raw))
+    out = {k: 0 for k in _STAT_SLOTS}
+    for i, v in enumerate(raw):
+        if i >= len(_STAT_SLOTS):
+            break  # newer server: trailing slots unknown to this mirror
+        out[_STAT_SLOTS[i]] = int(v)
+    return out
+
+
+def derive_stage_section(raw: Dict[str, int]) -> Dict[str, float]:
+    """Raw slot dict -> the documented ms-derived ``server``-section
+    shape (shared by the in-process section and the per-server entries
+    of ``bps.get_fleet_metrics()``, so the two surfaces can't drift)."""
+    return {
+        "recv_ms": raw["recv_ns"] / 1e6,
+        "recv_count": raw["recv_count"],
+        "queue_wait_ms": raw["queue_ns"] / 1e6,
+        "queue_count": raw["queue_count"],
+        "fold_ms": raw["fold_ns"] / 1e6,
+        "fold_count": raw["fold_count"],
+        "fold_bytes": raw["fold_bytes"],
+        "reply_ms": raw["reply_ns"] / 1e6,
+        "reply_count": raw["reply_count"],
+        "direct_recvs": raw["direct_recvs"],
+        "oob_msgs": raw["oob_msgs"],
+        "simd_tier": raw["simd_tier"],
+        "engine_threads": raw["engine_threads"],
+        "trace_records": raw["trace_records"],
+        "trace_dropped": raw["trace_dropped"],
+        "flight_records": raw["flight_records"],
+        "flight_dropped": raw["flight_dropped"],
+    }
 
 
 def stage_stats() -> Dict[str, int]:
@@ -86,6 +179,21 @@ def stage_stats() -> Dict[str, int]:
     return out
 
 
+def per_server_stats() -> List[Dict[str, int]]:
+    """One raw slot dict per live IN-PROCESS server, in registration
+    order — the local half of the fleet snapshot (remote/subprocess
+    servers answer the same vector over the STATS_PULL control op)."""
+    out: List[Dict[str, int]] = []
+    buf = (ctypes.c_uint64 * len(_STAT_SLOTS))()
+    with _live_mu:  # see stage_stats: excludes a concurrent destroy
+        for lib, ptr in _live:
+            if not hasattr(lib, "bps_server_stats"):
+                continue
+            n = lib.bps_server_stats(ptr, buf, len(_STAT_SLOTS))
+            out.append(parse_stat_slots([buf[i] for i in range(n)]))
+    return out
+
+
 def engine_stats() -> List[List[int]]:
     """Cumulative queued payload bytes per engine thread, one list per
     live in-process server — the balance-proof surface for the
@@ -109,22 +217,9 @@ def stage_section() -> Dict[str, float]:
     fixed whether or not a server is local, so the documented schema
     resolves on every deployment."""
     raw = stage_stats()
-    return {
-        "recv_ms": raw["recv_ns"] / 1e6,
-        "recv_count": raw["recv_count"],
-        "queue_wait_ms": raw["queue_ns"] / 1e6,
-        "queue_count": raw["queue_count"],
-        "fold_ms": raw["fold_ns"] / 1e6,
-        "fold_count": raw["fold_count"],
-        "fold_bytes": raw["fold_bytes"],
-        "reply_ms": raw["reply_ns"] / 1e6,
-        "reply_count": raw["reply_count"],
-        "direct_recvs": raw["direct_recvs"],
-        "oob_msgs": raw["oob_msgs"],
-        "simd_tier": raw["simd_tier"],
-        "engine_threads": raw["engine_threads"],
-        "live": raw["live"],
-    }
+    out = derive_stage_section(raw)
+    out["live"] = raw["live"]
+    return out
 
 
 def run_server(port: Optional[int] = None,

@@ -46,6 +46,27 @@ WIRE_HEADER_FMT = "<IBBHIQIIQI"
 WIRE_HEADER_BYTES = 40
 assert struct.calcsize(WIRE_HEADER_FMT) == WIRE_HEADER_BYTES
 
+# Observability control ops (native/ps.cc enum Op; machine-checked by
+# byteps-lint's slot-layout check against the enum). Header-only
+# requests the server answers INLINE from the conn loop — stats/trace/
+# flight pulls and the NTP-style clock echo never queue behind folds.
+WIRE_CTRL_OPS = {
+    "STATS_PULL": 12,
+    "TRACE_DRAIN": 13,
+    "FLIGHT_DRAIN": 14,
+    "CLOCK_PROBE": 15,
+}
+
+# Control-pull reply size limits (native/ps.cc enum CtrlLimits, also
+# lint-checked): the reply buffers below are sized from these, and a
+# reply larger than its buffer is drained-not-delivered by the native
+# recv loop — a silent empty drain, exactly the drift class the
+# machine check exists to prevent.
+WIRE_CTRL_LIMITS = {
+    "kCtrlDrainBatch": 1024,
+    "kCtrlFlightDrainMax": 4096,
+}
+
 
 def _load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(build())
@@ -102,6 +123,25 @@ def _load_lib() -> ctypes.CDLL:
         lib.bps_client_transport_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int]
+    if hasattr(lib, "bps_client_pushpull_async2"):
+        # fused op reporting the wire rid back (the trace-plane flow
+        # link); guarded — a stale .so degrades to rid-less tracing
+        lib.bps_client_pushpull_async2.restype = ctypes.c_int
+        lib.bps_client_pushpull_async2.argtypes = (
+            lib.bps_client_pushpull_async.argtypes
+            + [ctypes.POINTER(ctypes.c_uint32)])
+    if hasattr(lib, "bps_client_ctrl"):
+        # observability control plane (stats/trace/flight pulls + the
+        # clock probe); guarded — supports_fleet reads False on a
+        # stale .so and the fleet surfaces degrade to local-only
+        lib.bps_client_ctrl.restype = ctypes.c_int
+        lib.bps_client_ctrl.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_int]
+        lib.bps_client_clock_probe.restype = ctypes.c_int
+        lib.bps_client_clock_probe.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
     lib.bps_client_ipc_conns.argtypes = [ctypes.c_void_p]
@@ -326,6 +366,114 @@ class PSClient:
         return out
 
     # ------------------------------------------------------------ #
+    # fleet observability control plane (docs/observability.md):
+    # stats/trace/flight pulls + the clock probe — the wire ops that
+    # make an out-of-process server as measurable as an in-process one
+    # ------------------------------------------------------------ #
+
+    @property
+    def supports_fleet(self) -> bool:
+        """True when the loaded native library speaks the observability
+        control ops (False only under stale-.so version skew — the
+        fleet surfaces then degrade to in-process servers only)."""
+        return hasattr(self._lib, "bps_client_ctrl")
+
+    def _ctrl(self, server: int, op: str, cap: int,
+              timeout_s: int = 5) -> Optional[bytes]:
+        """One bounded control pull; returns the reply payload or None
+        (unsupported ABI / failed request). The per-request timeout is
+        deliberate: a wedged server costs a metrics poll ``timeout_s``
+        seconds, never the data plane's BYTEPS_CLIENT_TIMEOUT_S."""
+        self._check_server(server)
+        if self._closed:
+            raise RuntimeError("control pull on a closed PSClient")
+        if not self.supports_fleet:
+            return None
+        buf = (ctypes.c_uint8 * cap)()
+        n = self._lib.bps_client_ctrl(
+            self._handle, server, WIRE_CTRL_OPS[op], buf, cap, timeout_s)
+        if n < 0:
+            return None
+        return bytes(buf[:n])
+
+    def server_stats(self, server: int,
+                     timeout_s: int = 5) -> Optional[dict]:
+        """One remote server's full per-stage registry snapshot (the
+        same slot vector as the in-process ``bps_server_stats`` mirror,
+        by construction — STATS_PULL answers from one definition).
+        None when the server is unreachable or the ABI is stale."""
+        raw = self._ctrl(server, "STATS_PULL", 64 * 8, timeout_s)
+        if raw is None or len(raw) % 8:
+            return None
+        from . import parse_stat_slots
+        return parse_stat_slots(raw)
+
+    def drain_trace(self, server: int, timeout_s: int = 5,
+                    max_batches: int = 64) -> List[dict]:
+        """Drain (destructively) the server's wire-sampled trace ring:
+        a list of record dicts (``kind`` 0 = request span with recv/
+        enqueue/dequeue/done server-clock ns, 1 = reply send). Loops
+        full batches so one call empties the ring."""
+        from . import TRACE_REC_BYTES, TRACE_REC_FMT, _TRACE_REC_FIELDS
+        out: List[dict] = []
+        batch_cap = WIRE_CTRL_LIMITS["kCtrlDrainBatch"] * TRACE_REC_BYTES
+        for _ in range(max_batches):
+            raw = self._ctrl(server, "TRACE_DRAIN", batch_cap, timeout_s)
+            if not raw or len(raw) % TRACE_REC_BYTES:
+                break
+            out += [dict(zip(_TRACE_REC_FIELDS, rec))
+                    for rec in struct.iter_unpack(TRACE_REC_FMT, raw)]
+            if len(raw) < batch_cap:
+                break
+        return out
+
+    def drain_flight(self, server: int, timeout_s: int = 5) -> List[dict]:
+        """Snapshot the server's flight-recorder ring (non-destructive:
+        a poll never steals the events a later crash dump needs); kinds
+        resolve to names via ``FLIGHT_KIND_NAMES``."""
+        from . import (
+            FLIGHT_KIND_NAMES, FLIGHT_REC_BYTES, FLIGHT_REC_FMT,
+            _FLIGHT_REC_FIELDS,
+        )
+        raw = self._ctrl(
+            server, "FLIGHT_DRAIN",
+            WIRE_CTRL_LIMITS["kCtrlFlightDrainMax"] * FLIGHT_REC_BYTES,
+            timeout_s)
+        if not raw or len(raw) % FLIGHT_REC_BYTES:
+            return []
+        out = []
+        for rec in struct.iter_unpack(FLIGHT_REC_FMT, raw):
+            d = dict(zip(_FLIGHT_REC_FIELDS, rec))
+            d.pop("pad", None)
+            d["kind"] = FLIGHT_KIND_NAMES.get(d["kind"], str(d["kind"]))
+            out.append(d)
+        return out
+
+    def clock_probe(self, server: int, probes: int = 8,
+                    timeout_s: int = 5) -> Optional[tuple]:
+        """Estimate ``server``'s steady-clock offset NTP-style from
+        request/reply timestamp echoes: ``probes`` round trips, keep
+        the minimum-RTT sample (utils/tracing.py estimate_clock_offset).
+        Returns (offset_ns, err_bound_ns) where
+        ``server_clock - offset ≈ this process's clock``, or None when
+        unsupported/unreachable."""
+        self._check_server(server)
+        if self._closed or not self.supports_fleet:
+            return None
+        buf = (ctypes.c_uint64 * 4)()
+        samples = []
+        for _ in range(max(1, probes)):
+            if self._lib.bps_client_clock_probe(
+                    self._handle, server, buf, timeout_s) != 0:
+                continue
+            samples.append((int(buf[0]), int(buf[1]), int(buf[2]),
+                            int(buf[3])))
+        if not samples:
+            return None
+        from ..utils.tracing import estimate_clock_offset
+        return estimate_clock_offset(samples)
+
+    # ------------------------------------------------------------ #
     # per-server health (the elastic/failover plane)
     # ------------------------------------------------------------ #
 
@@ -473,7 +621,7 @@ class PSClient:
     def zpushpull_async(self, server: int, key: int, data: np.ndarray,
                         out: np.ndarray, cmd: int,
                         on_done: Callable[[int, Optional[Exception]], None],
-                        epoch: int = 0, codec: int = 0) -> None:
+                        epoch: int = 0, codec: int = 0) -> int:
         """Fused push+pull in ONE wire round trip: push ``data``, and
         when the server's aggregation round completes, the aggregate
         lands in ``out`` and ``on_done(reply_len, error)`` runs on the
@@ -485,7 +633,11 @@ class PSClient:
         replay-dedup stamp (see zpush) — a retried fused request with
         the same round is answered from the round's aggregate without
         re-folding the payload. ``codec``: adaptive wire tag (see
-        zpush)."""
+        zpush).
+
+        Returns the request's wire rid (0 on a native lib predating the
+        reporting ABI) — the id server-side trace spans carry, which the
+        fused timeline uses to flow-link worker and server spans."""
         self._check_server(server)
         if not out.flags["C_CONTIGUOUS"]:
             raise ValueError(
@@ -501,9 +653,16 @@ class PSClient:
             self._fused[ticket] = (on_done, out)
         self._ensure_reactor()
         self._inflight_add(1)
-        rc = self._lib.bps_client_pushpull_async(
-            self._handle, server, key, data.ctypes.data, data.nbytes, cmd,
-            out.ctypes.data, out.nbytes, ticket, epoch, codec)
+        rid = ctypes.c_uint32(0)
+        if hasattr(self._lib, "bps_client_pushpull_async2"):
+            rc = self._lib.bps_client_pushpull_async2(
+                self._handle, server, key, data.ctypes.data, data.nbytes,
+                cmd, out.ctypes.data, out.nbytes, ticket, epoch, codec,
+                ctypes.byref(rid))
+        else:
+            rc = self._lib.bps_client_pushpull_async(
+                self._handle, server, key, data.ctypes.data, data.nbytes,
+                cmd, out.ctypes.data, out.nbytes, ticket, epoch, codec)
         if self._m_pushpull_req is not None:
             self._m_pushpull_req.inc()
             self._m_push_bytes.inc(data.nbytes)
@@ -517,13 +676,14 @@ class PSClient:
             with self._fused_mu:
                 owned = self._fused.pop(ticket, None) is not None
             if not owned:
-                return  # reactor already delivered/owns the failure
+                return int(rid.value)  # reactor already owns the failure
             self._inflight_add(-1)
             if self._m_errors is not None:
                 self._m_errors.inc()
             raise RuntimeError(
                 f"fused pushpull failed to send key={key} "
                 f"(connection poisoned or lost)")
+        return int(rid.value)
 
     def _ensure_reactor(self) -> None:
         # double-checked locking: the flag only ever flips False->True,

@@ -123,6 +123,22 @@ def parse_enum(text: str, enum_name: str) -> Dict[str, int]:
     return out
 
 
+def parse_name_array(text: str, name: str
+                     ) -> Optional[Tuple[List[str], int]]:
+    """``static const char* const kX[] = {"a", "b", ...};`` ->
+    (["a", "b", ...], line). The slot/field manifests the native side
+    declares next to its stat vector and packed record structs — the
+    ground truth the slot-layout check diffs Python mirrors against.
+    None when the array does not exist in this tree."""
+    stripped = _strip_comments(text)
+    m = re.search(
+        r"%s\s*\[\]\s*=\s*\{(.*?)\};" % re.escape(name), stripped, re.S)
+    if not m:
+        return None
+    return (re.findall(r'"([^"]*)"', m.group(1)),
+            _line_of(stripped, m.start()))
+
+
 def getenv_reads(text: str) -> List[Tuple[str, int]]:
     """(var, line) for every ``getenv("X")`` in a C++ source."""
     stripped = _strip_comments(text)

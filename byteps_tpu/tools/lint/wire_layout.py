@@ -95,6 +95,174 @@ class WireLayoutRule(Rule):
         findings += self._check_codec_ids(project, text, rel_cc)
         findings += self._check_dtypes(project, text, rel_cc)
         findings += self._check_ipc_desc(project, text, rel_cc)
+        findings += self._check_slot_manifest(
+            project, text, rel_cc, "kStatSlotNames", "_STAT_SLOTS")
+        findings += self._check_slot_manifest(
+            project, text, rel_cc, "kTraceRecFields", "_TRACE_REC_FIELDS",
+            struct_name="TraceRec", fmt_const="TRACE_REC_FMT")
+        findings += self._check_slot_manifest(
+            project, text, rel_cc, "kFlightRecFields",
+            "_FLIGHT_REC_FIELDS", struct_name="FlightRec",
+            fmt_const="FLIGHT_REC_FMT")
+        findings += self._check_dict_enum(
+            project, text, rel_cc, "WIRE_CTRL_OPS", "Op",
+            "a skewed control op id reaches the server as an unknown op")
+        findings += self._check_dict_enum(
+            project, text, rel_cc, "WIRE_CTRL_LIMITS", "CtrlLimits",
+            "a skewed drain limit makes control replies overflow the "
+            "client buffer and drain silently empty")
+        return findings
+
+    # -- slot/record-layout manifests (bps_server_stats, trace ring,
+    #    flight ring) ---------------------------------------------------- #
+
+    def _find_tuple_const(self, project: Project, const: str):
+        """Locate a module-level tuple/list-of-str constant mirror."""
+        for p in project.py_files():
+            tree = project.tree(p)
+            if tree is None:
+                continue
+            node_line = _module_constants(tree).get(const)
+            if node_line is None:
+                continue
+            node, line = node_line
+            if isinstance(node, (ast.Tuple, ast.List)):
+                vals = [e.value for e in node.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                if len(vals) == len(node.elts):
+                    return p, line, vals
+            return p, line, None  # exists but not a str tuple
+        return None, 0, None
+
+    def _check_slot_manifest(self, project: Project, cc_text: str,
+                             rel_cc: str, cc_name: str, py_name: str,
+                             struct_name: Optional[str] = None,
+                             fmt_const: Optional[str] = None
+                             ) -> List[Finding]:
+        """The append-only slot/field contracts between ps.cc and the
+        Python mirrors — until PR 12 enforced only by a comment
+        (``_STAT_SLOTS``: "append-only contract with native/ps.cc").
+        Parses the native name manifest and diffs it against the
+        Python tuple BOTH directions (missing mirror, missing
+        manifest, reorder/rename/truncation all fail); for the packed
+        record layouts additionally pins the struct's static_assert
+        size against the mirror's struct-format size (the 40B-header
+        drift class, applied to the ring records)."""
+        findings: List[Finding] = []
+        parsed = cpp.parse_name_array(cc_text, cc_name)
+        path, line, vals = self._find_tuple_const(project, py_name)
+        if parsed is None and path is None:
+            return findings  # neither side: tree predates this plane
+        if parsed is None:
+            findings.append(Finding(
+                self.name, project.rel(path), line,
+                f"{py_name} exists but native {cc_name} manifest was "
+                f"not found — the slot layout is unverifiable"))
+            return findings
+        cc_slots, cc_line = parsed
+        if path is None:
+            findings.append(Finding(
+                self.name, rel_cc, cc_line,
+                f"native {cc_name} exists but no Python {py_name} "
+                f"mirror was found"))
+            return findings
+        rel = project.rel(path)
+        if vals is None:
+            findings.append(Finding(
+                self.name, rel, line,
+                f"{py_name} is not a tuple/list of str literals"))
+            return findings
+        if vals != cc_slots:
+            # name the FIRST divergence: reorders/renames/truncations
+            # all violate the append-only contract
+            i = next((i for i, (a, b) in enumerate(zip(vals, cc_slots))
+                      if a != b), min(len(vals), len(cc_slots)))
+            a = vals[i] if i < len(vals) else "<missing>"
+            b = cc_slots[i] if i < len(cc_slots) else "<missing>"
+            findings.append(Finding(
+                self.name, rel, line,
+                f"{py_name} disagrees with native {cc_name} at slot "
+                f"{i}: python {a!r} vs native {b!r} (append-only "
+                f"contract; {len(vals)} vs {len(cc_slots)} slots)"))
+        if struct_name and fmt_const:
+            rec = cpp.parse_header(cc_text, struct_name)
+            fmt_path, fmt_line, _ = self._find_tuple_const(
+                project, fmt_const)  # tuple lookup misses str consts
+            fmt_val = None
+            for p in project.py_files():
+                tree = project.tree(p)
+                if tree is None:
+                    continue
+                node_line = _module_constants(tree).get(fmt_const)
+                if node_line and isinstance(node_line[0], ast.Constant) \
+                        and isinstance(node_line[0].value, str):
+                    fmt_path, fmt_line = p, node_line[1]
+                    fmt_val = node_line[0].value
+                    break
+            if rec is not None and rec.asserted_size is not None \
+                    and fmt_val is not None:
+                try:
+                    size = struct.calcsize(fmt_val)
+                except struct.error:
+                    size = -1
+                if size != rec.asserted_size:
+                    findings.append(Finding(
+                        self.name, project.rel(fmt_path), fmt_line,
+                        f"{fmt_const} packs {size} bytes but native "
+                        f"{struct_name} is {rec.asserted_size} bytes"))
+            elif rec is not None and fmt_val is None:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"native {struct_name} exists but no {fmt_const} "
+                    f"struct-format mirror was found"))
+        return findings
+
+    # -- Python dict mirror <-> native enum (WIRE_CTRL_OPS <-> enum Op,
+    #    WIRE_CTRL_LIMITS <-> enum CtrlLimits) -------------------------- #
+
+    def _check_dict_enum(self, project: Project, cc_text: str,
+                         rel_cc: str, dict_name: str, enum_name: str,
+                         consequence: str) -> List[Finding]:
+        """Every entry of the Python dict mirror must match the native
+        enum member of the same name, by value."""
+        findings: List[Finding] = []
+        enum = cpp.parse_enum(cc_text, enum_name)
+        table: Dict[str, int] = {}
+        path = line = None
+        for p in project.py_files():
+            tree = project.tree(p)
+            if tree is None:
+                continue
+            node_line = _module_constants(tree).get(dict_name)
+            if node_line and isinstance(node_line[0], ast.Dict):
+                path, line = p, node_line[1]
+                for k, v in zip(node_line[0].keys, node_line[0].values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                            v, ast.Constant):
+                        table[k.value] = v.value
+                break
+        if not table:
+            return findings  # tree predates this mirror
+        rel = project.rel(path)
+        if not enum:
+            findings.append(Finding(
+                self.name, rel, line,
+                f"{dict_name} exists but native enum {enum_name} was "
+                f"not found"))
+            return findings
+        for name_, val in sorted(table.items()):
+            if name_ not in enum:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"{dict_name}[{name_!r}] has no native enum "
+                    f"{enum_name} member of that name"))
+            elif enum[name_] != val:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"{dict_name}[{name_!r}] = {val} but native "
+                    f"{enum_name}::{name_} = {enum[name_]} — "
+                    f"{consequence}"))
         return findings
 
     # -- IpcDesc (shm descriptor-ring framing) ------------------------- #

@@ -1,10 +1,18 @@
-"""Chrome-trace communication timeline.
+"""Chrome-trace communication timeline + fleet-fused dump.
 
 Reference: BYTEPS_TRACE_ON dumps per-(tensor, stage) spans to
 ``trace_dir/<local_rank>/comm.json`` in Chrome trace-event format
 (byteps/common/global.cc:448-564, docs/timeline.md). We reproduce the same
 file format, and additionally mirror spans into jax.profiler trace
 annotations so they appear in TensorBoard/Perfetto device traces.
+
+Beyond the reference: ``Tracer.dump()`` emits ONE fused timeline — the
+worker's PUSH/PULL spans plus every server's wire-sampled stage spans
+(recv → queue-wait → fold → reply, drained over the TRACE_DRAIN control
+op), clock-aligned via NTP-style offset estimation
+(``estimate_clock_offset``) and rid-linked with Chrome flow events, so
+a slow round is attributable to a specific server stage on a single
+timeline (docs/timeline.md).
 """
 
 from __future__ import annotations
@@ -13,9 +21,45 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..config import Config
+
+# synthetic pid base for server rows in the fused timeline (worker
+# events keep the real os.getpid(); Chrome/Perfetto group rows by pid)
+_SERVER_PID_BASE = 1000000
+
+
+def estimate_clock_offset(
+        samples: Sequence[Tuple[int, int, int, int]]) -> Tuple[int, int]:
+    """NTP-style clock-offset estimate from request/reply timestamp
+    echoes. Each sample is ``(t0, t1, t2, t3)``: client send, server
+    recv, server send, client recv — t0/t3 on the client's steady
+    clock, t1/t2 on the server's. For one sample the classic estimate
+    is ``offset = ((t1 - t0) + (t2 - t3)) / 2`` with the true offset
+    guaranteed inside ``± rtt/2`` where ``rtt = (t3-t0) - (t2-t1)``
+    (the bound is tight under asymmetric path delay — one direction
+    may consume the whole rtt). Across samples the MINIMUM-rtt probe
+    carries the tightest bound, so that one decides.
+
+    Returns ``(offset_ns, err_bound_ns)`` with
+    ``server_clock - offset ≈ client_clock``.
+    """
+    if not samples:
+        raise ValueError("estimate_clock_offset needs >= 1 sample")
+    best = None
+    for t0, t1, t2, t3 in samples:
+        rtt = (t3 - t0) - (t2 - t1)
+        if rtt < 0:
+            continue  # nonsensical echo (clock step mid-probe): skip
+        off = ((t1 - t0) + (t2 - t3)) // 2
+        if best is None or rtt < best[1]:
+            best = (off, rtt)
+    if best is None:
+        raise ValueError("every probe had negative rtt — broken echoes")
+    # bound: half the round trip, plus 1ns so a zero-rtt synthetic
+    # sample still reports a nonzero, honest uncertainty
+    return int(best[0]), int(best[1] // 2 + 1)
 
 
 class Tracer:
@@ -24,12 +68,24 @@ class Tracer:
         self._lock = threading.Lock()
         self._events: List[dict] = []
         self._step = 0
-        self._t0 = time.monotonic()
-        # (tensor, stage) -> (start_us, entered TraceAnnotation or None)
+        self._t0_ns = time.monotonic_ns()
+        # (tensor, stage) -> (start_us, entered TraceAnnotation or
+        # None, extra args dict or None, span seq)
         self._open_spans: Dict[tuple, tuple] = {}
+        # (tensor, stage) -> (seq, most recently RECORDED event dict):
+        # a late annotate() (rid racing a fast reply's end()) patches
+        # the event instead of vanishing; bounded by distinct spans
+        self._last_closed: Dict[tuple, tuple] = {}
+        # per-begin incarnation counter: annotate() callers hold the
+        # token of the span THEY opened, so a late annotate can never
+        # stamp the NEXT round's span for the same key
+        self._span_seq = 0
+        # fused-dump hook (core/state.py): () -> [{"server": idx,
+        # "offset_ns": o, "err_ns": e, "records": [TraceRec dicts]}]
+        self._server_collector: Optional[Callable[[], list]] = None
 
     def _us(self) -> float:
-        return (time.monotonic() - self._t0) * 1e6
+        return (time.monotonic_ns() - self._t0_ns) / 1e3
 
     def _active(self) -> bool:
         return (self._config.trace_on and
@@ -45,7 +101,7 @@ class Tracer:
             self.flush()
 
     def begin(self, name: str, stage: str,
-              cross_thread: bool = False) -> None:
+              cross_thread: bool = False) -> Optional[int]:
         """Mark the start of a (tensor, stage) span
         (reference: scheduled_queue.cc:105-123). begin/end normally pair
         on ONE thread (the stage's pool thread), which lets the span
@@ -56,13 +112,17 @@ class Tracer:
         on the stage thread, end in the completion reactor) — the
         Chrome-trace event still records, but the TraceAnnotation
         mirror is skipped, since annotations stack per thread and an
-        exit on another thread would unwind someone else's stack."""
+        exit on another thread would unwind someone else's stack.
+
+        Returns this span incarnation's token (None when nothing was
+        opened) — pass it to ``annotate`` so a late annotation can
+        never land on a LATER span of the same key."""
         # annotations mirror whenever a profiler dir is configured —
         # independent of the Chrome-trace window, which only gates the
         # comm.json events (a profiler session spans init()->shutdown())
         mirror = bool(self._config.jax_profiler_dir) and not cross_thread
         if not (mirror or self._active()):
-            return
+            return None
         with self._lock:
             prev = self._open_spans.pop((name, stage), None)
         if prev is not None and prev[1] is not None:
@@ -83,7 +143,46 @@ class Tracer:
             except Exception:  # noqa: BLE001 - profiler mirroring is aux
                 ann = None
         with self._lock:
-            self._open_spans[(name, stage)] = (self._us(), ann)
+            self._span_seq += 1
+            seq = self._span_seq
+            self._open_spans[(name, stage)] = (self._us(), ann, None,
+                                               seq)
+        return seq
+
+    def annotate(self, name: str, stage: str, token: Optional[int] = None,
+                 **args) -> None:
+        """Attach args to the (name, stage) span — how the wire stage
+        stamps the request's rid onto its span after the send assigned
+        one (the flow-link id the fused dump joins on). The span may
+        already be CLOSED: on a loopback fleet the reply can complete
+        (and the reactor run ``end()``) before the submitting thread
+        even returns from the native send — so a just-closed span's
+        recorded event is patched in place (the events list holds the
+        dict itself). ``token`` (begin()'s return) pins the annotation
+        to the caller's OWN span incarnation: a maximally-late annotate
+        racing the next round's ``begin`` for the same key must drop,
+        not stamp this round's rid onto the next round's span. A no-op
+        when the target span no longer exists (window closed, fallback
+        clients that report no rid)."""
+        if not args:
+            return
+        with self._lock:
+            entry = self._open_spans.get((name, stage))
+            if entry is not None:
+                start, ann, extra, seq = entry
+                if token is not None and token != seq:
+                    entry = None  # a later incarnation: fall through
+                else:
+                    merged = dict(extra) if extra else {}
+                    merged.update(args)
+                    self._open_spans[(name, stage)] = (start, ann,
+                                                       merged, seq)
+                    return
+            closed = self._last_closed.get((name, stage))
+            if closed is not None:
+                seq, ev = closed
+                if token is None or token == seq:
+                    ev["args"].update(args)
 
     def end(self, name: str, stage: str) -> None:
         """Record span duration (reference: core_loops.cc:69-91). The
@@ -95,7 +194,7 @@ class Tracer:
             entry = self._open_spans.pop((name, stage), None)
         if entry is None:
             return
-        start, ann = entry
+        start, ann, extra, seq = entry
         if ann is not None:
             try:
                 ann.__exit__(None, None, None)
@@ -103,12 +202,17 @@ class Tracer:
                 pass
         if not self._active():
             return
+        args = {"tensor": name}
+        if extra:
+            args.update(extra)
+        ev = {
+            "name": stage, "cat": "comm", "ph": "X",
+            "ts": start, "dur": self._us() - start,
+            "pid": os.getpid(), "tid": name, "args": args,
+        }
         with self._lock:
-            self._events.append({
-                "name": stage, "cat": "comm", "ph": "X",
-                "ts": start, "dur": self._us() - start,
-                "pid": os.getpid(), "tid": name, "args": {"tensor": name},
-            })
+            self._events.append(ev)
+            self._last_closed[(name, stage)] = (seq, ev)
 
     def counter(self, name: str, values: dict) -> None:
         """Chrome-trace counter event (``ph: "C"``): Perfetto renders
@@ -147,3 +251,143 @@ class Tracer:
                 json.dump({"traceEvents": self._events,
                            "displayTimeUnit": "ms"}, f)
             return out_path
+
+    # ---------------------------------------------------------------- #
+    # fused fleet timeline (docs/timeline.md)
+    # ---------------------------------------------------------------- #
+
+    def set_server_collector(self, fn: Callable[[], list]) -> None:
+        """Install the fleet hook dump() drains server spans through:
+        ``fn()`` returns one entry per server — ``{"server": idx,
+        "offset_ns": o, "err_ns": e, "records": [...]}`` with records
+        in the TRACE_DRAIN wire shape (server/__init__.py
+        ``_TRACE_REC_FIELDS``). Wired by core/state.py at init; tests
+        may install synthetic collectors."""
+        self._server_collector = fn
+
+    def _server_us(self, server_ns: int, offset_ns: int) -> float:
+        """Map a server steady-clock ns stamp onto this tracer's
+        microsecond timeline: subtract the estimated offset
+        (server_clock - offset ≈ client_clock), then rebase on t0."""
+        return ((server_ns - offset_ns) - self._t0_ns) / 1e3
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Emit ONE Chrome trace fusing the worker's comm spans with
+        every server's wire-sampled stage spans: servers land on their
+        own synthetic pid rows (process_name metadata names them),
+        each sampled request renders as recv → queue-wait → fold spans
+        (plus a reply span once its aggregate left), clock-aligned via
+        the collector's NTP-style offsets, and rid-linked to the worker
+        span that carries the same rid with Chrome flow events — a slow
+        round reads as a single arrow from the worker's PUSHPULL span
+        into the server stage that ate the time.
+
+        Writes ``<trace_dir>/<local_rank>/fused.json`` (or ``path``)
+        and returns it; returns None when there is nothing at all to
+        dump (no worker events AND no server records)."""
+        with self._lock:
+            # COPY the event dicts (args included) under the lock: a
+            # stage thread's late annotate() mutates the originals in
+            # place, and json.dump iterating a dict that grows a key
+            # mid-serialization raises — the dump must read a frozen
+            # snapshot (flush() is safe already: it serializes while
+            # holding the lock)
+            events = [dict(e, args=dict(e["args"])) if "args" in e
+                      else dict(e) for e in self._events]
+        fused: List[dict] = [{
+            "name": "process_name", "ph": "M", "pid": os.getpid(),
+            "args": {"name": f"bps-worker rank "
+                             f"{self._config.local_rank}"},
+        }]
+        fused += events
+        # worker spans by rid: the flow arrows start inside them
+        rid_spans = {e["args"]["rid"]: e for e in events
+                     if e.get("ph") == "X"
+                     and isinstance(e.get("args"), dict)
+                     and e["args"].get("rid")}
+        flows = 0
+        collected = self._server_collector() if self._server_collector \
+            else []
+        for entry in collected or []:
+            idx = int(entry.get("server", 0))
+            off = int(entry.get("offset_ns", 0))
+            pid = _SERVER_PID_BASE + idx
+            fused.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"bps-server {idx}",
+                         "clock_offset_ns": off,
+                         "clock_err_ns": int(entry.get("err_ns", 0))}})
+            # reply events joined to their request span by (rid, sender)
+            replies = {}
+            for rec in entry.get("records", []):
+                if rec.get("kind") == 1:
+                    replies[(rec["rid"], rec["sender"])] = rec
+            for rec in entry.get("records", []):
+                if rec.get("kind") != 0:
+                    continue
+                tid = f"key {rec['key']}"
+                args = {"rid": rec["rid"], "sender": rec["sender"],
+                        "op": rec["op"], "key": rec["key"]}
+                stages = (("recv", rec["t0"], rec["t1"]),
+                          ("queue-wait", rec["t1"], rec["t2"]),
+                          ("fold", rec["t2"], rec["t3"]))
+                for sname, a, b in stages:
+                    if not a or b < a:
+                        continue  # PULLs skip recv; clamp bad stamps
+                    fused.append({
+                        "name": sname, "cat": "server", "ph": "X",
+                        "ts": self._server_us(a, off),
+                        "dur": max((b - a) / 1e3, 0.001),
+                        "pid": pid, "tid": tid, "args": args})
+                rep = replies.pop((rec["rid"], rec["sender"]), None)
+                if rep is not None:
+                    if rep["t0"] >= rec["t3"]:
+                        # parked round: the wait + the aggregate send
+                        fused.append({
+                            "name": "reply", "cat": "server", "ph": "X",
+                            "ts": self._server_us(rec["t3"], off),
+                            "dur": max((rep["t0"] - rec["t3"]) / 1e3,
+                                       0.001),
+                            "pid": pid, "tid": tid, "args": args})
+                    else:
+                        # same-invocation reply (round completed inside
+                        # THIS handler): the send instant sits inside
+                        # the fold span — render a thin marker so the
+                        # reply leg is visible either way
+                        fused.append({
+                            "name": "reply", "cat": "server", "ph": "X",
+                            "ts": self._server_us(rep["t0"], off),
+                            "dur": 0.001,
+                            "pid": pid, "tid": tid, "args": args})
+                # rid flow link: worker span -> this request's first
+                # server stage (Chrome binds flow ends to the slice
+                # enclosing ts on that pid/tid row)
+                wspan = rid_spans.get(rec["rid"])
+                if wspan is not None:
+                    t_anchor = rec["t1"] if not rec["t0"] else rec["t0"]
+                    fused.append({
+                        "name": "rid", "cat": "bps-rid", "ph": "s",
+                        "id": rec["rid"],
+                        "ts": wspan["ts"] + 0.001,
+                        "pid": wspan["pid"], "tid": wspan["tid"]})
+                    fused.append({
+                        "name": "rid", "cat": "bps-rid", "ph": "f",
+                        "bp": "e", "id": rec["rid"],
+                        "ts": self._server_us(t_anchor, off) + 0.001,
+                        "pid": pid, "tid": tid})
+                    flows += 1
+        if not events and not collected:
+            return None
+        out_path = path
+        if out_path is None:
+            out_dir = os.path.join(self._config.trace_dir,
+                                   str(self._config.local_rank))
+            os.makedirs(out_dir, exist_ok=True)
+            out_path = os.path.join(out_dir, "fused.json")
+        else:
+            parent = os.path.dirname(os.path.abspath(out_path))
+            os.makedirs(parent, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump({"traceEvents": fused, "displayTimeUnit": "ms",
+                       "metadata": {"rid_flow_links": flows}}, f)
+        return out_path

@@ -8,6 +8,7 @@ jax initializes its backends, hence module scope here.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -23,6 +24,12 @@ if "xla_cpu_enable_fast_math" not in flags:
     flags = (flags + " --xla_cpu_enable_fast_math=false").strip()
 os.environ["XLA_FLAGS"] = flags
 os.environ.setdefault("BYTEPS_LOG_LEVEL", "WARNING")
+# flight-recorder dumps (fatal wire errors fire them automatically)
+# land in a temp dir, not the checkout — tests that assert on the dump
+# path override this themselves
+os.environ.setdefault(
+    "BYTEPS_FLIGHT_DIR",
+    os.path.join(tempfile.gettempdir(), f"bps-flight-{os.getpid()}"))
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

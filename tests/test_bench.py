@@ -74,6 +74,12 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
             return {"metrics_on_step_ms": 5.1,
                     "metrics_off_step_ms": 5.0,
                     "metrics_overhead_pct": 2.0}, None
+        if name == "trace_ab":
+            return {"trace_on_step_ms": 5.05,
+                    "trace_off_step_ms": 5.0,
+                    "trace_overhead_pct": 1.0,
+                    "trace_server_records": 96,
+                    "trace_rid_links": 24}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8,
@@ -140,6 +146,10 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     assert out["codec_tag_mismatch_rejected"] is True
     assert out["metrics_on_step_ms"] == 5.1
     assert out["metrics_overhead_pct"] == 2.0
+    assert out["trace_on_step_ms"] == 5.05
+    assert out["trace_overhead_pct"] == 1.0
+    assert out["trace_server_records"] == 96
+    assert out["trace_rid_links"] == 24
     assert out["stream_on_step_ms"] == 4.0
     assert out["stream_ttfp_on_ms"] == 0.9
     assert out["wire_fused_step_ms"] == 3.6
@@ -187,6 +197,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"metrics_on_step_ms": 5.1,
                     "metrics_off_step_ms": 5.0,
                     "metrics_overhead_pct": 2.0}, None
+        if name == "trace_ab":
+            return {"trace_on_step_ms": 5.05,
+                    "trace_off_step_ms": 5.0,
+                    "trace_overhead_pct": 1.0}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8}, None
@@ -228,15 +242,15 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 12 CPU phases + finals
-    assert calls.count("probe") == 13 + n_final
+    # start + one attempt after each of the 13 CPU phases + finals
+    assert calls.count("probe") == 14 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
         "after_churn_ab", "after_codec_adapt_ab", "after_fold_ab",
         "after_pushpull", "after_pushpull_2srv",
-        "after_arena_ab", "after_metrics_ab", "after_stream_ab",
-        "after_wire_ab", "after_shard_ab",
+        "after_arena_ab", "after_metrics_ab", "after_trace_ab",
+        "after_stream_ab", "after_wire_ab", "after_shard_ab",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     # the wedged stage and its traceback ride every diag entry — a dead
     # round is attributable from BENCH_rNN.json alone
@@ -389,8 +403,8 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "churn_ab",
                             "codec_adapt_ab", "fold_ab", "arena_ab",
-                            "metrics_ab", "stream_ab", "wire_ab",
-                            "shard_ab", "scaling"}
+                            "metrics_ab", "trace_ab", "stream_ab",
+                            "wire_ab", "shard_ab", "scaling"}
 
 
 def test_multichip_envelope_bounded():

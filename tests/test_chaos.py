@@ -379,7 +379,7 @@ def test_dropped_replies_retry_bitwise_identical():
 
 
 @pytest.mark.chaos
-def test_server_churn_failover_numerics():
+def test_server_churn_failover_numerics(tmp_path):
     """Acceptance churn test: with 2 loopback server PROCESSES, SIGKILL
     one mid-run. The run completes without restart, every round's
     aggregate matches the no-churn expectation bitwise (1 worker: the
@@ -485,6 +485,42 @@ def test_server_churn_failover_numerics():
             for p in ctx.partitions:
                 assert p.server != victim
 
+        # flight recorder captured the failover CAUSALLY (PR 12): the
+        # worker ring holds retry -> failover -> per-key migration
+        # events in timestamp order, key-matched to the routing table
+        from byteps_tpu.core import flight as flight_mod
+        evs = flight_mod.get_recorder().events()
+        kinds = [e["kind"] for e in evs]
+        assert "wire_retry" in kinds, kinds
+        assert "server_failover" in kinds, kinds
+        assert "key_migration" in kinds, kinds
+        ts = [e["ts_ns"] for e in evs]
+        assert ts == sorted(ts), "flight events out of causal order"
+        fo = next(e for e in evs if e["kind"] == "server_failover")
+        first_retry = next(e["ts_ns"] for e in evs
+                           if e["kind"] == "wire_retry")
+        assert fo["ts_ns"] >= first_retry, \
+            "failover recorded before the retry that triggered it"
+        assert fo["key"] == victim  # failover names the dead server
+        migrated_keys = {e["key"] for e in evs
+                         if e["kind"] == "key_migration"}
+        assert migrated_keys, "no per-key migration events"
+        live_keys = {p.key for ctx in state.registry.contexts_in_order()
+                     for p in ctx.partitions}
+        assert migrated_keys <= live_keys, \
+            "migration events name keys the registry does not know"
+        # and the merged dump (worker + surviving server) is written,
+        # valid JSON, and stays causally ordered after clock alignment
+        import json as _json
+        dump_path = bps.dump_flight_record(
+            str(tmp_path / "churn-flight.json"))
+        assert dump_path and os.path.exists(dump_path)
+        with open(dump_path) as f:
+            doc = _json.load(f)
+        merged_ts = [e["ts_ns"] for e in doc["merged"]]
+        assert merged_ts == sorted(merged_ts)
+        assert any(e["kind"] == "server_failover" for e in doc["merged"])
+
         # zero leaks: handles cleared, no busy arena slots (poll
         # briefly — the completion-ordered drain releases leases at the
         # next checkout boundary)
@@ -519,11 +555,13 @@ def test_server_churn_failover_numerics():
 
 
 @pytest.mark.chaos
-def test_dead_fleet_fails_fast():
+def test_dead_fleet_fails_fast(tmp_path):
     """Permanently-dead fleet: every server gone -> a submit fails with
     a clear bounded error well inside the retry x backoff budget — no
     hang (the fail-fast guard riding alongside
-    test_failure_detection.py's worker-death semantics)."""
+    test_failure_detection.py's worker-death semantics). The error
+    additionally POINTS AT the flight-record dump (PR 12): the operator
+    starts from the causal timeline, not log archaeology."""
     from byteps_tpu.core.state import GlobalState
     from byteps_tpu.utils.net import free_port
 
@@ -533,6 +571,7 @@ def test_dead_fleet_fails_fast():
         "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
         "BYTEPS_FORCE_DISTRIBUTED": "1",
         "BYTEPS_WIRE_RETRY": "2", "BYTEPS_WIRE_BACKOFF_MS": "25",
+        "BYTEPS_FLIGHT_DIR": str(tmp_path / "flight"),
     }
     saved = {k: os.environ.get(k) for k in env_keys}
     os.environ.update(env_keys)
@@ -561,6 +600,17 @@ def test_dead_fleet_fails_fast():
         msg = str(ei.value)
         assert ("attempts" in msg or "fleet is gone" in msg
                 or "dead" in msg), msg
+        # the fail-fast error names the flight dump, and the dump holds
+        # the retry trail that led to the verdict
+        assert "flight record dumped to" in msg, msg
+        dump_path = msg.rsplit("flight record dumped to ", 1)[1].strip()
+        assert os.path.exists(dump_path), dump_path
+        import json as _json
+        with open(dump_path) as f:
+            doc = _json.load(f)
+        kinds = [e["kind"] for e in doc["worker"]["events"]]
+        assert "wire_retry" in kinds, kinds
+        assert "round_failed" in kinds, kinds
     finally:
         try:
             if bps is not None:

@@ -165,6 +165,146 @@ def test_wire_layout_suppression(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# wire-layout: slot/record-layout manifests (the _STAT_SLOTS contract,
+# machine-checked instead of comment-enforced) + control-op ids
+# --------------------------------------------------------------------- #
+
+_CC_SLOTS = _CC_GOOD + """
+    static const char* const kStatSlotNames[] = {
+        "recv_ns", "recv_count", "fold_ns"};
+    enum Op : uint8_t {
+      PUSH = 2,
+      STATS_PULL = 12,
+      TRACE_DRAIN = 13,
+    };
+    enum CtrlLimits : uint32_t {
+      kCtrlDrainBatch = 1024,
+    };
+    #pragma pack(push, 1)
+    struct TraceRec {
+      uint64_t key;
+      uint64_t t0;
+      uint32_t rid;
+      uint16_t sender;
+      uint8_t op;
+      uint8_t kind;
+    };
+    #pragma pack(pop)
+    static_assert(sizeof(TraceRec) == 24, "trace record layout");
+    static const char* const kTraceRecFields[] = {
+        "key", "t0", "rid", "sender", "op", "kind"};
+"""
+
+_PY_SLOTS = _PY_MIRROR_GOOD + """
+    _STAT_SLOTS = ("recv_ns", "recv_count", "fold_ns")
+    TRACE_REC_FMT = "<QQIHBB"
+    _TRACE_REC_FIELDS = ("key", "t0", "rid", "sender", "op", "kind")
+    WIRE_CTRL_OPS = {"STATS_PULL": 12, "TRACE_DRAIN": 13}
+    WIRE_CTRL_LIMITS = {"kCtrlDrainBatch": 1024}
+"""
+
+
+def test_slot_layout_clean_fixture(tmp_path):
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS,
+        "server/client.py": _PY_SLOTS,
+    })
+    assert run_lint(root, ["wire-layout"]) == []
+
+
+def test_slot_layout_renamed_slot(tmp_path):
+    # the historical class: a slot renamed/retyped native-side with the
+    # Python mirror (which PARSES the wire vector) left behind
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS.replace('"fold_ns"', '"fold_bytes"'),
+        "server/client.py": _PY_SLOTS,
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "slot 2" in findings[0].message
+    assert "fold_ns" in findings[0].message
+    assert "fold_bytes" in findings[0].message
+
+
+def test_slot_layout_truncated_mirror(tmp_path):
+    # native appended a slot, mirror not extended: append-only violated
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS.replace(
+            '"fold_ns"};', '"fold_ns", "fold_bytes"};'),
+        "server/client.py": _PY_SLOTS,
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "3 vs 4 slots" in findings[0].message
+
+
+def test_slot_layout_reordered_mirror_fails_both_directions(tmp_path):
+    # a REORDER is a violation even with identical membership (the
+    # vector is positional), and the missing-native direction fires too
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS,
+        "server/client.py": _PY_SLOTS.replace(
+            '("recv_ns", "recv_count", "fold_ns")',
+            '("recv_count", "recv_ns", "fold_ns")'),
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1 and "slot 0" in findings[0].message
+    # native manifest without any Python mirror: loud, never vacuous
+    root2 = _write_tree(tmp_path / "two", {
+        "native/ps.cc": _CC_SLOTS,
+        "server/client.py": _PY_SLOTS.replace(
+            '_STAT_SLOTS = ("recv_ns", "recv_count", "fold_ns")', ""),
+    })
+    findings = run_lint(root2, ["wire-layout"])
+    assert any("_STAT_SLOTS" in f.message and "mirror" in f.message
+               for f in findings)
+
+
+def test_trace_rec_fmt_size_skew(tmp_path):
+    # the record struct grew native-side; the struct-format mirror that
+    # PARSES the drained ring bytes still packs the old size
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS.replace(
+            "uint64_t t0;", "uint64_t t0;\n      uint64_t t1;").replace(
+            "sizeof(TraceRec) == 24", "sizeof(TraceRec) == 32").replace(
+            '"key", "t0",', '"key", "t0", "t1",'),
+        "server/client.py": _PY_SLOTS.replace(
+            '_TRACE_REC_FIELDS = ("key", "t0",',
+            '_TRACE_REC_FIELDS = ("key", "t0", "t1",'),
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "TRACE_REC_FMT packs 24" in findings[0].message
+    assert "32" in findings[0].message
+
+
+def test_ctrl_op_id_skew(tmp_path):
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS,
+        "server/client.py": _PY_SLOTS.replace(
+            '"TRACE_DRAIN": 13', '"TRACE_DRAIN": 14'),
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "TRACE_DRAIN" in findings[0].message
+    assert "unknown op" in findings[0].message
+
+
+def test_ctrl_limit_skew(tmp_path):
+    # the server grew its drain batch; the client buffer mirror would
+    # under-size and replies would drain silently empty
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_SLOTS.replace("kCtrlDrainBatch = 1024",
+                                          "kCtrlDrainBatch = 4096"),
+        "server/client.py": _PY_SLOTS,
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "kCtrlDrainBatch" in findings[0].message
+    assert "silently empty" in findings[0].message
+
+
+# --------------------------------------------------------------------- #
 # guarded-by
 # --------------------------------------------------------------------- #
 

@@ -434,9 +434,32 @@ retries = int(snap["counters"].get("wire/retries", 0))
 oob = int(snap["server"]["oob_msgs"])
 assert retries > 0, "chaos produced no retries - knob dead?"
 assert oob > 0, "no descriptor-tier traffic - shm fast path not engaged?"
+# flight recorder (PR 12): the server ring holds the chaos injections,
+# the worker ring the retries they forced — key-matched and in causal
+# order (server thread shares this process's steady clock, so the
+# timestamps compare directly: a drop must precede some retry)
+from byteps_tpu.core import flight as flight_mod
+from byteps_tpu.core.state import get_state
+state = get_state()
+drops = [e for e in state.ps_client.drain_flight(0)
+         if e["kind"] == "chaos_drop"]
+assert drops, "server flight ring recorded no chaos_drop events"
+wevs = flight_mod.get_recorder().events()
+retry_evs = [e for e in wevs if e["kind"] == "wire_retry"]
+assert retry_evs, "worker flight ring recorded no wire_retry events"
+wts = [e["ts_ns"] for e in wevs]
+assert wts == sorted(wts), "worker flight events out of causal order"
+assert min(d["ts_ns"] for d in drops) < max(r["ts_ns"] for r in retry_evs), \
+    "no chaos drop precedes any retry - causality broken?"
+# rid/key-matched: the dropped replies name partition keys the worker
+# actually retried
+drop_keys = {d["key"] for d in drops if d["key"]}
+retry_keys = {r["key"] for r in retry_evs}
+assert drop_keys & retry_keys, (drop_keys, retry_keys)
 bps.shutdown()
 server.join(timeout=15)
-print("SHM_CHAOS_OK retries=", retries, "oob=", oob)
+print("SHM_CHAOS_OK retries=", retries, "oob=", oob,
+      "drops=", len(drops), "flight_retries=", len(retry_evs))
 """
 
 
