@@ -696,6 +696,113 @@ def phase_churn_ab(n_tensors: int = 6, elems: int = 4096,
                                               and clean_retries == 0)}
 
 
+def phase_scaleup_ab(n_tensors: int = 8, elems: int = 1 << 20,
+                     rounds: int = 5,
+                     throttle_mbps: float = 300.0) -> dict:
+    """Elastic scale-up churn bench (docs/fault-tolerance.md
+    "Elasticity"): run a deterministic push_pull schedule against ONE
+    throttled loopback server, then start a SECOND server process-less
+    (thread) mid-run, `bps.add_server` it into the live fleet, and keep
+    training without restart. Evidence:
+
+    - HARD counter proof the join engaged: ``registry/joins`` == 1 and
+      the newcomer holds key bytes (``registry.server_loads()[1]`` > 0);
+    - bitwise aggregate parity THROUGH the join (1 worker: every round's
+      aggregate equals the pushed tensor — a re-homed key that lost or
+      double-folded a round would read wrong);
+    - per-step wall steps DOWN after the join: both servers read the
+      same ``BYTEPS_SERVER_THROTTLE_MBPS`` cap, so the fleet's
+      aggregate bandwidth doubles and the wire-bound step wall must
+      drop measurably.
+    """
+    _force_cpu()
+    import statistics
+    import threading as _threading
+
+    import numpy as np
+
+    from byteps_tpu.config import Config
+    from byteps_tpu.server import run_server
+    from byteps_tpu.utils.net import free_port, wait_port
+
+    # scoped throttle BEFORE any server constructs (read per Server
+    # instance, so BOTH the initial and the runtime-joined server are
+    # capped — the before/after wall ratio measures fleet size, not a
+    # faster second server); _loopback_ps owns the rest of the
+    # scaffolding (env, rendezvous, teardown, --trace-dir artifacts)
+    prior = os.environ.get("BYTEPS_SERVER_THROTTLE_MBPS")
+    os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle_mbps)
+    server2 = None
+    try:
+        with _loopback_ps(1) as bps:
+            from byteps_tpu.core.state import get_state
+            state = get_state()
+            rng = np.random.RandomState(5)
+            grads = [rng.randn(elems).astype(np.float32)
+                     for _ in range(n_tensors)]
+
+            identical = True
+
+            def run_round(r):
+                nonlocal identical
+                t0 = time.perf_counter()
+                hs = [bps.push_pull_async(g * (r + 1), f"su_g{i}",
+                                          average=False)
+                      for i, g in enumerate(grads)]
+                outs = [np.array(bps.synchronize(h, timeout=180))
+                        for h in hs]
+                dt = (time.perf_counter() - t0) * 1e3
+                for g, o in zip(grads, outs):
+                    if not np.array_equal(o, g * (r + 1)):
+                        identical = False
+                return dt
+
+            run_round(0)  # warmup: declare + init barrier, untimed
+            before = [run_round(1 + r) for r in range(rounds)]
+
+            # the scale-up: a server started at RUNTIME joins the fleet
+            port2 = free_port()
+            server2 = _threading.Thread(
+                target=run_server,
+                args=(port2, Config(num_workers=1, num_servers=1)),
+                daemon=True)
+            server2.start()
+            wait_port(port2)
+            new_idx = bps.add_server(f"127.0.0.1:{port2}")
+            run_round(1 + rounds)  # warmup: seed the newcomer's stores
+            after = [run_round(2 + rounds + r) for r in range(rounds)]
+
+            snap = bps.get_metrics()
+            joins = int(snap["counters"].get("registry/joins", 0))
+            newcomer_bytes = state.registry.server_loads()[new_idx]
+            before_ms = statistics.median(before)
+            after_ms = statistics.median(after)
+            return {
+                "scaleup_before_step_ms": round(before_ms, 2),
+                "scaleup_after_step_ms": round(after_ms, 2),
+                "scaleup_ratio": round(after_ms / before_ms, 4)
+                if before_ms else None,
+                "scaleup_joins": joins,
+                "scaleup_newcomer_bytes": int(newcomer_bytes),
+                "scaleup_identical": bool(identical),
+                # the headline proof bit: the join engaged (counter +
+                # key residency), numerics held bitwise, and the wall
+                # stepped down
+                "scaleup_proof": bool(identical and joins == 1
+                                      and newcomer_bytes > 0
+                                      and after_ms < before_ms),
+            }
+    finally:
+        # the joined server got its SHUTDOWN from _loopback_ps's
+        # teardown (the client sends one to every connected server)
+        if server2 is not None:
+            server2.join(timeout=20)
+        if prior is None:
+            os.environ.pop("BYTEPS_SERVER_THROTTLE_MBPS", None)
+        else:
+            os.environ["BYTEPS_SERVER_THROTTLE_MBPS"] = prior
+
+
 def _codec_train_run(bps, steps: int, layers: int = 4):
     """One deterministic PS train run for the codec-plane A/B: mixed
     4MB + bias leaves through make_ps_train_step, returning (params,
@@ -1712,6 +1819,7 @@ _PHASES = {
     "pushpull_2srv": phase_pushpull_2srv,
     "pushpull_throttled": phase_pushpull_throttled,
     "churn_ab": phase_churn_ab,
+    "scaleup_ab": phase_scaleup_ab,
     "codec_adapt_ab": phase_codec_adapt_ab,
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
@@ -1865,6 +1973,13 @@ def main() -> None:
         "churn_ab_chaos_retries": None,
         "churn_ab_clean_retries": None,
         "churn_ab_idempotent_proof": None,
+        "scaleup_before_step_ms": None,
+        "scaleup_after_step_ms": None,
+        "scaleup_ratio": None,
+        "scaleup_joins": None,
+        "scaleup_newcomer_bytes": None,
+        "scaleup_identical": None,
+        "scaleup_proof": None,
         "codec_adapt_throttled_switches": None,
         "codec_adapt_unthrottled_switches": None,
         "codec_adapt_wire_reduction": None,
@@ -2027,6 +2142,13 @@ def main() -> None:
                             # epoch-dedup'd retries vs clean, bitwise
                             # equality + retry-counter proof
                             ("churn_ab", 240.0),
+                            # elastic scale-up churn: add a server
+                            # MID-RUN (runtime join + version-fenced
+                            # rebalance), bitwise parity through the
+                            # join, wall steps down, counter-proven key
+                            # residency on the newcomer — in the
+                            # runs-first group (new driver key)
+                            ("scaleup_ab", 240.0),
                             # adaptive-codec A/B: ladder escalation
                             # under throttle (switch + wire-byte counter
                             # proof), zero switches unthrottled,

@@ -65,6 +65,47 @@ def resume(num_workers: int, num_servers: int,
     get_state().resume(num_workers, num_servers, global_rank)
 
 
+def add_server(address: Optional[str] = None) -> int:
+    """Elastic scale-up join (docs/fault-tolerance.md "Elasticity"):
+    bring a server STARTED AT RUNTIME into the live fleet — native
+    connect, JOIN_PROBE handshake, then a deterministic version-fenced
+    rebalance moves key subranges onto it and re-routes this worker
+    without restart. ``address`` defaults to the consecutive-port
+    convention (``scheduler_uri:scheduler_port + index``). Returns the
+    new server index. Call from the training thread between rounds
+    (multi-worker fleets: every worker must join the same server at the
+    same round boundary — the plans are deterministic, so no further
+    coordination is needed)."""
+    from .core import elastic
+    return elastic.join_server(get_state(), address)
+
+
+def drain_server(server: int) -> list:
+    """Graceful elastic scale-down: quiesce ``server``'s keys, migrate
+    them to the survivors through the same plan engine crash-migration
+    uses, retire it from assignment, and collect its drain ACK. Returns
+    the migrated keys. The server process itself is left running (it
+    holds nothing afterwards) — stop it at leisure."""
+    from .core import elastic
+    return elastic.drain_server(get_state(), server)
+
+
+def set_server_spawn_hook(fn) -> None:
+    """Register the autoscaler's ``add`` actuator: ``fn(index) ->
+    "host:port"`` must start a PS server (same num_workers as the
+    fleet) and return its address — or None to decline. Only consulted
+    in ``BYTEPS_AUTOSCALE=act`` mode (read at decision time, so the
+    registration order vs init doesn't matter); survives re-init."""
+    get_state().server_spawn_hook = fn
+
+
+def get_autoscaler():
+    """The live autoscaler plane (None unless BYTEPS_AUTOSCALE is on):
+    ``decisions()`` lists every non-hold decision, ``tick()`` drives
+    the loop explicitly for eager (non-train-step) workloads."""
+    return get_state().autoscaler
+
+
 def rank() -> int:
     return get_state().rank()
 

@@ -185,6 +185,24 @@ class Config:
 
     # --- async / elastic (server.cc:434-436) ---
     enable_async: bool = False            # BYTEPS_ENABLE_ASYNC
+    # Sensor-driven autoscaler control loop (core/autoscaler.py,
+    # docs/fault-tolerance.md "Elasticity"): "" = off, "advise" (or any
+    # truthy value) = decisions surface via metrics + flight events
+    # only, "act" = evict/drain decisions apply through core/elastic.py
+    # and add decisions call the registered spawn hook (single-worker
+    # topologies only — multi-worker fleets force advisory mode, an
+    # external operator applies decisions fleet-wide). Tuning knobs
+    # (BYTEPS_AUTOSCALE_{UP_STEPS,DOWN_STEPS,EVICT_FACTOR,EVICT_STEPS,
+    # COOLDOWN,MIN_SERVERS,MAX_SERVERS}) are read by the plane itself.
+    autoscale: str = ""                   # BYTEPS_AUTOSCALE
+    # Server indices retired from assignment (drained/evicted/abandoned
+    # joins) — exported by core/elastic.py so the retirement SURVIVES a
+    # suspend/resume: the native conn table and the positional host
+    # list cannot shrink, and a resume that resurrected a drained slot
+    # would route keys to a server the operator may have stopped.
+    # Comma-separated indices; cleared by the operator when composing a
+    # genuinely fresh topology.
+    retired_servers: tuple = ()           # BYTEPS_RETIRED_SERVERS
 
     # --- server (server.cc:412-456) ---
     server_engine_threads: int = 4        # BYTEPS_SERVER_ENGINE_THREAD
@@ -275,6 +293,11 @@ class Config:
             wire_backoff_ms=float(
                 _env_str("BYTEPS_WIRE_BACKOFF_MS", "50")),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            autoscale=_env_str("BYTEPS_AUTOSCALE", "").strip().lower(),
+            retired_servers=tuple(
+                int(tok) for tok in
+                _env_str("BYTEPS_RETIRED_SERVERS", "").split(",")
+                if tok.strip()),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

@@ -1,0 +1,456 @@
+"""Sensor-driven autoscaler control loop for the elastic PS fleet
+(`BYTEPS_AUTOSCALE`, default off; docs/fault-tolerance.md "Elasticity").
+
+PR 12 delivered exactly the sensor set this loop needs — StepReport
+``server_attribution`` splitting a PULL-bound step into queue-wait /
+fold / wire per server (STATS_PULL fleet metrics), ``wire/inflight`` —
+and PR 9 proved the policy shape in-tree (a clockless hysteresis
+controller whose decisions are a pure function of its signal sequence,
+"Adaptive Methods and System", arXiv 2105.07829). This module closes
+the loop for FLEET SIZE the way the codec plane closed it for the wire
+codec:
+
+- ``AutoscaleController`` — pure and deterministic: no wall clock, no
+  RNG, no global state. Fed one ``FleetSample`` per step it walks three
+  hysteresis ladders: ``add`` after ``up_steps`` consecutive PULL-bound
+  steps (wire dominates compute by ``pull_ratio``), ``drain`` after
+  ``down_steps`` consecutive idle steps (wire under ``idle_ratio`` of
+  compute), and ``evict`` when one server's queue-wait+reply share
+  exceeds the fleet median by ``evict_factor`` for ``evict_steps``
+  consecutive steps — the gray failure (slow-but-alive straggler) the
+  reference's operator-coordinated suspend/resume never catches
+  automatically. A ``cooldown`` after every decision prevents flapping.
+  Identical sample sequences ⇒ identical decision sequences
+  (two-stack test, like the codec controller's).
+- ``AutoscalerPlane`` — the glue: builds each step's sample from the
+  StepReport + per-server stage-counter deltas (in-process mirror or
+  STATS_PULL, breaker-bounded like every other fleet sweep), feeds the
+  controller, and surfaces every decision as the ``autoscale/decisions``
+  counter + an ``autoscale_decision`` flight event. In ``act`` mode
+  (single-worker topologies only) evict/drain decisions apply through
+  ``core/elastic.py`` from the step-boundary observer — the train
+  thread, honoring the elastic thread contract — and ``add`` decisions
+  call the registered spawn hook, then ``join_server``. Multi-worker
+  fleets force advisory mode: per-worker walls differ, so acting
+  locally could diverge routing; an external operator (or a designated
+  coordinator) applies decisions fleet-wide from the advisory stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import log
+from . import flight
+
+# straggler signals below this floor are measurement noise on an idle
+# fleet, not gray failure — never evict over sub-millisecond deltas
+_EVICT_FLOOR_MS = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSample:
+    """One step boundary's deterministic controller input. Stage values
+    are milliseconds accrued DURING the step (counter deltas)."""
+
+    step: int
+    compute_ms: float = 0.0
+    pull_ms: float = 0.0
+    inflight_peak: int = 0
+    # per-ALIVE-server straggler signal: PER-REQUEST queue-wait + reply
+    # ms over the window (load-independent; see _straggler_signal)
+    per_server: Dict[int, float] = dataclasses.field(default_factory=dict)
+    num_alive: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    step: int
+    action: str              # "add" | "drain" | "evict" | "hold"
+    server: Optional[int]    # evict: the straggler; drain/add: None
+    reason: str
+
+    @property
+    def hold(self) -> bool:
+        return self.action == "hold"
+
+
+def _median(values: List[float]) -> float:
+    s = sorted(values)
+    return s[(len(s) - 1) // 2] if s else 0.0
+
+
+class AutoscaleController:
+    """Pure deterministic fleet-size controller — see module docstring.
+
+    Mutable state is ONLY the hysteresis streaks and the cooldown
+    counter, advanced exclusively by :meth:`observe`; two instances fed
+    identical sample sequences emit identical decision sequences."""
+
+    def __init__(self, up_steps: int = 3, down_steps: int = 12,
+                 pull_ratio: float = 1.5, idle_ratio: float = 0.2,
+                 evict_factor: float = 4.0, evict_steps: int = 3,
+                 cooldown: int = 10, min_servers: int = 1,
+                 max_servers: int = 64):
+        self.up_steps = max(1, int(up_steps))
+        self.down_steps = max(1, int(down_steps))
+        self.pull_ratio = float(pull_ratio)
+        self.idle_ratio = float(idle_ratio)
+        self.evict_factor = max(1.0, float(evict_factor))
+        self.evict_steps = max(1, int(evict_steps))
+        self.cooldown = max(0, int(cooldown))
+        self.min_servers = max(1, int(min_servers))
+        self.max_servers = max(self.min_servers, int(max_servers))
+        self._up_streak = 0
+        self._down_streak = 0
+        self._evict_streaks: Dict[int, int] = {}
+        self._cooldown_left = 0
+
+    # ---- predicates (pure) ------------------------------------------- #
+
+    def pull_bound(self, s: FleetSample) -> bool:
+        """Escalation predicate (same shape as the codec controller's):
+        the wire must DOMINATE compute by the configured ratio — a
+        1.01x verdict must not grow the fleet."""
+        return s.pull_ms > self.pull_ratio * max(s.compute_ms, 1e-9)
+
+    def idle(self, s: FleetSample) -> bool:
+        return (s.compute_ms > 0.0
+                and s.pull_ms < self.idle_ratio * s.compute_ms)
+
+    def straggler(self, s: FleetSample) -> Optional[int]:
+        """The gray-failure detector: a server whose PER-REQUEST
+        queue-wait+reply latency exceeds the fleet median by
+        ``evict_factor`` (and the noise floor) — per-request, so a
+        healthy server that merely carries more load never reads as
+        gray-failed. Deterministic: highest signal wins, lowest index
+        breaks ties. None when no server crosses the bar this step."""
+        if len(s.per_server) < 2:
+            return None  # nothing to compare against (or last survivor)
+        med = _median(list(s.per_server.values()))
+        worst = None
+        for srv in sorted(s.per_server):
+            v = s.per_server[srv]
+            if v <= _EVICT_FLOOR_MS or v <= self.evict_factor * med:
+                continue
+            if worst is None or v > s.per_server[worst]:
+                worst = srv
+        return worst
+
+    # ---- the loop ---------------------------------------------------- #
+
+    def observe(self, s: FleetSample) -> Decision:
+        """Advance the streaks with one step's sample and return the
+        decision (``hold`` almost always). Precedence: evict (a gray
+        failure caps the whole fleet regardless of load) > add > drain.
+        Any non-hold decision starts the cooldown and resets every
+        streak — the fleet must re-prove a condition against the NEW
+        topology before the next move."""
+        # per-server eviction streaks advance every step, cooldown or
+        # not (a straggler does not stop being slow while we cool down)
+        bad = self.straggler(s)
+        for srv in list(self._evict_streaks):
+            if srv != bad:
+                self._evict_streaks.pop(srv)
+        if bad is not None:
+            self._evict_streaks[bad] = self._evict_streaks.get(bad, 0) + 1
+        if self.pull_bound(s):
+            self._up_streak += 1
+            self._down_streak = 0
+        elif self.idle(s):
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return Decision(s.step, "hold", None, "cooldown")
+        if (bad is not None
+                and self._evict_streaks.get(bad, 0) >= self.evict_steps
+                and s.num_alive > self.min_servers):
+            self._fire()
+            return Decision(
+                s.step, "evict", bad,
+                f"server {bad} queue+reply {s.per_server[bad]:.1f}ms > "
+                f"{self.evict_factor:g}x fleet median for "
+                f"{self.evict_steps} steps")
+        if (self._up_streak >= self.up_steps
+                and s.num_alive < self.max_servers):
+            self._fire()
+            return Decision(
+                s.step, "add", None,
+                f"PULL-bound {self.up_steps} consecutive steps "
+                f"(pull {s.pull_ms:.1f}ms vs compute "
+                f"{s.compute_ms:.1f}ms)")
+        if (self._down_streak >= self.down_steps
+                and s.num_alive > self.min_servers):
+            self._fire()
+            return Decision(
+                s.step, "drain", None,
+                f"idle {self.down_steps} consecutive steps "
+                f"(pull {s.pull_ms:.1f}ms vs compute "
+                f"{s.compute_ms:.1f}ms)")
+        return Decision(s.step, "hold", None, "")
+
+    def _fire(self) -> None:
+        self._cooldown_left = self.cooldown
+        self._up_streak = 0
+        self._down_streak = 0
+        self._evict_streaks.clear()
+
+
+def register_autoscale_metrics(metrics) -> None:
+    """Create the elastic-lifecycle instruments eagerly so the
+    docs/observability.md schema resolves them on every deployment,
+    autoscaled or not (same contract as the wire/retries family)."""
+    metrics.counter("registry/joins")
+    metrics.counter("registry/drains")
+    metrics.counter("autoscale/decisions")
+    metrics.counter("server/evictions")
+
+
+class AutoscalerPlane:
+    """Wires the pure controller to the live fleet — see module
+    docstring. Driven by the StepProfiler's step-boundary observer
+    (train thread) or explicitly via :meth:`tick`."""
+
+    def __init__(self, state, mode: str = "advise"):
+        def env(name, default):
+            return os.environ.get(name, default)
+
+        self._state = state
+        self._acting = mode == "act"
+        if self._acting and max(1, state.config.num_workers) > 1:
+            log.warning(
+                "autoscaler: BYTEPS_AUTOSCALE=act with %d workers — "
+                "forcing advisory mode (a locally-acting controller "
+                "would diverge routing across workers; apply decisions "
+                "fleet-wide from the advisory stream instead)",
+                state.config.num_workers)
+            self._acting = False
+        self.controller = AutoscaleController(
+            up_steps=int(env("BYTEPS_AUTOSCALE_UP_STEPS", "3")),
+            down_steps=int(env("BYTEPS_AUTOSCALE_DOWN_STEPS", "12")),
+            pull_ratio=float(env("BYTEPS_AUTOSCALE_PULL_RATIO", "1.5")),
+            idle_ratio=float(env("BYTEPS_AUTOSCALE_IDLE_RATIO", "0.2")),
+            evict_factor=float(env("BYTEPS_AUTOSCALE_EVICT_FACTOR",
+                                   "4.0")),
+            evict_steps=int(env("BYTEPS_AUTOSCALE_EVICT_STEPS", "3")),
+            cooldown=int(env("BYTEPS_AUTOSCALE_COOLDOWN", "10")),
+            min_servers=int(env("BYTEPS_AUTOSCALE_MIN_SERVERS", "1")),
+            max_servers=int(env("BYTEPS_AUTOSCALE_MAX_SERVERS", "64")))
+        self._mu = threading.Lock()
+        self._base: Dict[int, Dict[str, int]] = {}  # guarded-by: _mu
+        self._decisions: List[Decision] = []        # guarded-by: _mu
+        self._sweep_tripped = False                 # guarded-by: _mu
+        self._metrics = state.metrics
+        if self._metrics is not None:
+            register_autoscale_metrics(self._metrics)
+            self._m_decisions = self._metrics.counter(
+                "autoscale/decisions")
+        else:
+            self._m_decisions = None
+
+    # ---- sensors ----------------------------------------------------- #
+
+    def _sweep_per_server(self) -> Dict[int, Dict[str, int]]:
+        """Raw per-server stage counters for every ALIVE server, over
+        STATS_PULL — the wire sweep is INDEX-ACCURATE (each pull names
+        its server), where the in-process mirror only knows
+        registration order (a leaked server from an earlier lifecycle
+        in the same process would shift every index and misattribute
+        the straggler — found the hard way in the full-suite run). The
+        mirror remains the fallback when no fleet-capable client is
+        connected. Bounded like every fleet sweep (1s per pull,
+        one-way breaker at 2.5s) — the control loop must never become
+        the stall it watches.
+
+        Known cost, accepted for the opt-in autoscaler: on a REMOTE
+        fleet this is a second per-step wire sweep on top of the
+        StepProfiler's fleet-sum probe (which only needs totals and
+        discards per-server readings). Folding the two into one sweep
+        means teaching the profiler probe to retain per-server
+        readings — the right follow-on if a large fleet ever makes two
+        bounded sweeps per step measurable."""
+        from ..server import per_server_stats
+        state = self._state
+        registry = state.registry
+        alive = registry.alive_servers() if registry is not None \
+            else list(range(max(1, state.config.num_servers)))
+        with self._mu:
+            tripped = self._sweep_tripped
+        client = state.ps_client
+        if not tripped and client is not None \
+                and getattr(client, "supports_fleet", False):
+            out: Dict[int, Dict[str, int]] = {}
+            t0 = time.monotonic()
+            for s in alive:
+                try:
+                    raw = client.server_stats(s, timeout_s=1)
+                except Exception:  # noqa: BLE001 - dead server: skip
+                    raw = None
+                if raw is not None:
+                    out[s] = raw
+            if time.monotonic() - t0 > 2.5:
+                with self._mu:
+                    self._sweep_tripped = True
+                log.warning(
+                    "autoscaler: per-server sweep exceeded 2.5s — "
+                    "dropping the wire sensor for this lifecycle "
+                    "(eviction detection degrades to the in-process "
+                    "mirror)")
+            if out:
+                return out
+        local = per_server_stats()
+        return {s: local[s] for s in alive if s < len(local)}
+
+    def _straggler_signal(self) -> Dict[int, float]:
+        """PER-REQUEST queue-wait + reply ms accrued since the last
+        tick (counter deltas against the per-server baseline, divided
+        by the requests the server handled in the window). Per-request
+        is the load-independent gray-failure signal: a healthy server
+        that simply hosts the hot keys accrues more ABSOLUTE stage
+        time but the same per-request latency — normalizing keeps the
+        detector from evicting the busiest healthy server on skewed
+        traffic. A server seen for the FIRST time contributes no
+        signal this tick — its cumulative-since-boot counters are not
+        a step delta — and the baseline MERGES rather than replaces,
+        so a server that misses one sweep (a 1s stats timeout under
+        load) keeps its baseline instead of having its whole lifetime
+        counted as the next tick's 'delta' (which would evict a
+        healthy server)."""
+        cur = self._sweep_per_server()
+        out: Dict[int, float] = {}
+        with self._mu:
+            base = self._base
+            for s, raw in cur.items():
+                b = base.get(s)
+                if b is not None:
+                    dq = max(0, raw["queue_ns"] - b.get("queue_ns", 0))
+                    dr = max(0, raw["reply_ns"] - b.get("reply_ns", 0))
+                    dn = max(0, raw["queue_count"]
+                             - b.get("queue_count", 0))
+                    # a server with no traffic this window has no
+                    # latency evidence either way: signal 0
+                    out[s] = ((dq + dr) / 1e6 / dn) if dn else 0.0
+            base.update(cur)
+        return out
+
+    def build_sample(self, report=None) -> FleetSample:
+        registry = self._state.registry
+        alive = len(registry.alive_servers()) if registry is not None \
+            else max(1, self._state.config.num_servers)
+        compute = pull = 0.0
+        step = 0
+        if report is not None:
+            step = report.step
+            compute = report.compute_ms or 0.0
+            pull = max(report.pull_p95_ms or 0.0,
+                       report.pull_wait_ms or 0.0)
+        inflight = 0
+        client = self._state.ps_client
+        if client is not None:
+            inflight = getattr(client, "inflight_peak", 0)
+        return FleetSample(step=step, compute_ms=compute, pull_ms=pull,
+                           inflight_peak=inflight,
+                           per_server=self._straggler_signal(),
+                           num_alive=alive)
+
+    # ---- the loop ---------------------------------------------------- #
+
+    def on_step(self, report) -> None:
+        """StepProfiler observer (train thread, once per finished
+        step): build the sample, run the controller, surface/apply."""
+        try:
+            self.tick(report=report)
+        except Exception:  # noqa: BLE001 - the loop must not kill a step
+            log.exception("autoscaler tick failed (step observer)")
+
+    def tick(self, sample: Optional[FleetSample] = None,
+             report=None) -> Decision:
+        if sample is None:
+            sample = self.build_sample(report)
+        d = self.controller.observe(sample)
+        if d.hold:
+            return d
+        with self._mu:
+            self._decisions.append(d)
+        if self._m_decisions is not None:
+            self._m_decisions.inc()
+        flight.record("autoscale_decision",
+                      key=d.server if d.server is not None else 0,
+                      detail=f"step={d.step} action={d.action} "
+                             f"{d.reason}")
+        log.warning("autoscaler: step %d -> %s%s (%s)%s", d.step,
+                    d.action,
+                    f" server {d.server}" if d.server is not None else "",
+                    d.reason,
+                    "" if self._acting else " [advisory]")
+        if self._acting:
+            self._apply(d)
+        return d
+
+    def _apply(self, d: Decision) -> None:
+        from . import elastic
+        state = self._state
+        try:
+            if d.action == "evict" and d.server is not None:
+                elastic.evict_server(state, d.server)
+            elif d.action == "drain":
+                srv = self._least_loaded_alive()
+                if srv is not None:
+                    elastic.drain_server(state, srv)
+            elif d.action == "add":
+                # read the hook off the state AT USE TIME — the one
+                # registration point (bps.set_server_spawn_hook), no
+                # copy to fall stale
+                hook = getattr(state, "server_spawn_hook", None)
+                if hook is None:
+                    log.warning(
+                        "autoscaler: 'add' decided but no spawn hook is "
+                        "registered (bps.set_server_spawn_hook) — "
+                        "decision stays advisory")
+                    return
+                idx = state.config.num_servers
+                address = hook(idx)
+                if address:
+                    elastic.join_server(state, address)
+        except Exception:  # noqa: BLE001 - an apply failure must not
+            log.exception(  # kill training; the decision stays recorded
+                "autoscaler: applying %s failed (fleet unchanged)",
+                d.action)
+
+    def _least_loaded_alive(self) -> Optional[int]:
+        registry = self._state.registry
+        if registry is None:
+            return None
+        alive = registry.alive_servers()
+        if len(alive) < 2:
+            return None
+        loads = registry.server_loads()
+        return min(alive, key=lambda s: (loads[s], s))
+
+    # ---- exposition -------------------------------------------------- #
+
+    def decisions(self) -> List[Decision]:
+        with self._mu:
+            return list(self._decisions)
+
+    def snapshot(self) -> dict:
+        """The ``autoscale`` section of ``bps.get_metrics()``."""
+        with self._mu:
+            ds = list(self._decisions)
+        last = ds[-1] if ds else None
+        return {
+            "mode": "act" if self._acting else "advise",
+            "decisions": len(ds),
+            "last": None if last is None else {
+                "step": last.step, "action": last.action,
+                "server": last.server, "reason": last.reason,
+            },
+        }

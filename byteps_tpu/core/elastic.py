@@ -1,0 +1,283 @@
+"""Elastic fleet mechanics: runtime server scale-up join, graceful
+drain, and straggler eviction (docs/fault-tolerance.md "Elasticity").
+
+PR 6 built the death half of elasticity (bounded retry, replay-epoch
+dedup, deterministic ``migrate_server``); this module is the growth
+half. Both directions ride the registry's ONE version-fenced plan
+engine (``core/registry.py`` ``RebalancePlan``):
+
+- ``join_server`` — connect the worker's native client to a server
+  started at runtime (atomic conn-group publish), run the JOIN_PROBE
+  handshake (worker-count agreement BEFORE any key routes there), and
+  apply a deterministic ``plan_join`` that moves key subranges TO the
+  newcomer — re-routing without restart, with the same replay-epoch /
+  ``routing_version`` machinery crash migration uses. Server-side codec
+  state (COMP_INIT) is replayed onto the newcomer for moved keys.
+- ``drain_server`` — the inverse: quiesce the victim's keys
+  (``scheduler.keys_idle``), apply ``plan_drain`` (move out + retire
+  from assignment), and collect the DRAIN_REQ ACK. Crash migration and
+  drain are one code path exercised from two triggers.
+- ``evict_server`` — drain triggered by the gray-failure detector
+  (core/autoscaler.py): a slow-but-alive server is retired BEFORE it
+  stalls the fleet; counts under ``server/evictions``.
+
+Thread contract: these functions mutate the routing table, so they must
+run from the submitting (train) thread between rounds, or under an
+external quiescence guarantee — the same discipline as ``bps.suspend``.
+The autoscaler's acting mode honors it by applying decisions from the
+step-boundary observer, which runs on the train thread. Multi-worker
+fleets must apply the SAME operation on every worker at the same round
+boundary (the plans are deterministic, so no coordination message is
+needed beyond the trigger itself).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional
+
+from ..utils.logging import log
+from . import flight
+
+# quiescence poll: the moved keys must have no queued / in-flight /
+# backoff-parked task before the routing table mutates under them
+_QUIESCE_TIMEOUT_S = 30.0
+_QUIESCE_POLL_S = 0.02
+
+
+def _quiesce(scheduler, keys: List[int], what: str,
+             timeout_s: float = _QUIESCE_TIMEOUT_S) -> None:
+    if scheduler is None or not keys:
+        return
+    deadline = time.monotonic() + timeout_s
+    while not scheduler.keys_idle(keys):
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"{what}: keys {keys[:8]}... never went quiescent within "
+                f"{timeout_s:.0f}s — call from the training thread "
+                f"between rounds (in-flight rounds must settle before "
+                f"the routing table moves under them)")
+        time.sleep(_QUIESCE_POLL_S)
+
+
+def _replay_codec_state(state, moved_keys: List[int]) -> None:
+    """Moved keys land on stores that were (or will be) freshly
+    init-pushed dense — any server-side codec the adaptive plane had
+    installed died with the old assignment. Mark the affected leaves'
+    plans as not-applied so the plane re-runs COMP_INIT at its next
+    quiescent resolve (PR 9's comp_init replay, the same convergence
+    the crash path gets from ``_prepare_retry``). Explicitly-compressed
+    tensors (CompressedRegistry) self-heal through one retried round:
+    the first compressed push to the fresh store error-replies and the
+    retry re-installs the codec (test-pinned by the churn suite)."""
+    plane = getattr(state, "codec_plane", None)
+    registry = state.registry
+    if plane is None or registry is None or not moved_keys:
+        return
+    moved = set(moved_keys)
+    for name, plan in registry.codec_plans().items():
+        ctx = registry.get(name)
+        if ctx is None or not getattr(plan, "applied", None):
+            continue
+        if plan.applied == "dense":
+            continue
+        if any(p.key in moved for p in ctx.partitions):
+            # applied=None == "server has the dense default": desired !=
+            # applied at the next resolve, so the plane re-installs on
+            # every partition (idempotent for the unmoved ones)
+            plan.applied = None
+
+
+def _export_topology_env(state) -> None:
+    """Keep the env-derived topology in sync with the live fleet so a
+    later suspend/resume (``Config.from_env``) reconnects to the whole
+    grown fleet instead of the init-time prefix — INCLUDING the
+    retired-slot set: the host list is positional and the native conn
+    table cannot shrink, so drained/evicted/abandoned indices must stay
+    masked across the resume (`BYTEPS_RETIRED_SERVERS`) instead of
+    being resurrected into routing."""
+    client = state.ps_client
+    registry = state.registry
+    os.environ["DMLC_NUM_SERVER"] = str(state.config.num_servers)
+    if client is not None:
+        os.environ["BYTEPS_SERVER_HOSTS"] = ",".join(client.servers)
+    retired = registry.dead_servers() if registry is not None else []
+    if retired:
+        os.environ["BYTEPS_RETIRED_SERVERS"] = ",".join(
+            str(s) for s in retired)
+    else:
+        os.environ.pop("BYTEPS_RETIRED_SERVERS", None)
+
+
+def join_server(state, address: Optional[str] = None) -> int:
+    """Scale-up join: bring a runtime-started server into the fleet and
+    move key subranges onto it. Returns the new server index.
+
+    Steps (docs/fault-tolerance.md "Elasticity"): native connect →
+    JOIN_PROBE handshake (worker-count agreement) → registry
+    ``add_server`` + deterministic ``plan_join`` → quiesce the moving
+    keys → version-fenced ``rebalance`` → invalidate the client's init
+    cache for the moved keys (the newcomer's stores are seeded by the
+    next ``ensure_init``) → codec-state replay marks. ``address``
+    defaults to the consecutive-port convention
+    (``scheduler_uri:scheduler_port + index``)."""
+    client = state.ps_client
+    registry = state.registry
+    if client is None or registry is None:
+        raise RuntimeError("join_server: no PS client (init with "
+                           "num_servers > 0 first)")
+    cfg = state.config
+    new_idx = cfg.num_servers
+    if address is None:
+        address = f"{cfg.scheduler_uri}:{cfg.scheduler_port + new_idx}"
+    got = client.add_server(address)
+    if got != new_idx:
+        raise RuntimeError(
+            f"join_server: native client connected {address!r} at index "
+            f"{got}, expected {new_idx} — client/registry server tables "
+            f"have diverged")
+    try:
+        probe = client.join_probe(new_idx)
+        if probe is None:
+            raise RuntimeError(
+                f"join_server: server {new_idx} at {address!r} did not "
+                f"answer the JOIN_PROBE handshake (stale server build?)")
+        want_workers = max(1, cfg.num_workers)
+        if probe["num_workers"] != want_workers:
+            raise RuntimeError(
+                f"join_server: server at {address!r} runs num_workers="
+                f"{probe['num_workers']}, this fleet has {want_workers} "
+                f"— refusing the join (its aggregation rounds would "
+                f"never complete)")
+        if probe["draining"]:
+            raise RuntimeError(
+                f"join_server: server at {address!r} is draining — "
+                f"refusing to route keys to a retiring server")
+    except Exception:
+        # the native conn table cannot shrink — the failed slot must
+        # still be ACCOUNTED FOR or every later join computes an index
+        # the client has already moved past (a one-bad-probe wedge).
+        # Grow registry+config to cover it and retire it unused: no key
+        # ever routes there, and the next join aligns again.
+        abandoned = registry.add_server()
+        registry.retire_server(abandoned)
+        state.config = dataclasses.replace(
+            cfg, num_servers=abandoned + 1)
+        _export_topology_env(state)
+        log.warning(
+            "elastic: join of %s failed after the native connect — "
+            "server index %d retired unused (no rollback on the native "
+            "conn table); future joins realign", address, abandoned)
+        raise
+    ridx = registry.add_server()
+    if ridx != new_idx:
+        raise RuntimeError(
+            f"join_server: registry grew to index {ridx}, client to "
+            f"{new_idx} — server tables have diverged")
+    state.config = dataclasses.replace(cfg, num_servers=new_idx + 1)
+    # the server IS in the fleet from here (connected, probed,
+    # assignable): export the topology NOW, so whatever happens to the
+    # rebalance below, a later suspend/resume reconnects to the real
+    # fleet and a retried operation sees consistent tables
+    _export_topology_env(state)
+    if state.metrics is not None:
+        state.metrics.counter("registry/joins").inc()
+    # plan + quiesce + apply, recomputing on a stale fence: a
+    # concurrent crash failover can bump routing_version while we wait
+    # for quiescence — the refusal is the fence doing its job, and the
+    # fresh table just needs a fresh (deterministic) plan
+    moved: List[int] = []
+    for attempt in range(3):
+        plan = registry.plan_join(new_idx)
+        try:
+            _quiesce(state.scheduler, plan.keys(), "join_server")
+        except TimeoutError as e:
+            # DEGRADED, not broken: the newcomer is live and assignable
+            # (new declarations will land on it); only the re-homing of
+            # existing keys didn't apply. Raise with the state spelled
+            # out instead of leaving the operator guessing.
+            flight.record("server_join", key=new_idx,
+                          detail=f"addr={address} moved_keys=0 "
+                                 f"quiesce_timeout=1")
+            raise RuntimeError(
+                f"join_server: server {new_idx} at {address!r} JOINED "
+                f"(connected, probed, assignable to new keys) but "
+                f"existing keys were not rebalanced onto it — the "
+                f"moving keys never went quiescent: {e}") from e
+        try:
+            moved = registry.rebalance(plan)
+            break
+        except RuntimeError as e:
+            if "stale rebalance plan" not in str(e) or attempt == 2:
+                raise
+            log.info("elastic: join rebalance raced a routing change "
+                     "(%s); recomputing the plan", e)
+    client.invalidate_init(moved)
+    _replay_codec_state(state, moved)
+    _export_topology_env(state)  # retired set may have changed mid-race
+    flight.record("server_join", key=new_idx,
+                  detail=f"addr={address} moved_keys={len(moved)} "
+                         f"routing_version={registry.routing_version}")
+    log.info("elastic: server %d joined at %s; %d key(s) re-homed to it "
+             "(routing_version=%d)", new_idx, address, len(moved),
+             registry.routing_version)
+    return new_idx
+
+
+def drain_server(state, server: int, evict: bool = False) -> List[int]:
+    """Load-driven (or eviction-driven) graceful scale-down: quiesce the
+    server's keys, migrate them to survivors via the SAME plan engine
+    crash migration uses, retire the server from assignment, and
+    collect its DRAIN_REQ ACK. Returns the moved keys.
+
+    The drained server process is NOT terminated here — it holds no
+    routed keys afterwards and may be stopped by the operator / spawn
+    hook at leisure (its later death migrates nothing)."""
+    client = state.ps_client
+    registry = state.registry
+    if client is None or registry is None:
+        raise RuntimeError("drain_server: no PS client")
+    plan = registry.plan_drain(server)
+    _quiesce(state.scheduler, plan.keys(),
+             "evict_server" if evict else "drain_server")
+    moved = registry.rebalance(plan)
+    client.invalidate_init(moved)
+    _replay_codec_state(state, moved)
+    # the retirement must survive a later suspend/resume (the host list
+    # is positional — the slot cannot be dropped, only masked)
+    _export_topology_env(state)
+    # the ACK is best-effort BY DESIGN: a gray-failed server may be too
+    # wedged to answer, and the drain must complete anyway — the keys
+    # are already off it
+    ack = None
+    try:
+        ack = client.drain_req(server, timeout_s=2)
+    except Exception:  # noqa: BLE001 - advisory ACK only
+        ack = None
+    if state.metrics is not None:
+        state.metrics.counter("registry/drains").inc()
+        if evict:
+            state.metrics.counter("server/evictions").inc()
+    kind = "server_evict" if evict else "server_drain"
+    flight.record(kind, key=server,
+                  detail=f"moved_keys={len(moved)} ack={ack is not None} "
+                         f"routing_version={registry.routing_version}")
+    for k in moved:
+        flight.record("key_migration", key=k,
+                      detail=f"from_server={server} trigger="
+                             f"{'evict' if evict else 'drain'}")
+    log.warning(
+        "elastic: server %d %s; %d key(s) migrated to survivors "
+        "(routing_version=%d, drain ack=%s)", server,
+        "evicted (gray failure)" if evict else "drained", len(moved),
+        registry.routing_version, ack)
+    return moved
+
+
+def evict_server(state, server: int) -> List[int]:
+    """Gray-failure eviction: a deterministic detector (core/
+    autoscaler.py) decided this slow-but-alive server is capping the
+    fleet — retire it proactively through the drain path."""
+    return drain_server(state, server, evict=True)

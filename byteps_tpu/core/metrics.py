@@ -494,6 +494,12 @@ class StepProfiler:
         self._reports = collections.deque(maxlen=max(1, window))  # guarded-by: _mu
         self._current: Optional[_StepBuilder] = None  # guarded-by: _mu
         self._step_no = 0                             # guarded-by: _mu
+        # step-boundary observers (the autoscaler plane's sensor tap):
+        # called with each finished StepReport ON THE TRAIN THREAD at
+        # end_step, after the report is in the ring — the one place a
+        # control loop may safely mutate the routing table (the elastic
+        # thread contract, core/elastic.py)
+        self._observers: List = []                    # guarded-by: _mu
 
     def _probe_fleet(self) -> Optional[dict]:
         if self._fleet_probe is None:
@@ -576,6 +582,13 @@ class StepProfiler:
             self._reports.append(r)
             if self._current is b:
                 self._current = None
+            observers = list(self._observers)
+        for fn in observers:
+            try:
+                fn(r)
+            except Exception:  # noqa: BLE001 - observers must not kill
+                from ..utils.logging import log  # the step
+                log.exception("step observer raised")
         if self.stall_diag:
             from ..utils.logging import log
             log.info("step %d [%.1fms] %s", r.step, r.wall_ms,
@@ -593,6 +606,12 @@ class StepProfiler:
                 "push_p95": round(r.push_p95_ms or 0.0, 3),
             })
         return r
+
+    def add_observer(self, fn) -> None:
+        """Register a step-boundary observer: ``fn(report)`` runs on
+        the train thread after every finished step (see _observers)."""
+        with self._mu:
+            self._observers.append(fn)
 
     def reports(self) -> List[StepReport]:
         with self._mu:

@@ -18,6 +18,7 @@ TPU-native re-implementation of the reference's declaration/key machinery:
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Dict, List, Optional
 
@@ -72,6 +73,40 @@ _HASH_FNS = {
 }
 
 
+@dataclasses.dataclass(frozen=True)
+class RebalanceMove:
+    """One partition re-homing: key moves src -> dst."""
+
+    key: int
+    src: int
+    dst: int
+    length: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RebalancePlan:
+    """A version-fenced routing change (the elastic fleet's ONE plan
+    shape — scale-up join, graceful drain and crash migration are the
+    same engine exercised from three triggers,
+    docs/fault-tolerance.md "Elasticity").
+
+    ``base_version``: the routing_version the plan was computed at.
+    ``rebalance`` refuses a plan computed against a stale table — two
+    concurrent planners would otherwise apply moves whose ``src``
+    fields no longer match reality. ``retire``: the plan's server
+    leaves the assignable set after the moves apply (drain/death);
+    joins keep it assignable."""
+
+    kind: str            # "join" | "drain" | "death"
+    server: int          # the joining / draining / dead server index
+    base_version: int
+    moves: tuple         # RebalanceMove, ordered (deterministic)
+    retire: bool = False
+
+    def keys(self) -> List[int]:
+        return [m.key for m in self.moves]
+
+
 class TensorRegistry:
     """Thread-safe name -> TensorContext table with stable key assignment."""
 
@@ -93,7 +128,11 @@ class TensorRegistry:
         # increasing routing version (the migration fence: bumped once
         # per migrate_server call, so routing-table readers can detect
         # "the table changed under me" cheaply)
-        self._dead_servers: set = set()                # guarded-by: _lock
+        # seeded from config.retired_servers: a drained/evicted slot
+        # stays retired across process lifecycles (the env round-trip
+        # core/elastic.py maintains)
+        self._dead_servers: set = set(
+            getattr(config, "retired_servers", ()))  # guarded-by: _lock
         self._routing_version = 0                      # guarded-by: _lock
         # adaptive codec plane: per-leaf plan state (core/codec_plane.py
         # CodecPlan — active ladder rung, plan epoch, hysteresis
@@ -213,9 +252,23 @@ class TensorRegistry:
         with self._lock:
             self._config = new_config
             self._server_load = [0] * max(1, new_config.num_servers)
-            # a resume declares a NEW server topology: server indices
-            # renumber, so the old death verdicts no longer apply
-            self._dead_servers.clear()
+            # a resume declares a NEW server topology: CRASH verdicts no
+            # longer apply (a restarted server may legitimately re-use
+            # its index), but deliberate retirements (drain/evict/
+            # abandoned join — exported as BYTEPS_RETIRED_SERVERS by
+            # core/elastic.py, carried in via the new config) must
+            # survive: the host list is positional and cannot shrink,
+            # and resurrecting a drained slot would route keys to a
+            # server the operator may have stopped
+            self._dead_servers = set(
+                s for s in getattr(new_config, "retired_servers", ())
+                if s < max(1, new_config.num_servers))
+            # the whole routing table is about to be rebuilt against the
+            # new server count — that IS a routing change, and the fence
+            # must advance so any reader caching assignments against the
+            # old version (in-flight plans, elastic controllers)
+            # observes the rebuild instead of trusting a stale table
+            self._routing_version += 1
             for name in self._declaration_order:
                 ctx = self._contexts[name]
                 ctx.initialized = False
@@ -380,19 +433,200 @@ class TensorRegistry:
             return list(self._server_load)
 
     # ------------------------------------------------------------------ #
-    # live key migration (elastic server fleet)
+    # live key migration + elastic rebalance (one plan engine for
+    # scale-up join, graceful drain, and crash migration)
     # ------------------------------------------------------------------ #
 
     @property
     def routing_version(self) -> int:
-        """Monotonic migration fence: bumped once per migrate_server
-        call that moved at least one partition."""
+        """Monotonic routing fence: bumped once per applied routing
+        change (migration, rebalance, elastic redeclare)."""
         with self._lock:
             return self._routing_version
 
     def dead_servers(self) -> List[int]:
+        """Servers masked out of assignment (crashed OR drained)."""
         with self._lock:
             return sorted(self._dead_servers)
+
+    def alive_servers(self) -> List[int]:
+        with self._lock:
+            num = max(1, self._config.num_servers)
+            return [s for s in range(num) if s not in self._dead_servers]
+
+    def add_server(self) -> int:
+        """Grow the server table by one (runtime scale-up join): the new
+        index becomes assignable, with zero accumulated load — the
+        follow-up ``plan_join``/``rebalance`` moves key subranges onto
+        it. Deterministic across workers (pure count bump). Returns the
+        new server index."""
+        with self._lock:
+            idx = self._config.num_servers
+            self._config = dataclasses.replace(
+                self._config, num_servers=idx + 1)
+            while len(self._server_load) < idx + 1:
+                self._server_load.append(0)
+            # a re-used index must not inherit a death verdict from a
+            # previous fleet generation
+            self._dead_servers.discard(idx)
+            return idx
+
+    def retire_server(self, server: int) -> None:
+        """Mask ``server`` out of assignment without moving anything —
+        the abandoned-slot path: a join whose handshake failed AFTER
+        the native client grew its conn table must still account for
+        the index (the native table cannot shrink), so the index
+        retires unused and later joins keep aligning."""
+        with self._lock:
+            self._dead_servers.add(server)
+
+    def _partitions_locked(self):
+        """(name, Partition) in declaration order — THE iteration order
+        every plan is computed in, so independent workers derive
+        identical plans from identical declaration histories."""
+        for name in self._declaration_order:
+            for p in self._contexts[name].partitions:
+                yield name, p
+
+    def _moves_off_locked(self, server: int, alive: List[int],
+                          keys: Optional[set] = None) -> List[RebalanceMove]:
+        """Deterministic move list re-homing every partition of
+        ``server`` (optionally restricted to ``keys``) onto the
+        least-loaded destination in ``alive`` — shared by crash
+        migration and graceful drain (one code path, two triggers).
+        Pure: works on a copy of the load table."""
+        loads = list(self._server_load)
+        moves: List[RebalanceMove] = []
+        for _name, p in self._partitions_locked():
+            if p.server != server:
+                continue
+            if keys is not None and p.key not in keys:
+                continue
+            dst = min(alive, key=lambda s: loads[s])
+            loads[server] -= p.length
+            loads[dst] += p.length
+            moves.append(RebalanceMove(p.key, server, dst, p.length))
+        return moves
+
+    def _apply_moves_locked(self, moves) -> List[int]:
+        """Mutate the routing table per ``moves`` (Partition.server in
+        place, so in-flight retry state re-routes without re-plumbing)
+        and keep the load accounting consistent."""
+        parts = {p.key: p for _n, p in self._partitions_locked()}
+        for m in moves:
+            p = parts.get(m.key)
+            if p is None or p.server != m.src:
+                raise RuntimeError(
+                    f"rebalance plan does not match the routing table: "
+                    f"key {m.key} expected on server {m.src}, found "
+                    f"{'missing' if p is None else p.server} — the plan "
+                    f"was computed against a different table")
+        for m in moves:
+            p = parts[m.key]
+            self._server_load[m.src] -= p.length
+            self._server_load[m.dst] += p.length
+            p.server = m.dst
+        return [m.key for m in moves]
+
+    def plan_join(self, new_server: int) -> RebalancePlan:
+        """Deterministic scale-up plan: move the earliest-declared
+        partitions off the currently most-loaded donors until the
+        newcomer holds its fair share (total/alive bytes). Pure — no
+        mutation; apply with :meth:`rebalance`. Every worker computing
+        this against the same declaration history and load table gets
+        the identical plan (the same no-coordination property
+        ``migrate_server`` has)."""
+        with self._lock:
+            num = max(1, self._config.num_servers)
+            bps_check(0 <= new_server < num,
+                      f"plan_join: server {new_server} out of range "
+                      f"[0, {num})")
+            bps_check(new_server not in self._dead_servers,
+                      f"plan_join: server {new_server} is retired")
+            alive = [s for s in range(num)
+                     if s not in self._dead_servers]
+            loads = list(self._server_load)
+            total = sum(loads[s] for s in alive)
+            target = total // max(1, len(alive))
+            moves: List[RebalanceMove] = []
+            moved: set = set()
+            while loads[new_server] < target:
+                donors = [s for s in alive if s != new_server]
+                if not donors:
+                    break
+                # take from the most-loaded donor (lowest index on
+                # ties), earliest-declared partition first
+                donor = max(donors, key=lambda s: (loads[s], -s))
+                cand = None
+                for _name, p in self._partitions_locked():
+                    if p.server == donor and p.key not in moved:
+                        cand = p
+                        break
+                if cand is None:
+                    break
+                moves.append(RebalanceMove(cand.key, donor, new_server,
+                                           cand.length))
+                moved.add(cand.key)
+                loads[donor] -= cand.length
+                loads[new_server] += cand.length
+            return RebalancePlan("join", new_server,
+                                 self._routing_version, tuple(moves))
+
+    def plan_drain(self, server: int) -> RebalancePlan:
+        """Deterministic scale-down plan: every partition of ``server``
+        re-homes to the least-loaded survivor and the server retires
+        from assignment — the graceful inverse of crash migration,
+        through the same move engine. Pure; apply with
+        :meth:`rebalance`."""
+        with self._lock:
+            num = max(1, self._config.num_servers)
+            bps_check(0 <= server < num,
+                      f"plan_drain: server {server} out of range "
+                      f"[0, {num})")
+            if server in self._dead_servers:
+                raise RuntimeError(
+                    f"plan_drain: server {server} is already retired")
+            alive = [s for s in range(num)
+                     if s not in self._dead_servers and s != server]
+            if not alive:
+                raise RuntimeError(
+                    f"cannot drain server {server}: no other surviving "
+                    f"server remains")
+            moves = self._moves_off_locked(server, alive)
+            return RebalancePlan("drain", server, self._routing_version,
+                                 tuple(moves), retire=True)
+
+    def rebalance(self, plan: RebalancePlan) -> List[int]:
+        """Apply a version-fenced :class:`RebalancePlan`: validates the
+        fence (a plan computed against a stale routing table is
+        refused — recompute after the table settles), re-homes the
+        plan's keys, retires the server for drain plans, and bumps
+        ``routing_version``. Returns the moved keys (callers must
+        invalidate client init caches for them and replay any
+        server-side codec state — core/elastic.py owns that
+        choreography)."""
+        with self._lock:
+            if plan.base_version != self._routing_version:
+                raise RuntimeError(
+                    f"stale rebalance plan: computed at routing_version "
+                    f"{plan.base_version}, table is now at "
+                    f"{self._routing_version} — recompute the plan")
+            num = max(1, self._config.num_servers)
+            if not 0 <= plan.server < num:
+                raise ValueError(
+                    f"rebalance plan names server {plan.server}, out of "
+                    f"range [0, {num})")
+            moved = self._apply_moves_locked(plan.moves)
+            if plan.retire:
+                self._dead_servers.add(plan.server)
+            # a join/drain is a routing change even with zero moves (the
+            # assignable set changed), so the fence always advances
+            self._routing_version += 1
+            log.info(
+                "registry: rebalance kind=%s server=%d moved=%d "
+                "(routing_version=%d)", plan.kind, plan.server,
+                len(moved), self._routing_version)
+            return moved
 
     def migrate_server(self, dead_server: int,
                        keys: Optional[set] = None) -> List[int]:
@@ -400,7 +634,10 @@ class TensorRegistry:
         ``dead_server`` (optionally restricted to ``keys``) onto the
         least-loaded SURVIVING server, updating the per-server load
         accounting, and mask the dead server out of all future
-        assignments.
+        assignments. Since the elastic rebalance landed this is the
+        crash-trigger entry into the same move engine the graceful
+        drain uses (``_moves_off_locked``) — scale-down and
+        crash-migration are one code path exercised from two triggers.
 
         The re-targeting mutates each ``Partition.server`` in place, so
         in-flight retry state holding the Partition object re-routes
@@ -427,20 +664,8 @@ class TensorRegistry:
                     f"server {dead_server} is dead and no surviving "
                     f"server remains ({num} declared, all dead) — the PS "
                     f"fleet is gone")
-            migrated: List[int] = []
-            for name in self._declaration_order:
-                ctx = self._contexts[name]
-                for p in ctx.partitions:
-                    if p.server != dead_server:
-                        continue
-                    if keys is not None and p.key not in keys:
-                        continue
-                    target = min(alive,
-                                 key=lambda s: self._server_load[s])
-                    self._server_load[dead_server] -= p.length
-                    self._server_load[target] += p.length
-                    p.server = target
-                    migrated.append(p.key)
+            moves = self._moves_off_locked(dead_server, alive, keys)
+            migrated = self._apply_moves_locked(moves)
             if migrated:
                 self._routing_version += 1
                 log.warning(

@@ -161,6 +161,12 @@ class GlobalState:
         self.scheduler = None        # PipelineScheduler over ps_client
         self.handles = None          # HandleManager for the async API
         self.codec_plane = None      # adaptive codec plane (codec_plane.py)
+        self.autoscaler = None       # autoscaler plane (autoscaler.py)
+        # server spawn hook for the autoscaler's acting "add" path:
+        # fn(index) -> "host:port" of a freshly-started server (or None
+        # to decline); survives re-init (operator wiring, not lifecycle
+        # state)
+        self.server_spawn_hook = None
         self.flight = None           # crash flight recorder (flight.py)
         # persistent host staging arena (core/arena.py); replaced with an
         # enabled instance at init() when BYTEPS_STAGING_ARENA is on —
@@ -240,6 +246,12 @@ class GlobalState:
             # or not the adaptive plane itself is enabled below
             from .codec_plane import register_codec_metrics
             register_codec_metrics(self.metrics)
+            # elastic-lifecycle instruments too (registry/joins,
+            # registry/drains, autoscale/decisions, server/evictions):
+            # eagerly created so healthy static fleets export documented
+            # zeros, exactly like the wire/retries family
+            from .autoscaler import register_autoscale_metrics
+            register_autoscale_metrics(self.metrics)
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.
@@ -356,6 +368,19 @@ class GlobalState:
                     # schema guard only pins the codec/* instruments
                     self.metrics.section(
                         "codec_plans", self.codec_plane.plan_snapshot)
+                autoscale_mode = (self.config.autoscale or "").strip()
+                if autoscale_mode not in ("", "0", "off", "false", "no"):
+                    # sensor-driven fleet-size control loop
+                    # (core/autoscaler.py): consumes each finished
+                    # StepReport on the train thread; "act" applies
+                    # evict/drain through core/elastic.py, anything
+                    # else is advisory (metrics + flight events)
+                    from .autoscaler import AutoscalerPlane
+                    mode = "act" if autoscale_mode == "act" else "advise"
+                    self.autoscaler = AutoscalerPlane(self, mode=mode)
+                    self.profiler.add_observer(self.autoscaler.on_step)
+                    self.metrics.section("autoscale",
+                                         self.autoscaler.snapshot)
             if self.config.metrics_port > 0 and self._metrics_server is None:
                 from .metrics import start_http_server
                 try:
@@ -564,10 +589,33 @@ class GlobalState:
 
     def resume(self, num_workers: int, num_servers: int,
                global_rank: Optional[int] = None) -> None:
-        """Elastic resume with a new topology (common/__init__.py:75-81)."""
+        """Elastic resume with a new topology (common/__init__.py:75-81).
+
+        A resume may change ``num_servers``: ``redeclare_all`` rebuilds
+        the WHOLE routing table against the new count (fresh
+        partition→server assignment, load table reset, routing_version
+        bumped) — never a stale assignment table. An explicit
+        ``BYTEPS_SERVER_HOSTS`` list is trimmed to the new count when
+        shrinking (the surviving prefix keeps its indices); growing past
+        the known list is an error — name the new hosts, or grow a LIVE
+        fleet with ``bps.add_server`` instead."""
         import os
+        # validate BEFORE any env mutation: a refused resume must leave
+        # the process env exactly as it found it (a half-written
+        # topology would poison every later Config.from_env reader)
+        hosts = os.environ.get("BYTEPS_SERVER_HOSTS", "")
+        addrs = [h.strip() for h in hosts.split(",") if h.strip()]
+        if hosts and num_servers > 0 and len(addrs) < num_servers:
+            raise ValueError(
+                f"resume(num_servers={num_servers}) but "
+                f"BYTEPS_SERVER_HOSTS names only {len(addrs)} "
+                f"server(s) — set the full host list before resuming, "
+                f"or join live servers with bps.add_server()")
         os.environ["DMLC_NUM_WORKER"] = str(num_workers)
         os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+        if hosts and num_servers > 0 and len(addrs) > num_servers:
+            os.environ["BYTEPS_SERVER_HOSTS"] = ",".join(
+                addrs[:num_servers])
         if global_rank is not None:
             os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
         # init() re-establishes the PS client that suspend() closed.
@@ -584,6 +632,9 @@ class GlobalState:
         # the plane holds client/scheduler refs; plan STATE stays on the
         # registry so a resume continues where the ladder left off
         self.codec_plane = None
+        # controller streaks are lifecycle state: a resumed fleet must
+        # re-prove its conditions against the new topology
+        self.autoscaler = None
 
     # ------------------------------------------------------------------ #
     # identity (communicator.cc:60-96)

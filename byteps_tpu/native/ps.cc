@@ -290,6 +290,15 @@ enum Op : uint8_t {
   TRACE_DRAIN = 13,   // reply: packed TraceRec[] (destructive read)
   FLIGHT_DRAIN = 14,  // reply: packed FlightRec[] (snapshot, kept)
   CLOCK_PROBE = 15,   // reply: {recv_ns, send_ns} steady-clock echo
+  // Elastic-fleet control plane (docs/fault-tolerance.md "Elasticity"),
+  // riding the same inline conn-loop path as the observability ops:
+  JOIN_PROBE = 16,  // reply: {num_workers, draining} — the scale-up
+                    // join handshake: a worker verifies the newcomer is
+                    // up and agrees on the worker count BEFORE the
+                    // registry routes key subranges to it
+  DRAIN_REQ = 17,   // mark this server draining (advisory flag + flight
+                    // event); reply: {keys_held, 1} — the drain ACK a
+                    // worker collects after migrating the keys away
 };
 
 enum ReqType : uint32_t {
@@ -1914,7 +1923,13 @@ class Throttle {
 //     replies). Forces client timeouts + retries, which the epoch
 //     replay-dedup must absorb without double-counting;
 //   BYTEPS_CHAOS_DELAY_MS=M           — sleep M ms before each
-//     aggregate reply (latency injection).
+//     aggregate reply (latency injection);
+//   BYTEPS_CHAOS_SLOW_SERVER=M        — PERSISTENT per-server slowdown:
+//     every data request sleeps M ms between dequeue and handling, so
+//     the engine serializes behind the sleeps and the server's
+//     queue-wait stage counters inflate continuously — the gray-failure
+//     shape (slow-but-alive straggler) the autoscaler's eviction
+//     detector keys on, unlike the reply-only DELAY_MS above.
 class Chaos {
  public:
   Chaos() {
@@ -1926,6 +1941,16 @@ class Chaos {
       delay_ms_ = std::atol(e);
     if (const char* e = ::getenv("BYTEPS_CHAOS_KILL_AFTER_ROUNDS"))
       kill_rounds_ = std::atol(e);
+    if (const char* e = ::getenv("BYTEPS_CHAOS_SLOW_SERVER"))
+      slow_ms_ = std::atol(e);
+  }
+
+  // Called at engine dequeue, BEFORE the queue-wait accounting: the
+  // injected latency lands in queue_ns (requests behind it also wait),
+  // which is exactly the stage a real straggler inflates.
+  void slow_point() {
+    if (slow_ms_ > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(slow_ms_));
   }
 
   // Called before an aggregate reply is sent: inject latency, then
@@ -1958,6 +1983,7 @@ class Chaos {
   double drop_rate_ = 0;
   long delay_ms_ = 0;
   long kill_rounds_ = 0;
+  long slow_ms_ = 0;
   Mu mu_;
   double acc_ = 0;
   long dropped_ = 0;
@@ -2111,7 +2137,7 @@ static const char* const kStatSlotNames[] = {
     "fold_count", "fold_bytes", "reply_ns", "reply_count",
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
-    "flight_dropped"};
+    "flight_dropped", "draining"};
 static constexpr size_t kNumStatSlots =
     sizeof(kStatSlotNames) / sizeof(kStatSlotNames[0]);
 
@@ -2123,6 +2149,13 @@ enum FlightKind : uint8_t {
   kFlightWorkerDeparted = 4,
   kFlightPullAbort = 5,
   kFlightUnknownOp = 6,
+  // a stamped fold carrying a different round than the one that opened
+  // this aggregation round — the multi-worker partial-reply-window
+  // hazard, rejected loudly instead of silently mis-summed
+  kFlightRoundSkew = 7,
+  // this server was told to drain (DRAIN_REQ): it should receive no
+  // further data traffic once the workers migrated its keys away
+  kFlightDrained = 8,
 };
 
 // Control-pull reply size limits — wire contract: the CLIENT sizes its
@@ -2267,6 +2300,15 @@ struct KeyStore {
   // loudly instead of summed: the adaptive plane's aggregation-safety
   // net. Reset at every ALL_RECV / rollback / re-init.
   uint32_t round_codec = 0;
+  // Round number latched by the current aggregation round's FIRST
+  // stamped fold (epoch >> 16; 0 = round opened unstamped). A later
+  // sync-mode fold of the SAME positional round carrying a DIFFERENT
+  // round number means the workers are folding different training
+  // rounds into one aggregate — the multi-worker partial-reply-window
+  // hazard after a migration (docs/fault-tolerance.md): rejected
+  // loudly instead of silently mis-summed. Re-latched whenever
+  // recv_count returns to 0 (ALL_RECV / rollback / re-init).
+  uint64_t round_open = 0;
   std::vector<int32_t> round_idx;     // randomk: this round's indices
   std::vector<float> scratch;         // decompress buffer
   // randomk homomorphic fast path: the round's aggregate in WIRE form
@@ -2424,7 +2466,7 @@ class Server {
         st.oob_msgs.load(),     (uint64_t)simd_tier(),
         (uint64_t)n_engines_,   trace_ring_.total(),
         trace_ring_.dropped(),  flight_ring_.total(),
-        flight_ring_.dropped()};
+        flight_ring_.dropped(), draining_.load() ? 1ull : 0ull};
     int n = max_n < (int)kNumStatSlots ? max_n : (int)kNumStatSlots;
     for (int i = 0; i < n; ++i) out[i] = v[i];
     return n;
@@ -2658,8 +2700,9 @@ class Server {
         continue;
       }
       if (h.op == STATS_PULL || h.op == TRACE_DRAIN ||
-          h.op == FLIGHT_DRAIN) {
-        HandleControlPull(conn, h.rid, h.op);
+          h.op == FLIGHT_DRAIN || h.op == JOIN_PROBE ||
+          h.op == DRAIN_REQ) {
+        HandleControlPull(conn, h.rid, h.op, h.sender);
         continue;
       }
       if (h.op == BARRIER) {
@@ -2829,7 +2872,45 @@ class Server {
   }
 
   void HandleControlPull(const std::shared_ptr<Conn>& conn, uint32_t rid,
-                         uint8_t op) {
+                         uint8_t op, uint16_t sender = 0) {
+    if (op == JOIN_PROBE) {
+      // scale-up join handshake: the worker verifies the newcomer is
+      // reachable and agrees on the worker count BEFORE the registry
+      // re-routes key subranges here (a num_workers mismatch would
+      // wedge every aggregation round on the new store)
+      uint64_t v[2] = {(uint64_t)num_workers_,
+                       draining_.load() ? 1ull : 0ull};
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                                (uint32_t)sizeof(v));
+      conn->send_msg(r, v);
+      return;
+    }
+    if (op == DRAIN_REQ) {
+      // graceful scale-down: latch the advisory draining flag (visible
+      // in STATS_PULL / bps_server_stats as the `draining` slot) and
+      // ACK with the number of key stores held — the worker has
+      // already migrated the keys away, so the count is forensic, not
+      // a gate. The flag is advisory by design: a drained server that
+      // still receives traffic (operator error, stale worker) serves
+      // it correctly rather than corrupting anything.
+      bool first = !draining_.exchange(true);
+      if (first) {
+        Flight(kFlightDrained, 0, rid, sender);
+        std::fprintf(stderr,
+                     "[bps-server] drain requested by worker %u; "
+                     "draining flag latched\n", (unsigned)sender);
+      }
+      uint64_t v[2];
+      {
+        std::lock_guard<Mu> lk(stores_mu_);
+        v[0] = (uint64_t)stores_.size();
+      }
+      v[1] = 1;
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                                (uint32_t)sizeof(v));
+      conn->send_msg(r, v);
+      return;
+    }
     if (op == STATS_PULL) {
       // full per-stage registry snapshot over the wire: the remote
       // half of bps.get_fleet_metrics() (same slot vector as the
@@ -2918,6 +2999,10 @@ class Server {
   void EngineLoop(int idx) {
     EngineMsg m;
     while (queues_[idx]->wait_pop(&m)) {
+      // gray-failure injection (BYTEPS_CHAOS_SLOW_SERVER): the sleep
+      // sits between dequeue and the queue-wait accounting below, so it
+      // COUNTS as queue-wait — the stage a real straggler inflates
+      chaos_.slow_point();
       if (m.enq_ns) {
         stats_.queue_ns.fetch_add(now_ns() - m.enq_ns,
                                   std::memory_order_relaxed);
@@ -3066,6 +3151,34 @@ class Server {
       }
     }
     return true;
+  }
+
+  // Round-alignment gate (call under ks.mu, after IsReplay, before the
+  // fold): sync-mode stamped folds summing into ONE aggregation round
+  // must all carry the SAME round number. The first fold of a round
+  // latches it; a disagreeing later fold is the partial-reply-window
+  // hazard — after a migration, a worker that consumed round N's reply
+  // pushes N+1 while a worker whose reply was lost re-pushes N, and
+  // positional counting would silently sum the two rounds together.
+  // Unstamped folds (legacy) and async mode keep positional semantics.
+  bool RoundAligned(KeyStore& ks, const EngineMsg& m) {
+    if (async_) return true;
+    uint64_t rnd = m.epoch >> 16;
+    if (ks.recv_count == 0) {
+      ks.round_open = rnd;  // rnd==0: round opened unstamped, no gate
+      return true;
+    }
+    if (!rnd || ks.round_open == 0 || rnd == ks.round_open) return true;
+    std::fprintf(stderr,
+                 "[bps-server] round skew key=%llu sender=%u: round "
+                 "opened at %llu, this push carries %llu — refusing to "
+                 "fold (workers are folding different training rounds; "
+                 "partial-reply window after a migration?)\n",
+                 (unsigned long long)m.key, (unsigned)m.sender,
+                 (unsigned long long)ks.round_open,
+                 (unsigned long long)rnd);
+    Flight(kFlightRoundSkew, m.key, m.rid, m.sender, rnd);
+    return false;
   }
 
   // Record a successful fold's round (call under ks.mu, next to the
@@ -3326,7 +3439,7 @@ class Server {
         return;
       }
       if (IsReplay(ks, m)) goto ack;  // fold at most once per round
-      if (!CodecTagOk(ks, m)) {
+      if (!CodecTagOk(ks, m) || !RoundAligned(ks, m)) {
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
@@ -3530,6 +3643,7 @@ class Server {
           break;
         }
         if (!CodecTagOk(ks, m)) break;  // rowsparse rides the dense mode
+        if (!RoundAligned(ks, m)) break;
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
         if (m.size() < 8) break;
@@ -3658,7 +3772,7 @@ class Server {
         return;
       }
       if (!IsReplay(ks, m)) {
-        if (!CodecTagOk(ks, m)) {
+        if (!CodecTagOk(ks, m) || !RoundAligned(ks, m)) {
           MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
           m.conn->send_msg(r, nullptr);
           return;
@@ -3923,6 +4037,9 @@ class Server {
   int64_t debug_key_ = -1;
   Throttle throttle_;  // BYTEPS_SERVER_THROTTLE_MBPS, off by default
   Chaos chaos_;        // BYTEPS_CHAOS_*, off by default
+  // latched by DRAIN_REQ (advisory; surfaced as the `draining` stat
+  // slot so detectors/operators can see the lifecycle state remotely)
+  std::atomic<bool> draining_{false};
   int listen_fd_ = -1;
   std::atomic<bool> shutting_down_{false};
   std::atomic<int> shutdown_count_{0};
@@ -4675,6 +4792,13 @@ class ServerConn {
 
 class Client {
  public:
+  // Upper bound on servers per client. The connection-group table is a
+  // FIXED array of owning pointers with an atomic count, so a runtime
+  // AddServer (elastic scale-up) publishes a fully-built group with one
+  // release store and the data-plane readers (pick(), the reactor
+  // sweeps, ServerDead probes) never race a vector reallocation.
+  static constexpr int kMaxServers = 256;
+
   bool Connect(const std::vector<std::pair<std::string, int>>& servers,
                int worker_id) {
     worker_id_ = (uint16_t)worker_id;
@@ -4686,30 +4810,42 @@ class Client {
     // ordering comes from key-affine conn picking (pick(server, key)):
     // a key's async push and its pull share one FIFO stream; unordered
     // ops (init/comp_init) block on their ACK and may round-robin.
-    int k = 4;
+    conns_per_server_ = 4;
     if (const char* e = ::getenv("BYTEPS_CLIENT_CONNS")) {
-      k = std::atoi(e);
-      if (k < 1) k = 1;
-      if (k > 16) k = 16;
+      conns_per_server_ = std::atoi(e);
+      if (conns_per_server_ < 1) conns_per_server_ = 1;
+      if (conns_per_server_ > 16) conns_per_server_ = 16;
     }
-    groups_.clear();
+    if ((int)servers.size() > kMaxServers) return false;
     for (size_t i = 0; i < servers.size(); ++i) {
-      auto g = std::make_unique<ConnGroup>();
-      for (int j = 0; j < k; ++j) {
-        auto c = std::make_unique<ServerConn>();
-        c->set_cq(&cq_);
-        if (!c->Connect(servers[i].first, servers[i].second, worker_id_))
-          return false;
-        g->conns.push_back(std::move(c));
-      }
-      groups_.push_back(std::move(g));
+      auto g = BuildGroup(servers[i].first, servers[i].second);
+      if (!g) return false;
+      groups_[i] = std::move(g);
     }
+    n_groups_.store((int)servers.size(), std::memory_order_release);
     return true;
   }
 
+  // Runtime scale-up: connect a NEW server's striped conn group and
+  // publish it at the next index. The group is fully constructed (all
+  // conns up, recv loops running) BEFORE the count's release store, so
+  // a concurrent reader either doesn't see the server yet or sees it
+  // whole. Returns the new server index, or -1.
+  int AddServer(const std::string& host, int port) {
+    std::lock_guard<Mu> lk(grow_mu_);
+    int n = n_groups_.load(std::memory_order_relaxed);
+    if (n >= kMaxServers || conns_per_server_ <= 0) return -1;
+    auto g = BuildGroup(host, port);
+    if (!g) return -1;
+    groups_[n] = std::move(g);
+    n_groups_.store(n + 1, std::memory_order_release);
+    return n;
+  }
+
   void Close() {
-    for (auto& g : groups_)
-      for (auto& c : g->conns)
+    int n = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < n; ++i)
+      for (auto& c : groups_[i]->conns)
         if (c) c->Close();
     cq_.close();
   }
@@ -4736,7 +4872,9 @@ class Client {
   // Returns the reply length or -1.
   int Ctrl(int server, uint8_t op, void* out, uint32_t out_cap,
            long timeout_s) {
-    if (server < 0 || server >= (int)groups_.size()) return -1;
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return -1;
     uint32_t r = groups_[server]->conns[0]->Request(
         op, 0, 0, worker_id_, nullptr, 0, out, out_cap, 0, 0,
         timeout_s > 0 ? timeout_s : 5);
@@ -4747,7 +4885,9 @@ class Client {
   // t2 server-send, t3 client-recv}, all steady-clock ns (t0/t3 on the
   // client's clock, t1/t2 on the server's). Returns 0 or -1.
   int ClockProbe(int server, uint64_t* out4, long timeout_s) {
-    if (server < 0 || server >= (int)groups_.size()) return -1;
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return -1;
     uint64_t echo[2] = {0, 0};
     out4[0] = now_ns();
     uint32_t r = groups_[server]->conns[0]->Request(
@@ -4764,7 +4904,9 @@ class Client {
   // EOF or poisoned): the worker-side server-death verdict that drives
   // key migration. Out-of-range indices read as dead.
   int ServerDead(int server) {
-    if (server < 0 || server >= (int)groups_.size()) return 1;
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return 1;
     for (auto& c : groups_[server]->conns)
       if (c && !c->dead()) return 0;
     return 1;
@@ -4784,8 +4926,9 @@ class Client {
       int chunk = remain > 500 ? 500 : remain;
       int n = cq_.pop_batch(out, max_n, chunk > 0 ? chunk : 0);
       if (n != 0) return n;
-      for (auto& g : groups_)
-        for (auto& c : g->conns)
+      int ng = n_groups_.load(std::memory_order_acquire);
+      for (int i = 0; i < ng; ++i)
+        for (auto& c : groups_[i]->conns)
           if (c) c->SweepExpiredFused(timeout_s);
       remain -= chunk;
       if (remain <= 0) return 0;
@@ -4798,8 +4941,9 @@ class Client {
   // fused request into the queue, then close it — the reactor drains
   // the failures and exits on -1 BEFORE the native client is destroyed.
   void CqAbort() {
-    for (auto& g : groups_)
-      for (auto& c : g->conns)
+    int n = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < n; ++i)
+      for (auto& c : groups_[i]->conns)
         if (c) c->AbortFused();
     cq_.close();
   }
@@ -4854,8 +4998,9 @@ class Client {
 
   int IpcConns() const {
     int n = 0;
-    for (auto& g : groups_)
-      for (auto& c : g->conns)
+    int ng = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < ng; ++i)
+      for (auto& c : groups_[i]->conns)
         if (c && c->ipc_active()) n++;
     return n;
   }
@@ -4864,8 +5009,9 @@ class Client {
   // the client-side proof that the zero-copy shm tier engaged.
   void TransportStats(uint64_t* oob_sent, uint64_t* oob_recvd) const {
     uint64_t snt = 0, rcv = 0;
-    for (auto& g : groups_)
-      for (auto& c : g->conns)
+    int ng = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < ng; ++i)
+      for (auto& c : groups_[i]->conns)
         if (c) {
           snt += c->oob_sent();
           rcv += c->oob_recvd();
@@ -4876,18 +5022,22 @@ class Client {
 
   int TotalConns() const {
     int n = 0;
-    for (auto& g : groups_) n += (int)g->conns.size();
+    int ng = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < ng; ++i) n += (int)groups_[i]->conns.size();
     return n;
   }
 
   int Shutdown() {
     // exactly ONE shutdown per server per worker: the server counts
     // SHUTDOWN messages against num_workers, so the stripe conns must
-    // not inflate the count (their sockets just close afterwards)
+    // not inflate the count (their sockets just close afterwards).
+    // Runtime-joined servers are included — they were created with the
+    // same worker count and exit on the same rendezvous.
     int rc = 0;
-    for (auto& g : groups_) {
-      if (g->conns[0]->Request(SHUTDOWN, 0, 0, worker_id_, nullptr, 0,
-                               nullptr, 0) == ~0u)
+    int ng = n_groups_.load(std::memory_order_acquire);
+    for (int i = 0; i < ng; ++i) {
+      if (groups_[i]->conns[0]->Request(SHUTDOWN, 0, 0, worker_id_,
+                                        nullptr, 0, nullptr, 0) == ~0u)
         rc = -1;
     }
     return rc;
@@ -4898,6 +5048,20 @@ class Client {
     std::vector<std::unique_ptr<ServerConn>> conns;
     std::atomic<uint32_t> rr{0};
   };
+
+  // Build one server's fully-connected striped group (recv loops
+  // running); nullptr on any connect failure.
+  std::unique_ptr<ConnGroup> BuildGroup(const std::string& host,
+                                        int port) {
+    auto g = std::make_unique<ConnGroup>();
+    for (int j = 0; j < conns_per_server_; ++j) {
+      auto c = std::make_unique<ServerConn>();
+      c->set_cq(&cq_);
+      if (!c->Connect(host, port, worker_id_)) return nullptr;
+      g->conns.push_back(std::move(c));
+    }
+    return g;
+  }
 
   // round-robin pick: ops with no ordering requirement (init/comp_init
   // block on their ACK, so cross-conn reorder can't hurt them)
@@ -4916,7 +5080,13 @@ class Client {
   }
 
   uint16_t worker_id_ = 0;
-  std::vector<std::unique_ptr<ConnGroup>> groups_;
+  int conns_per_server_ = 4;
+  // fixed slots [0, n_groups_): a group pointer is written BEFORE the
+  // count's release store, so readers loading the count with acquire
+  // see only fully-built groups and never race a container growth
+  std::unique_ptr<ConnGroup> groups_[kMaxServers];
+  std::atomic<int> n_groups_{0};
+  Mu grow_mu_;  // serializes AddServer calls (readers stay lock-free)
   CompletionQueue cq_;  // fused-request completions, all conns
 };
 
@@ -4995,6 +5165,19 @@ void* bps_client_create(const char* servers_csv, int worker_id) {
     return nullptr;
   }
   return c;
+}
+
+// Runtime scale-up (elastic fleet, docs/fault-tolerance.md): connect a
+// NEW server's striped conn group and publish it at the next index.
+// `host_port` = "host:port". Returns the new server index or -1. The
+// caller (server/client.py PSClient.add_server) then runs the
+// JOIN_PROBE handshake before the registry routes any key here.
+int bps_client_add_server(void* c, const char* host_port) {
+  std::string entry(host_port);
+  size_t colon = entry.rfind(':');
+  if (colon == std::string::npos) return -1;
+  return ((bps::Client*)c)->AddServer(entry.substr(0, colon),
+                                      std::atoi(entry.c_str() + colon + 1));
 }
 
 int bps_client_init_key(void* c, int server, uint64_t key, const void* data,

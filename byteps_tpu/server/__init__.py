@@ -44,7 +44,7 @@ _STAT_SLOTS = (
     "fold_count", "fold_bytes", "reply_ns", "reply_count",
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
-    "flight_dropped",
+    "flight_dropped", "draining",
 )
 
 # Wire-sampled trace record (native/ps.cc TraceRec, drained over the
@@ -74,6 +74,7 @@ assert struct.calcsize(FLIGHT_REC_FMT) == FLIGHT_REC_BYTES
 FLIGHT_KIND_NAMES = {
     1: "replay_dedup", 2: "codec_reject", 3: "chaos_drop",
     4: "worker_departed", 5: "pull_abort", 6: "unknown_op",
+    7: "round_skew", 8: "drained",
 }
 
 
@@ -150,6 +151,7 @@ def derive_stage_section(raw: Dict[str, int]) -> Dict[str, float]:
         "trace_dropped": raw["trace_dropped"],
         "flight_records": raw["flight_records"],
         "flight_dropped": raw["flight_dropped"],
+        "draining": raw["draining"],
     }
 
 

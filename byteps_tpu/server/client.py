@@ -55,6 +55,8 @@ WIRE_CTRL_OPS = {
     "TRACE_DRAIN": 13,
     "FLIGHT_DRAIN": 14,
     "CLOCK_PROBE": 15,
+    "JOIN_PROBE": 16,
+    "DRAIN_REQ": 17,
 }
 
 # Control-pull reply size limits (native/ps.cc enum CtrlLimits, also
@@ -142,6 +144,12 @@ def _load_lib() -> ctypes.CDLL:
         lib.bps_client_clock_probe.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    if hasattr(lib, "bps_client_add_server"):
+        # runtime scale-up (elastic fleet); guarded — a stale .so simply
+        # cannot grow its fleet and add_server() raises a clear error
+        lib.bps_client_add_server.restype = ctypes.c_int
+        lib.bps_client_add_server.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p]
     lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
     lib.bps_client_barrier.restype = ctypes.c_int
     lib.bps_client_ipc_conns.argtypes = [ctypes.c_void_p]
@@ -496,6 +504,79 @@ class PSClient:
     def dead_servers(self) -> List[int]:
         """Indices of servers whose every connection is dead."""
         return [s for s in range(len(self._servers)) if self.server_dead(s)]
+
+    # ------------------------------------------------------------ #
+    # elastic fleet: runtime scale-up join + graceful drain
+    # (core/elastic.py drives these; docs/fault-tolerance.md)
+    # ------------------------------------------------------------ #
+
+    @property
+    def servers(self) -> List[str]:
+        """The live server address list (grows on :meth:`add_server`)."""
+        with self._lock:
+            return list(self._servers)
+
+    @property
+    def supports_elastic(self) -> bool:
+        """True when the loaded native library can grow its connection
+        table at runtime (False only under stale-.so version skew)."""
+        return hasattr(self._lib, "bps_client_add_server")
+
+    def add_server(self, address: str) -> int:
+        """Connect this client to a NEW server at runtime and return its
+        index (== the previous server count). The native side publishes
+        the fully-connected striped conn group atomically, so in-flight
+        traffic to existing servers never races the growth. The caller
+        must run :meth:`join_probe` before routing keys to the index."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("add_server on a closed PSClient")
+        if not self.supports_elastic:
+            raise RuntimeError(
+                "native library predates runtime scale-up "
+                "(bps_client_add_server missing) — rebuild the native "
+                "lib to grow the fleet at runtime")
+        idx = self._lib.bps_client_add_server(self._handle,
+                                              address.encode())
+        if idx < 0:
+            raise RuntimeError(
+                f"failed to connect new PS server at {address!r}")
+        with self._lock:
+            # the native index is authoritative; the Python list exists
+            # for range checks and re-connect bookkeeping
+            while len(self._servers) <= idx:
+                self._servers.append(address)
+            self._servers[idx] = address
+        log.info("PS client: joined server %d at %s", idx, address)
+        return idx
+
+    def join_probe(self, server: int,
+                   timeout_s: int = 5) -> Optional[dict]:
+        """Scale-up join handshake: ask ``server`` for its worker count
+        and draining state (JOIN_PROBE control op). Returns
+        ``{"num_workers", "draining"}`` or None (unreachable / stale
+        ABI). The caller validates ``num_workers`` against its own
+        config BEFORE the registry routes key subranges there — a
+        mismatched newcomer would wedge every aggregation round."""
+        raw = self._ctrl(server, "JOIN_PROBE", 16, timeout_s)
+        if raw is None or len(raw) != 16:
+            return None
+        nw, draining = struct.unpack("<QQ", raw)
+        return {"num_workers": int(nw), "draining": bool(draining)}
+
+    def drain_req(self, server: int,
+                  timeout_s: int = 5) -> Optional[dict]:
+        """Graceful-drain ACK (DRAIN_REQ control op): latch the server's
+        advisory draining flag and collect ``{"keys_held",
+        "draining"}``. Called AFTER the registry migrated the server's
+        keys away; best-effort — a dead/stale server returns None and
+        the drain proceeds regardless (the flag is forensic, not a
+        correctness gate)."""
+        raw = self._ctrl(server, "DRAIN_REQ", 16, timeout_s)
+        if raw is None or len(raw) != 16:
+            return None
+        held, draining = struct.unpack("<QQ", raw)
+        return {"keys_held": int(held), "draining": bool(draining)}
 
     def invalidate_init(self, keys) -> None:
         """Forget that ``keys`` were init-pushed: after a key migrates to

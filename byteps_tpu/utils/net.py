@@ -3,6 +3,26 @@
 from __future__ import annotations
 
 import socket
+import time
+
+
+def wait_port(port: int, timeout: float = 60.0,
+              host: str = "127.0.0.1") -> None:
+    """Block until ``host:port`` ACCEPTS a connection (the
+    server-came-up rendezvous every loopback-fleet harness needs —
+    server processes pay a cold import before they bind). Raises
+    RuntimeError at the deadline."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            with socket.create_connection((host, port), timeout=1):
+                return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"server on {host}:{port} never came up within "
+                    f"{timeout:.0f}s")
+            time.sleep(0.1)
 
 
 def free_port() -> int:

@@ -116,6 +116,14 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "churn_ab_clean_retries": 0,
                     "churn_ab_drop_rate": 0.25,
                     "churn_ab_idempotent_proof": True}, None
+        if name == "scaleup_ab":
+            return {"scaleup_before_step_ms": 320.0,
+                    "scaleup_after_step_ms": 180.0,
+                    "scaleup_ratio": 0.5625,
+                    "scaleup_joins": 1,
+                    "scaleup_newcomer_bytes": 16777216,
+                    "scaleup_identical": True,
+                    "scaleup_proof": True}, None
         if name == "codec_adapt_ab":
             return {"codec_adapt_throttled_switches": 2,
                     "codec_adapt_unthrottled_switches": 0,
@@ -137,8 +145,11 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:5] == ["pushpull_throttled", "scaling", "churn_ab",
-                             "codec_adapt_ab", "fold_ab"]
+    assert cpu_calls[:6] == ["pushpull_throttled", "scaling", "churn_ab",
+                             "scaleup_ab", "codec_adapt_ab", "fold_ab"]
+    assert out["scaleup_proof"] is True
+    assert out["scaleup_joins"] == 1
+    assert out["scaleup_newcomer_bytes"] == 16777216
     assert out["codec_adapt_proof"] is True
     assert out["codec_adapt_throttled_switches"] == 2
     assert out["codec_adapt_unthrottled_switches"] == 0
@@ -222,6 +233,11 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"churn_ab_identical": True,
                     "churn_ab_chaos_retries": 5,
                     "churn_ab_clean_retries": 0}, None
+        if name == "scaleup_ab":
+            return {"scaleup_before_step_ms": 320.0,
+                    "scaleup_after_step_ms": 180.0,
+                    "scaleup_joins": 1,
+                    "scaleup_proof": True}, None
         if name == "codec_adapt_ab":
             return {"codec_adapt_throttled_switches": 1,
                     "codec_adapt_unthrottled_switches": 0,
@@ -242,12 +258,13 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 13 CPU phases + finals
-    assert calls.count("probe") == 14 + n_final
+    # start + one attempt after each of the 14 CPU phases + finals
+    assert calls.count("probe") == 15 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
-        "after_churn_ab", "after_codec_adapt_ab", "after_fold_ab",
+        "after_churn_ab", "after_scaleup_ab", "after_codec_adapt_ab",
+        "after_fold_ab",
         "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_trace_ab",
         "after_stream_ab", "after_wire_ab", "after_shard_ab",
@@ -402,9 +419,10 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "churn_ab",
-                            "codec_adapt_ab", "fold_ab", "arena_ab",
-                            "metrics_ab", "trace_ab", "stream_ab",
-                            "wire_ab", "shard_ab", "scaling"}
+                            "scaleup_ab", "codec_adapt_ab", "fold_ab",
+                            "arena_ab", "metrics_ab", "trace_ab",
+                            "stream_ab", "wire_ab", "shard_ab",
+                            "scaling"}
 
 
 def test_multichip_envelope_bounded():

@@ -64,19 +64,11 @@ def _wait_ports(ports, timeout=60):
     """Block until every port accepts connections: the server processes
     pay a cold jax import before they bind, which can outlast the native
     client's own 10s connect-retry window."""
-    import socket
+    from byteps_tpu.utils.net import wait_port
 
     deadline = time.monotonic() + timeout
     for port in ports:
-        while True:
-            try:
-                with socket.create_connection(("127.0.0.1", port),
-                                              timeout=1):
-                    break
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise RuntimeError(f"server on :{port} never came up")
-                time.sleep(0.2)
+        wait_port(port, max(1.0, deadline - time.monotonic()))
 
 
 # --------------------------------------------------------------------- #
@@ -125,7 +117,10 @@ def test_replayed_push_never_double_counts():
     c0.zpull(0, key, out0, CMD_F32, exact=True)
     np.testing.assert_array_equal(out0, 2 * (x0 + x1))
 
-    c0.close(shutdown_servers=False)
+    # BOTH workers SHUTDOWN: a 2-worker server counts shutdowns against
+    # num_workers — one would leave a live server thread leaked into
+    # the rest of the suite (and a 10s join timeout here)
+    c0.close()
     c1.close()
     t.join(timeout=10)
 
@@ -371,6 +366,147 @@ def test_dropped_replies_retry_bitwise_identical():
     assert "DROP_OK" in out, out[-4000:]
     assert "dedup: replayed push" in out, \
         "no server-side dedup fired - replay path untested?"
+
+
+# --------------------------------------------------------------------- #
+# multi-worker partial-reply window (PR-6 documented limitation, now
+# guarded): after a migration, a worker that consumed round N's reply
+# pushes N+1 while a worker whose reply was lost re-pushes N — the
+# server must never silently sum the two rounds into one aggregate.
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.chaos
+def test_round_skew_rejected_never_missummed():
+    """The round-alignment gate (native RoundAligned): a sync-mode
+    stamped fold carrying a different round than the one that opened
+    the aggregation round is REJECTED with an error reply (and a
+    round_skew flight event) — the silent cross-round mis-sum the
+    partial-reply window used to produce is now a loud, attributable
+    failure."""
+    port, t = _server_thread(num_workers=2)
+    addr = [f"127.0.0.1:{port}"]
+    c0 = PSClient(addr, worker_id=0)
+    c1 = PSClient(addr, worker_id=1)
+    n = 256
+    key = 9
+    x0 = np.arange(n, dtype=np.float32)
+    x1 = np.full(n, 5.0, np.float32)
+
+    th = threading.Thread(
+        target=c0.init_key, args=(0, key, np.zeros(n, np.float32),
+                                  CMD_F32), daemon=True)
+    th.start()
+    c1.init_key(0, key, np.zeros(n, np.float32), CMD_F32)
+    th.join(timeout=15)
+    assert not th.is_alive()
+
+    # aligned round folds normally
+    c0.zpush(0, key, x0, CMD_F32, epoch=_epoch(1))
+    c1.zpush(0, key, x1, CMD_F32, epoch=_epoch(1))
+    out = np.empty(n, np.float32)
+    c0.zpull(0, key, out, CMD_F32, exact=True)
+    np.testing.assert_array_equal(out, x0 + x1)
+
+    # the partial-reply-window shape: w1 opens round 2, w0 (which
+    # "consumed" round 2 elsewhere) pushes round 3 into the SAME
+    # positional round — must be rejected, not summed
+    c1.zpush(0, key, x1 * 2, CMD_F32, epoch=_epoch(2))
+    with pytest.raises(RuntimeError):
+        c0.zpush(0, key, x0 * 2, CMD_F32, epoch=_epoch(3))
+    # the guard recorded the skew on the flight plane
+    evs = c1.drain_flight(0)
+    assert any(e["kind"] == "round_skew" for e in evs), evs
+    # w0 re-sending the ALIGNED round still completes it correctly —
+    # the gate rejects skew, it never poisons the round
+    c0.zpush(0, key, x0 * 2, CMD_F32, epoch=_epoch(2))
+    c0.zpull(0, key, out, CMD_F32, exact=True)
+    np.testing.assert_array_equal(out, (x0 + x1) * 2)
+
+    c0.close()  # both workers SHUTDOWN: the 2-worker server exits
+    c1.close()
+    t.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_benign_window_migration_recovers_bitwise():
+    """The DOMINANT window (2-worker subprocess drill, satellite 1):
+    the server dies mid-round — neither worker consumed the round —
+    and both re-push the SAME round on the adoptive server. The
+    replay-epoch machinery covers this case exactly: both folds apply
+    once on the fresh store, the aggregate is bitwise the true sum,
+    and a later replay of the same round is deduped."""
+    from byteps_tpu.utils.net import free_port
+
+    port_a = free_port()
+    # victim: a REAL process (SIGKILL-able); survivor: in-process
+    proc = _spawn_server_proc(port_a, num_workers=2, num_servers=2)
+    port_b, tb = _server_thread(num_workers=2)
+    _wait_ports([port_a, port_b])
+    addrs = [f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"]
+    c0 = PSClient(addrs, worker_id=0)
+    c1 = PSClient(addrs, worker_id=1)
+    n = 512
+    key = 4
+    x0 = np.arange(n, dtype=np.float32)
+    x1 = np.full(n, 3.0, np.float32)
+    try:
+        th = threading.Thread(
+            target=c0.init_key, args=(0, key, np.zeros(n, np.float32),
+                                      CMD_F32), daemon=True)
+        th.start()
+        c1.init_key(0, key, np.zeros(n, np.float32), CMD_F32)
+        th.join(timeout=15)
+        assert not th.is_alive()
+
+        # round 1 completes on the victim
+        c0.zpush(0, key, x0, CMD_F32, epoch=_epoch(1))
+        c1.zpush(0, key, x1, CMD_F32, epoch=_epoch(1))
+        out = np.empty(n, np.float32)
+        c0.zpull(0, key, out, CMD_F32, exact=True)
+        c1.zpull(0, key, out, CMD_F32, exact=True)
+
+        # round 2: w0's push folds on the victim... which then dies
+        # before the round completes — the benign (mid-round) window
+        c0.zpush(0, key, x0 * 2, CMD_F32, epoch=_epoch(2))
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        time.sleep(0.3)  # EOF propagates to every striped conn
+        assert c0.server_dead(0) and c1.server_dead(0)
+
+        # "migration": both workers re-home the key to the survivor
+        # (index 1) — fresh store via the init barrier, then BOTH
+        # re-push round 2 (w0's retry chain still holds the payload)
+        th = threading.Thread(
+            target=c0.init_key, args=(1, key, np.zeros(n, np.float32),
+                                      CMD_F32), daemon=True)
+        th.start()
+        c1.init_key(1, key, np.zeros(n, np.float32), CMD_F32)
+        th.join(timeout=15)
+        assert not th.is_alive()
+        c0.zpush(1, key, x0 * 2, CMD_F32, epoch=_epoch(2, attempt=1))
+        c1.zpush(1, key, x1 * 2, CMD_F32, epoch=_epoch(2))
+        c0.zpull(1, key, out, CMD_F32, exact=True)
+        np.testing.assert_array_equal(out, (x0 + x1) * 2)  # TRUE sum
+        c1.zpull(1, key, out, CMD_F32, exact=True)
+        np.testing.assert_array_equal(out, (x0 + x1) * 2)
+
+        # and a replayed round-2 push on the adoptive server is
+        # deduped (answered, never re-folded): round 3 still exact
+        c0.zpush(1, key, x0 * 2, CMD_F32, epoch=_epoch(2, attempt=2))
+        c0.zpush(1, key, x0 * 3, CMD_F32, epoch=_epoch(3))
+        c1.zpush(1, key, x1 * 3, CMD_F32, epoch=_epoch(3))
+        c0.zpull(1, key, out, CMD_F32, exact=True)
+        np.testing.assert_array_equal(out, (x0 + x1) * 3)
+    finally:
+        # both workers send SHUTDOWN so the 2-worker survivor exits
+        # (the dead victim's shutdown request fails fast on dead conns)
+        c0.close()
+        c1.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        tb.join(timeout=10)
 
 
 # --------------------------------------------------------------------- #

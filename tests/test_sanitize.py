@@ -121,10 +121,48 @@ def worker(w):
 
 threads = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
 for t in threads: t.start()
+
+# Elastic leg (PR 13), CONCURRENT with the stress above: a second
+# server starts at runtime and both clients AddServer it — the atomic
+# conn-group publish (fixed array + release-store count) races the
+# live recv loops, reactor sweeps and ServerDead probes under the
+# sanitizer; then the new JOIN_PROBE / DRAIN_REQ control ops run
+# inline on the conn loop while data traffic flows.
+from byteps_tpu.utils.net import wait_port
+PORT2 = int(os.environ["BPS_STRESS_PORT2"])
+server2 = threading.Thread(target=run_server,
+                           args=(PORT2, Config(num_workers=2,
+                                               num_servers=1)),
+                           daemon=True)
+server2.start()
+wait_port(PORT2)
+assert clients[0].add_server(f"127.0.0.1:{PORT2}") == 1
+assert clients[1].add_server(f"127.0.0.1:{PORT2}") == 1
+probe = clients[0].join_probe(1)
+assert probe and probe["num_workers"] == 2 and not probe["draining"]
+ez = np.zeros(1024, np.float32)
+it = threading.Thread(target=clients[0].init_key,
+                      args=(1, 777, ez, CMD), daemon=True)
+it.start()
+clients[1].init_key(1, 777, ez, CMD)
+it.join(timeout=30)
+assert not it.is_alive()
+for w in range(2):
+    clients[w].zpush(1, 777, np.ones(1024, np.float32), CMD,
+                     epoch=(1 << 16))
+eout = np.empty(1024, np.float32)
+clients[0].zpull(1, 777, eout, CMD)
+assert (eout == 2.0).all()
+ack = clients[0].drain_req(1)
+assert ack and ack["draining"] and ack["keys_held"] >= 1
+stats = clients[1].server_stats(1)
+assert stats and stats["draining"] == 1
+
 for t in threads: t.join()
-clients[0].close(shutdown_servers=False)
+clients[0].close()  # both workers SHUTDOWN: both servers exit cleanly
 clients[1].close()
 server.join(timeout=20)
+server2.join(timeout=20)
 print("STRESS_OK")
 """
 
@@ -218,10 +256,16 @@ def test_sanitized_loopback_stress(tmp_path, mode):
 
     script = tmp_path / "stress.py"
     script.write_text(_STRESS)
+    port1 = free_port()
+    port2 = free_port()
+    while port2 == port1:
+        port2 = free_port()
     env = {
         **os.environ,
         "BPS_REPO": repo,
-        "BPS_STRESS_PORT": str(free_port()),
+        "BPS_STRESS_PORT": str(port1),
+        # elastic leg: the runtime-joined second server
+        "BPS_STRESS_PORT2": str(port2),
         "BYTEPS_SANITIZE": mode,
         "LD_PRELOAD": runtime,
         opts_var: opts,
