@@ -50,6 +50,14 @@ Extra keys in the same line:
   (BYTEPS_STAGING_ARENA, core/arena.py) on vs off, plus the arena
   counters (allocs avoided / bytes pinned / conflicts) proving the
   zero-allocation steady state.
+- ``ledger_on_step_ms`` / ``ledger_off_step_ms`` — steady-state PS
+  train step wall with the step efficiency ledger (BYTEPS_LEDGER,
+  core/ledger.py) pricing every step vs off, plus the engaged-proof
+  (``ledger_mfu`` / ``ledger_overlap_frac`` /
+  ``ledger_wire_efficiency`` non-null from the ON arm's last
+  StepReport). ``--baseline FILE`` additionally runs the noise-aware
+  perf regression gate (ci/perf_gate.py) over the final snapshot and
+  attaches its verdict as ``perf_gate``.
 - ``stream_on_step_ms`` / ``stream_off_step_ms`` and
   ``stream_ttfp_on_ms`` / ``stream_ttfp_off_ms`` — the
   COMPUTE/PUSH/UPDATE pipeline A/B (BYTEPS_STREAM_EXPORT +
@@ -301,13 +309,17 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     import numpy as np
     import optax
 
+    from byteps_tpu.core.ledger import detect_peak, extract_cost
     from byteps_tpu.models import llama
 
-    # bf16 peak of the bench chip (v5e). Override with BENCH_PEAK_FLOPS
-    # when running on different hardware (v5p: 459e12, v4: 275e12).
-    peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+    # bf16 peak from the ledger's device-kind table (core/ledger.py;
+    # docs/performance.md "Chip peak table") — MFU stops silently
+    # assuming one chip. BYTEPS_PEAK_FLOPS overrides for odd hardware.
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak_flops, _, peak_source = detect_peak(kind)
 
     tokens = None
+    step_flops = {}  # variant -> XLA cost-analysis FLOPs per step
 
     def fused_adam_for(cfg):
         """Hand-fused adam over this cfg's loss (shared implementation:
@@ -322,7 +334,7 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
             lambda q, t: llama.loss_fn(q, {"tokens": t}, cfg))
         return init, step
 
-    def measure_cfg(cfg, make_opt=None) -> float:
+    def measure_cfg(cfg, make_opt=None, tag=None) -> float:
         nonlocal tokens
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         if tokens is None:
@@ -345,6 +357,16 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
                 return optax.apply_updates(p, u), o, loss
 
         stepj = jax.jit(step, donate_argnums=(0, 1))
+        if tag is not None:
+            # XLA's own cost model for this variant's whole step
+            # (lowering only — before the warmup calls donate the
+            # buffers); feeds the MFU numerator when available
+            try:
+                c = extract_cost(stepj.lower(params, opt, tokens))
+            except Exception:  # noqa: BLE001 - cost is advisory
+                c = None
+            if c and c.get("flops"):
+                step_flops[tag] = c["flops"]
         for _ in range(3):
             params, opt, loss = stepj(params, opt, tokens)
         float(loss)  # host readback: the only reliable sync here
@@ -383,7 +405,7 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
     results = {}
     for name, (c, make_opt) in variants.items():
         try:
-            results[name] = measure_cfg(c, make_opt=make_opt)
+            results[name] = measure_cfg(c, make_opt=make_opt, tag=name)
         except Exception as e:  # noqa: BLE001 - e.g. OOM on other chips
             sys.stderr.write(f"[bench] train variant {name!r} failed: "
                              f"{e}\n")
@@ -391,9 +413,17 @@ def phase_train(B: int = 16, S: int = 1024, steps: int = 10) -> dict:
         raise RuntimeError("all train variants failed")
     best = max(results, key=results.get)
     tps = results[best]
-    mfu = tps * model_flops_per_token(cfg, S) / peak_flops
+    # MFU numerator: the winning variant's XLA cost-analysis FLOPs per
+    # token when the backend has a cost model, the analytic formula
+    # otherwise (version-tolerant fallback — the ledger's discipline)
+    if step_flops.get(best):
+        fpt, mfu_source = step_flops[best] / (B * S), "xla"
+    else:
+        fpt, mfu_source = model_flops_per_token(cfg, S), "analytic"
+    mfu = tps * fpt / peak_flops
     out = {"value": round(tps, 1), "mfu": round(mfu, 4),
-           "train_variant": best}
+           "train_variant": best, "mfu_source": mfu_source,
+           "peak_flops": peak_flops, "peak_source": peak_source}
     for name, v in results.items():
         out[f"tokens_per_sec_{name}"] = round(v, 1)
     return out
@@ -1198,6 +1228,93 @@ def phase_trace_ab(steps: int = 6, reps: int = 3) -> dict:
             "trace_rid_links": proof.get("rid_links")}
 
 
+def phase_ledger_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the step efficiency ledger (core/ledger.py, BYTEPS_LEDGER)
+    on the PS train step's steady state: the same model/batch trained
+    through the loopback PS with the ledger pricing every step (cost-
+    model lowering, wire-span overlap accounting, wire byte deltas,
+    observer archive hook) vs BYTEPS_LEDGER=0, INTERLEAVED reps
+    (host-load drift lands on both arms), best-of step wall per arm.
+    The acceptance bar is overhead <= 2% of step wall. The ON arm also
+    proves the ledger ENGAGED (not vacuously cheap): the last
+    StepReport must carry non-null ``mfu``/``overlap_frac``/
+    ``wire_efficiency`` and the step diagnosis must name the
+    efficiency verdict."""
+    import gc
+
+    def run(enabled: bool, walls: list, proof: dict):
+        os.environ["BYTEPS_LEDGER"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # the metrics_ab layout: 4MB leaves ride their own keys
+            # through every priced stage, biases keep the fused bucket
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, cost lowering
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            if enabled and not proof:
+                last = bps.get_step_reports()[-1]
+                proof["mfu"] = last["mfu"]
+                proof["overlap_frac"] = last["overlap_frac"]
+                proof["wire_efficiency"] = last["wire_efficiency"]
+                led = bps.get_ledger()
+                proof["source"] = led.get("source")
+                diag = bps.get_metrics()["steps"].get(
+                    "last_diagnosis", "")
+                proof["verdict"] = "MFU" in diag
+
+    prior = os.environ.get("BYTEPS_LEDGER")
+    on_walls, off_walls, proof = [], [], {}
+    try:
+        for _ in range(reps):
+            run(True, on_walls, proof)
+            run(False, off_walls, {})
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_LEDGER", None)
+        else:
+            os.environ["BYTEPS_LEDGER"] = prior
+    on_ms = min(on_walls) * 1e3
+    off_ms = min(off_walls) * 1e3
+    return {"ledger_on_step_ms": round(on_ms, 2),
+            "ledger_off_step_ms": round(off_ms, 2),
+            "ledger_overhead_pct": round(
+                (on_ms - off_ms) / off_ms * 100.0, 2) if off_ms else None,
+            "ledger_mfu": proof.get("mfu"),
+            "ledger_overlap_frac": proof.get("overlap_frac"),
+            "ledger_wire_efficiency": proof.get("wire_efficiency"),
+            "ledger_cost_source": proof.get("source"),
+            "ledger_verdict_named": proof.get("verdict")}
+
+
 def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
     """A/B the fused PUSHPULL wire op (BYTEPS_FUSED_PUSHPULL,
     native/ps.cc PUSHPULL + the completion-reactor client) on the PS
@@ -1824,6 +1941,7 @@ _PHASES = {
     "arena_ab": phase_arena_ab,
     "metrics_ab": phase_metrics_ab,
     "trace_ab": phase_trace_ab,
+    "ledger_ab": phase_ledger_ab,
     "stream_ab": phase_stream_ab,
     "wire_ab": phase_wire_ab,
     "fold_ab": phase_fold_ab,
@@ -1908,11 +2026,34 @@ def _run_phase(name: str, timeout_s: float):
     return None, "no-result-line"
 
 
+def _perf_gate_summary(baseline_path: str, candidate: dict) -> dict:
+    """Noise-aware comparison of this run against a committed baseline
+    (ci/perf_gate.py, loaded by path — it is stdlib-only, so the
+    parent keeps its never-imports-jax guarantee). Advisory: the
+    verdict rides the JSON under ``perf_gate``; the bench exit code is
+    unchanged either way."""
+    import importlib.util
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "perf_gate", os.path.join(REPO, "ci", "perf_gate.py"))
+        pg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pg)
+        baseline = pg.load_baseline(baseline_path)
+        report = pg.compare(candidate, baseline)
+        sys.stderr.write(pg.format_report(report) + "\n")
+        return pg.summarize(report)
+    except Exception as e:  # noqa: BLE001 - advisory, never fatal
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main() -> None:
     # --trace-dir DIR: every phase riding _loopback_ps also emits its
     # fused fleet Chrome trace (docs/timeline.md) next to the JSON
     # result, as DIR/<phase>[.N].trace.json. Exported through the env
     # so phase CHILDREN (separate processes) inherit it.
+    # --baseline FILE: after the run, compare the final snapshot
+    # against a committed perf baseline with the noise-aware gate
+    # (ci/perf_gate.py) and attach the verdict as ``perf_gate``.
     argv = list(sys.argv)
     if "--trace-dir" in argv:
         i = argv.index("--trace-dir")
@@ -1920,6 +2061,15 @@ def main() -> None:
             sys.stderr.write("bench.py: --trace-dir needs a directory\n")
             sys.exit(2)
         os.environ["BENCH_TRACE_DIR"] = os.path.abspath(argv[i + 1])
+        del argv[i:i + 2]
+        sys.argv = argv
+    baseline_path = None
+    if "--baseline" in argv:
+        i = argv.index("--baseline")
+        if i + 1 >= len(argv):
+            sys.stderr.write("bench.py: --baseline needs a JSON file\n")
+            sys.exit(2)
+        baseline_path = os.path.abspath(argv[i + 1])
         del argv[i:i + 2]
         sys.argv = argv
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
@@ -1954,6 +2104,12 @@ def main() -> None:
         "trace_overhead_pct": None,
         "trace_server_records": None,
         "trace_rid_links": None,
+        "ledger_on_step_ms": None,
+        "ledger_off_step_ms": None,
+        "ledger_overhead_pct": None,
+        "ledger_mfu": None,
+        "ledger_overlap_frac": None,
+        "ledger_wire_efficiency": None,
         "stream_on_step_ms": None,
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
@@ -2162,6 +2318,12 @@ def main() -> None:
                             # with the equal-fold_bytes counter proof —
                             # in the runs-first group (new driver key)
                             ("fold_ab", 240.0),
+                            # efficiency-ledger A/B: cost-model pricing
+                            # + perf archive on vs BYTEPS_LEDGER=0,
+                            # <=2% overhead bar with the engaged-proof
+                            # (non-null mfu/overlap/wire-efficiency) —
+                            # in the runs-first group (new driver key)
+                            ("ledger_ab", 240.0),
                             ("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback
@@ -2245,6 +2407,8 @@ def main() -> None:
     if result["value"] is not None:
         result["vs_baseline"] = round(result["value"]
                                       / BASELINE_TOKENS_PER_SEC, 4)
+    if baseline_path:
+        result["perf_gate"] = _perf_gate_summary(baseline_path, result)
     print(json.dumps(_snapshot(final=True)), flush=True)
 
 

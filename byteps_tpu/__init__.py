@@ -39,8 +39,8 @@ __all__ = [
     "push_pull", "push_pull_async", "poll", "synchronize", "broadcast",
     "declare_tensor", "profiler_step",
     "get_pushpull_speed", "get_metrics", "get_step_reports",
-    "get_arena_stats", "get_fleet_metrics", "dump_flight_record",
-    "dump_fused_trace",
+    "get_arena_stats", "get_fleet_metrics", "get_ledger",
+    "dump_flight_record", "dump_fused_trace",
     "Config", "DataType", "QueueType", "Status",
 ]
 
@@ -169,6 +169,23 @@ def get_fleet_metrics() -> dict:
     series, so scraping and calling can never disagree
     (docs/observability.md)."""
     return get_metrics()
+
+
+def get_ledger() -> dict:
+    """The step efficiency ledger's snapshot (core/ledger.py;
+    docs/observability.md "Step efficiency ledger"): the registered
+    cost model (XLA cost-analysis FLOPs/bytes, ideal exchange bytes,
+    ``source``), the resolved device peak (``peak_flops`` /
+    ``peak_bw_gbps`` / ``peak_source``), the cost model's attainable-
+    MFU ``roofline_frac``, and the perf archive's path + record
+    counters (``BYTEPS_PERF_ARCHIVE``). Identical to
+    ``get_metrics()["ledger"]``; the per-STEP efficiency fields
+    (``mfu``, ``overlap_frac``, ``wire_efficiency``) ride each
+    ``StepReport`` — see ``get_step_reports()``."""
+    state = get_state()
+    if state.ledger is None:
+        return {"enabled": False}
+    return state.ledger.snapshot()
 
 
 def dump_flight_record(path: Optional[str] = None) -> Optional[str]:

@@ -246,6 +246,24 @@ class Config:
     stall_diag: bool = False              # BYTEPS_STALL_DIAG
     step_report_window: int = 64          # BYTEPS_STEP_REPORTS
 
+    # --- step efficiency ledger (rebuild addition; core/ledger.py,
+    # docs/observability.md "Step efficiency ledger"). On: the train
+    # layer registers each plan's XLA cost-analysis FLOPs/bytes + ideal
+    # exchange bytes, and every StepReport is priced in MFU / roofline /
+    # overlap-fraction / wire-efficiency terms against the device-kind
+    # peak table (peak_flops/peak_bw_gbps override auto-detection);
+    # perf_archive appends a compact JSONL efficiency record per step
+    # (flushed every perf_flush_steps, at shutdown and on SIGTERM);
+    # eff_drop_frac/_window drive the efficiency_drop flight event
+    # (mfu/overlap falling below the trailing-window median). ---
+    ledger: bool = True                   # BYTEPS_LEDGER
+    peak_flops: float = 0.0               # BYTEPS_PEAK_FLOPS (0 = auto)
+    peak_bw_gbps: float = 0.0             # BYTEPS_PEAK_BW_GBPS (0 = auto)
+    perf_archive: str = ""                # BYTEPS_PERF_ARCHIVE ("" = off)
+    perf_flush_steps: int = 32            # BYTEPS_PERF_FLUSH_STEPS
+    eff_drop_frac: float = 0.25           # BYTEPS_EFF_DROP_FRAC
+    eff_drop_window: int = 16             # BYTEPS_EFF_DROP_WINDOW
+
     # --- multi-process runtime (SURVEY §2.4: scheduler rendezvous ->
     # jax.distributed coordination service) ---
     num_processes: int = 1                # BYTEPS_NUM_PROCESS
@@ -315,6 +333,13 @@ class Config:
             metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),
             stall_diag=_env_bool("BYTEPS_STALL_DIAG"),
             step_report_window=_env_int("BYTEPS_STEP_REPORTS", 64),
+            ledger=_env_bool("BYTEPS_LEDGER", True),
+            peak_flops=float(_env_str("BYTEPS_PEAK_FLOPS", "0")),
+            peak_bw_gbps=float(_env_str("BYTEPS_PEAK_BW_GBPS", "0")),
+            perf_archive=_env_str("BYTEPS_PERF_ARCHIVE", ""),
+            perf_flush_steps=_env_int("BYTEPS_PERF_FLUSH_STEPS", 32),
+            eff_drop_frac=float(_env_str("BYTEPS_EFF_DROP_FRAC", "0.25")),
+            eff_drop_window=_env_int("BYTEPS_EFF_DROP_WINDOW", 16),
             num_processes=_env_int("BYTEPS_NUM_PROCESS", 1),
             process_id=_env_int("BYTEPS_PROCESS_ID",
                                 _env_int("DMLC_WORKER_ID", 0)),

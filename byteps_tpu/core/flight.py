@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 __all__ = [
     "FlightRecorder", "configure", "get_recorder", "record",
     "set_server_collector", "dump", "install_signal_handler",
+    "add_term_hook",
 ]
 
 
@@ -87,6 +88,10 @@ _recorder = FlightRecorder(enabled=False)
 # core/state.py when a PS client with the control ops is connected;
 # best-effort (a dead fleet dumps worker events alone)
 _server_collector: Optional[Callable[[], list]] = None
+# best-effort extra work on the SIGTERM path (e.g. the perf archive's
+# flush, core/ledger.py), run BEFORE the flight dump; reset per
+# configure() so a re-init never accumulates stale hooks
+_term_hooks: List[Callable[[], None]] = []
 _dump_dir = "./flight"
 _prev_sigterm = None
 _handler_installed = False
@@ -100,7 +105,14 @@ def configure(capacity: int = 2048, enabled: bool = True,
     _recorder = FlightRecorder(capacity=capacity, enabled=enabled)
     _dump_dir = dump_dir
     _server_collector = None
+    del _term_hooks[:]
     return _recorder
+
+
+def add_term_hook(fn: Callable[[], None]) -> None:
+    """Register extra SIGTERM-path work (perf-archive flush): runs in
+    registration order before the flight dump, each hook best-effort."""
+    _term_hooks.append(fn)
 
 
 def get_recorder() -> FlightRecorder:
@@ -185,6 +197,11 @@ def install_signal_handler() -> None:
         return
 
     def _on_term(signum, frame):
+        for hook in list(_term_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - hooks must not block dump
+                pass
         path = dump(reason="SIGTERM")
         if path:
             import sys

@@ -320,6 +320,23 @@ class StepReport:
     server_queue_ms: Optional[float] = None
     server_fold_ms: Optional[float] = None
     server_reply_ms: Optional[float] = None
+    # Step efficiency ledger (core/ledger.py): the step priced against
+    # its registered cost model. achieved_flops = cost-model FLOPs /
+    # wall; mfu = achieved / device-kind peak (BYTEPS_PEAK_FLOPS
+    # overrides); roofline_frac = the cost model's attainable-MFU bound
+    # (arithmetic intensity × bandwidth, capped at peak); overlap_frac
+    # = fraction of this step's wire time hidden under compute (union
+    # of the scheduler's wire spans ∩ the compute interval);
+    # wire_efficiency = ideal exchange bytes ÷ actual wire bytes
+    # (wire_bytes, the step's counter delta). All None when the ledger
+    # is off (BYTEPS_LEDGER=0) or its input is absent — never a silent
+    # zero.
+    achieved_flops: Optional[float] = None
+    mfu: Optional[float] = None
+    roofline_frac: Optional[float] = None
+    overlap_frac: Optional[float] = None
+    wire_efficiency: Optional[float] = None
+    wire_bytes: Optional[int] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -416,6 +433,20 @@ def classify_step(r: StepReport) -> str:
         extras.append(f"ttfp {r.ttfp_ms:.1f}ms")
     if extras:
         msg += "; " + ", ".join(extras)
+    # efficiency verdict (step efficiency ledger, core/ledger.py):
+    # "MFU 0.31 of 0.58 roofline; overlap 62%; wire 1.9x ideal"
+    effs = []
+    if r.mfu is not None:
+        e = f"MFU {r.mfu:.2f}"
+        if r.roofline_frac:
+            e += f" of {r.roofline_frac:.2f} roofline"
+        effs.append(e)
+    if r.overlap_frac is not None:
+        effs.append(f"overlap {r.overlap_frac * 100:.0f}%")
+    if r.wire_efficiency:
+        effs.append(f"wire {1.0 / r.wire_efficiency:.1f}x ideal")
+    if effs:
+        msg += "; " + "; ".join(effs)
     return msg
 
 
@@ -426,7 +457,8 @@ class _StepBuilder:
     not per-byte — contention is negligible)."""
 
     __slots__ = ("step", "t0", "_mu", "stage_samples", "queue_peak",
-                 "credit_stalls", "marks", "pull_wait_s", "fleet_base")
+                 "credit_stalls", "marks", "pull_wait_s", "fleet_base",
+                 "wire_spans", "wire_base", "monolithic")
 
     def __init__(self, step: int):
         self.step = step
@@ -434,6 +466,15 @@ class _StepBuilder:
         # fleet per-stage counter snapshot at step start (train-thread
         # only, set by StepProfiler.begin_step); None = no probe
         self.fleet_base: Optional[Dict[str, int]] = None
+        # wire byte-counter snapshot at step start (train-thread only,
+        # set by StepProfiler.begin_step); None = no ledger
+        self.wire_base: Optional[int] = None
+        # reduced-shape round (device-compressed tier): compute and
+        # wire are one monolithic helper, so export_done lands AFTER
+        # the wire — every span would read as "hidden under compute"
+        # and fabricate overlap_frac 1.0. Set by the train thread;
+        # overlap then prices as None, like the tier's other fields.
+        self.monolithic = False
         self._mu = threading.Lock()
         # stage samples / queue peak / stalls arrive from scheduler pool
         # threads; marks and pull_wait_s are train-thread-only by
@@ -441,12 +482,22 @@ class _StepBuilder:
         self.stage_samples: Dict[str, List[float]] = {}  # guarded-by: _mu
         self.queue_peak = 0                              # guarded-by: _mu
         self.credit_stalls = 0                           # guarded-by: _mu
+        # wire exchange intervals relative to step start, fed by the
+        # scheduler's completion callbacks — the ledger's overlap
+        # timeline (core/ledger.py overlap_fraction)
+        self.wire_spans: List[tuple] = []                # guarded-by: _mu
         self.marks: Dict[str, float] = {}
         self.pull_wait_s = 0.0
 
     def stage_sample(self, stage: str, seconds: float) -> None:
         with self._mu:
             self.stage_samples.setdefault(stage, []).append(seconds * 1e3)
+
+    def wire_span(self, start: float, end: float) -> None:
+        """One wire exchange's absolute (perf_counter) interval, stored
+        relative to step start for the ledger's overlap accounting."""
+        with self._mu:
+            self.wire_spans.append((start - self.t0, end - self.t0))
 
     def queue_depth(self, depth: int) -> None:
         with self._mu:
@@ -475,11 +526,18 @@ class StepProfiler:
 
     def __init__(self, window: int = 64, enabled: bool = True,
                  stall_diag: bool = False, tracer=None,
-                 fleet_probe=None):
+                 fleet_probe=None, ledger=None):
         import collections
         self.enabled = enabled
         self.stall_diag = stall_diag
         self._tracer = tracer
+        # step efficiency ledger (core/ledger.py): prices each finished
+        # step (MFU/roofline/overlap/wire-efficiency) from its
+        # registered cost model + the wire spans/byte deltas this
+        # profiler collects. None (or disabled) = fields stay None.
+        self._ledger = ledger if (ledger is not None
+                                  and getattr(ledger, "enabled", False)) \
+            else None
         # () -> {"recv_ns", "queue_ns", "fold_ns", "reply_ns"} summed
         # over the reachable fleet (in-process mirror or STATS_PULL),
         # or None. Snapshotted at both step boundaries; the deltas are
@@ -522,6 +580,11 @@ class StepProfiler:
         self._probe_cache = None
         if cur.fleet_base is None:
             cur.fleet_base = self._probe_fleet()
+        if self._ledger is not None:
+            try:
+                cur.wire_base = self._ledger.wire_bytes_total()
+            except Exception:  # noqa: BLE001 - pricing is best-effort
+                cur.wire_base = None
         return cur
 
     def current(self) -> Optional[_StepBuilder]:
@@ -552,6 +615,19 @@ class StepProfiler:
                        for k in ("recv_ns", "queue_ns", "fold_ns",
                                  "reply_ns")}
         pull_total = sum(samples.get("PULL", [])) if srv else None
+        # step efficiency ledger: price the step from the registered
+        # cost model + this step's wire spans and wire byte delta
+        eff: dict = {}
+        if self._ledger is not None:
+            with b._mu:
+                spans = [] if b.monolithic else list(b.wire_spans)
+            try:
+                eff = self._ledger.step_efficiency(
+                    wall_s=wall / 1e3,
+                    compute_end_s=b.marks.get("export_done", 0.0),
+                    wire_spans=spans, wire_base=b.wire_base) or {}
+            except Exception:  # noqa: BLE001 - pricing is best-effort
+                eff = {}
         r = StepReport(
             step=b.step,
             wall_ms=wall,
@@ -577,6 +653,12 @@ class StepProfiler:
             server_queue_ms=srv.get("queue_ns"),
             server_fold_ms=srv.get("fold_ns"),
             server_reply_ms=srv.get("reply_ns"),
+            achieved_flops=eff.get("achieved_flops"),
+            mfu=eff.get("mfu"),
+            roofline_frac=eff.get("roofline_frac"),
+            overlap_frac=eff.get("overlap_frac"),
+            wire_efficiency=eff.get("wire_efficiency"),
+            wire_bytes=eff.get("wire_bytes"),
         )
         with self._mu:
             self._reports.append(r)

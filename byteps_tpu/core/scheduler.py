@@ -726,6 +726,12 @@ class PipelineScheduler:
         prof = self._profiler.current() if self._profiler else None
         if prof is not None:
             prof.stage_sample(stage, dt)
+            if stage == "PULL":
+                # the efficiency ledger's overlap timeline: a PULL
+                # sample spans submit→completion (wire + aggregation
+                # wait on both the fused and two-op paths), so the
+                # interval is the step's wire occupancy
+                prof.wire_span(t0, t0 + dt)
 
     # ---- bounded retry + server failover ------------------------------ #
 

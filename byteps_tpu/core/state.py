@@ -162,6 +162,7 @@ class GlobalState:
         self.handles = None          # HandleManager for the async API
         self.codec_plane = None      # adaptive codec plane (codec_plane.py)
         self.autoscaler = None       # autoscaler plane (autoscaler.py)
+        self.ledger = None           # step efficiency ledger (ledger.py)
         # server spawn hook for the autoscaler's acting "add" path:
         # fn(index) -> "host:port" of a freshly-started server (or None
         # to decline); survives re-init (operator wiring, not lifecycle
@@ -238,9 +239,24 @@ class GlobalState:
                 enabled=self.config.flight_recorder,
                 dump_dir=self.config.flight_dir)
             self.metrics.section("flight", self.flight.snapshot)
-            if self.config.flight_recorder:
-                flight_mod.install_signal_handler()
             flight_mod.set_server_collector(self._collect_server_flight)
+            # step efficiency ledger (core/ledger.py): fresh per
+            # lifecycle like the metrics plane — the train layer
+            # registers each plan's cost model on it, the profiler
+            # prices every step against it, and its observer hook
+            # drives the perf archive + efficiency_drop flight events
+            from .ledger import EfficiencyLedger, register_ledger_metrics
+            register_ledger_metrics(self.metrics)
+            self.ledger = EfficiencyLedger(self.config, self.metrics)
+            self.metrics.section("ledger", self.ledger.snapshot)
+            if self.config.flight_recorder or self.ledger.archive_enabled:
+                flight_mod.install_signal_handler()
+            if self.ledger.archive_enabled:
+                # the archive flushes on SIGTERM alongside the flight
+                # dump (one handler, hooks run first; term_flush uses a
+                # bounded lock acquire — the signal may have landed on
+                # the thread that holds the archive lock mid-append)
+                flight_mod.add_term_hook(self.ledger.term_flush)
             # codec-plane instruments exist on every deployment (the
             # docs/observability.md schema guard resolves them), whether
             # or not the adaptive plane itself is enabled below
@@ -304,8 +320,14 @@ class GlobalState:
                 enabled=self.config.metrics_on,
                 stall_diag=self.config.stall_diag,
                 tracer=self.tracer,
-                fleet_probe=self._fleet_stage_probe)
+                fleet_probe=self._fleet_stage_probe,
+                ledger=self.ledger)
             self.metrics.section("steps", self.profiler.snapshot)
+            if self.ledger is not None and self.ledger.enabled:
+                # archive append + efficiency-drop detection per
+                # finished step, on the train thread like the
+                # autoscaler's sensor tap
+                self.profiler.add_observer(self.ledger.on_step)
             if self.tracer is not None:
                 # fused-timeline hook: Tracer.dump() drains every
                 # server's wire-sampled span ring + clock offset
@@ -421,6 +443,11 @@ class GlobalState:
                 except Exception as e:  # noqa: BLE001
                     log.warning("jax.profiler.stop_trace failed: %s", e)
                 self._jax_profiling = False
+            if self.ledger is not None:
+                try:
+                    self.ledger.close()  # flush the perf archive tail
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
             # free the pinned staging bytes (slots are rebuilt lazily
             # by the next init's first submissions)
             self.arena.reset()

@@ -797,6 +797,43 @@ def make_ps_train_step(
                 str(getattr(k, "key", getattr(k, "idx", k)))
                 for k in path))
             p_leaves.append(leaf)
+        # ---- step efficiency ledger (core/ledger.py): register this
+        # plan's cost model ONCE per gradient-tree shape — XLA cost
+        # analysis of the compiled grad + apply units (lowering only:
+        # nothing executes, donated args stay live) plus the plan's
+        # ideal exchange bytes (each leaf crosses the wire once each
+        # way), so end_step prices every step in MFU / roofline /
+        # wire-efficiency terms. A backend without a cost model
+        # registers the wire sizes alone (MFU stays None, never 0).
+        ledger = getattr(state, "ledger", None)
+        if ledger is not None and ledger.enabled:
+            cost_key = (treedef, tuple(
+                (tuple(np.shape(pl)), str(getattr(pl, "dtype", "")))
+                for pl in p_leaves))
+            # keyed on the LEDGER INSTANCE too: suspend/resume replaces
+            # state.ledger, and a plan-key-only cache would leave the
+            # fresh ledger with no cost model (post-resume MFU None)
+            if (stream_state.get("cost_key") != cost_key
+                    or stream_state.get("cost_ledger") is not ledger):
+                stream_state["cost_key"] = cost_key
+                stream_state["cost_ledger"] = ledger
+                from ..core import ledger as ledger_mod
+                flops = acc_bytes = None
+                for part in (ledger_mod.jit_cost(grad_fn, params, batch),
+                             ledger_mod.jit_cost(apply_fn, params,
+                                                 opt_state, params)):
+                    if part:
+                        if part.get("flops"):
+                            flops = (flops or 0.0) + part["flops"]
+                        if part.get("bytes_accessed"):
+                            acc_bytes = (acc_bytes or 0.0) \
+                                + part["bytes_accessed"]
+                ledger.register_step_cost(
+                    flops=flops, bytes_accessed=acc_bytes,
+                    ideal_wire_bytes=2 * sum(
+                        int(getattr(pl, "nbytes", 0))
+                        for pl in p_leaves),
+                    source="xla" if flops else "none")
         use_device = (compression is not None
                       and device_compress is not False
                       and state.scheduler is not None)
@@ -809,7 +846,10 @@ def make_ps_train_step(
             if prof is not None:
                 # device tier: the round is monolithic (compute + wire
                 # inside one helper), so compute_ms covers through the
-                # round and the apply is the tail
+                # round and the apply is the tail; overlap_frac must
+                # price as None — export_done lands AFTER the wire
+                # here, so spans would fabricate "perfect overlap"
+                prof.monolithic = True
                 prof.mark("export_done")
                 prof.mark("drain_done")
             params, opt_state = apply_fn(params, opt_state, grads)

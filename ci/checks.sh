@@ -48,6 +48,25 @@ else:
     print(report if report else "[clang-tidy] clean")
 PY
 
+# advisory (never fails the gate): noise-aware perf regression check of
+# the newest parsed driver artifact against the committed baseline —
+# the sample histories in ci/perf_baseline.json define the noise band
+# (ci/perf_gate.py; docs/performance.md "Perf regression gate")
+candidate=$(ls "$ROOT"/BENCH_r*.json 2>/dev/null | sort | tail -1)
+echo
+if [ -n "$candidate" ]; then
+  echo "=== [perf-gate] advisory: $(basename "$candidate") vs ci/perf_baseline.json"
+  python ci/perf_gate.py --baseline ci/perf_baseline.json \
+    --candidate "$candidate"
+  case $? in
+    0) ;;
+    1) echo "[perf-gate] regression flagged (advisory — does not fail the gate)" ;;
+    *) echo "[perf-gate] gate did not run (bad baseline/candidate; advisory)" ;;
+  esac
+else
+  echo "=== [perf-gate] no BENCH_r*.json candidate; skipping (advisory)"
+fi
+
 # slow markers included: the sanitize tier IS the slow TSAN/ASAN burst
 # plus the fast Waiter-pool smoke; it builds its own instrumented libs
 run_stage "sanitize" env JAX_PLATFORMS=cpu \
