@@ -58,6 +58,12 @@ Extra keys in the same line:
   StepReport). ``--baseline FILE`` additionally runs the noise-aware
   perf regression gate (ci/perf_gate.py) over the final snapshot and
   attaches its verdict as ``perf_gate``.
+- ``health_on_step_ms`` / ``health_off_step_ms`` — steady-state PS
+  train step wall with the training-health plane (BYTEPS_HEALTH,
+  core/health.py + the native in-fold statistics pass) on vs off,
+  plus the engaged-proof (``health_grad_norm`` non-null from the ON
+  arm's last StepReport, ``health_infold_rounds`` nonzero from the
+  server's stat slots). Acceptance bar: ``health_overhead_pct`` <= 2.
 - ``stream_on_step_ms`` / ``stream_off_step_ms`` and
   ``stream_ttfp_on_ms`` / ``stream_ttfp_off_ms`` — the
   COMPUTE/PUSH/UPDATE pipeline A/B (BYTEPS_STREAM_EXPORT +
@@ -1315,6 +1321,93 @@ def phase_ledger_ab(steps: int = 6, reps: int = 3) -> dict:
             "ledger_verdict_named": proof.get("verdict")}
 
 
+def phase_health_ab(steps: int = 6, reps: int = 3) -> dict:
+    """A/B the training-health plane (core/health.py + the native
+    in-fold statistics pass, BYTEPS_HEALTH) on the PS train step's
+    steady state: the same model/batch trained through the loopback PS
+    with the fused in-fold stats + drain tap + detector running vs
+    BYTEPS_HEALTH=0, INTERLEAVED reps (host-load drift lands on both
+    arms), best-of step wall per arm. The acceptance bar is overhead
+    <= 2% of step wall. The ON arm also proves the plane ENGAGED (not
+    vacuously cheap): the last StepReport must carry a non-null
+    ``grad_norm``/``update_ratio_p95`` with zero nonfinite leaves, the
+    server's in-fold stat slots (``health_rounds``) must be nonzero,
+    and the step diagnosis must name the health verdict."""
+    import gc
+
+    def run(enabled: bool, walls: list, proof: dict):
+        os.environ["BYTEPS_HEALTH"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # the metrics_ab layout: 4MB leaves ride their own keys
+            # through the drain tap, biases keep the fused bucket
+            params = {f"w{i}": _cpu_put(
+                rng.randn(1024, 1024).astype(np.float32))
+                for i in range(4)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(1024).astype(np.float32)) for i in range(4)})
+            batch = _cpu_put(rng.randn(32, 1024).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(4):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.sgd(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, pnorm build
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                walls.append(time.perf_counter() - t0)
+            if enabled and not proof:
+                last = bps.get_step_reports()[-1]
+                proof["grad_norm"] = last["grad_norm"]
+                proof["update_ratio_p95"] = last["update_ratio_p95"]
+                proof["nonfinite_leaves"] = last["nonfinite_leaves"]
+                srv = bps.get_metrics().get("server", {})
+                proof["infold_rounds"] = srv.get("health_rounds")
+                diag = bps.get_metrics()["steps"].get(
+                    "last_diagnosis", "")
+                proof["verdict"] = "health" in diag.lower()
+
+    prior = os.environ.get("BYTEPS_HEALTH")
+    on_walls, off_walls, proof = [], [], {}
+    try:
+        for _ in range(reps):
+            run(True, on_walls, proof)
+            run(False, off_walls, {})
+    finally:
+        if prior is None:
+            os.environ.pop("BYTEPS_HEALTH", None)
+        else:
+            os.environ["BYTEPS_HEALTH"] = prior
+    on_ms = min(on_walls) * 1e3
+    off_ms = min(off_walls) * 1e3
+    return {"health_on_step_ms": round(on_ms, 2),
+            "health_off_step_ms": round(off_ms, 2),
+            "health_overhead_pct": round(
+                (on_ms - off_ms) / off_ms * 100.0, 2) if off_ms else None,
+            "health_grad_norm": proof.get("grad_norm"),
+            "health_update_ratio_p95": proof.get("update_ratio_p95"),
+            "health_nonfinite_leaves": proof.get("nonfinite_leaves"),
+            "health_infold_rounds": proof.get("infold_rounds"),
+            "health_verdict_named": proof.get("verdict")}
+
+
 def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
     """A/B the fused PUSHPULL wire op (BYTEPS_FUSED_PUSHPULL,
     native/ps.cc PUSHPULL + the completion-reactor client) on the PS
@@ -1942,6 +2035,7 @@ _PHASES = {
     "metrics_ab": phase_metrics_ab,
     "trace_ab": phase_trace_ab,
     "ledger_ab": phase_ledger_ab,
+    "health_ab": phase_health_ab,
     "stream_ab": phase_stream_ab,
     "wire_ab": phase_wire_ab,
     "fold_ab": phase_fold_ab,
@@ -2110,6 +2204,11 @@ def main() -> None:
         "ledger_mfu": None,
         "ledger_overlap_frac": None,
         "ledger_wire_efficiency": None,
+        "health_on_step_ms": None,
+        "health_off_step_ms": None,
+        "health_overhead_pct": None,
+        "health_grad_norm": None,
+        "health_infold_rounds": None,
         "stream_on_step_ms": None,
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
@@ -2324,6 +2423,13 @@ def main() -> None:
                             # (non-null mfu/overlap/wire-efficiency) —
                             # in the runs-first group (new driver key)
                             ("ledger_ab", 240.0),
+                            # training-health A/B: in-fold stats +
+                            # drain tap + detector on vs BYTEPS_HEALTH
+                            # =0, <=2% overhead bar with the engaged-
+                            # proof (non-null grad_norm, nonzero
+                            # in-fold health_rounds slot) — in the
+                            # runs-first group (new driver key)
+                            ("health_ab", 240.0),
                             ("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback

@@ -256,6 +256,24 @@ class Config:
     # (flushed every perf_flush_steps, at shutdown and on SIGTERM);
     # eff_drop_frac/_window drive the efficiency_drop flight event
     # (mfu/overlap falling below the trailing-window median). ---
+    # --- training-health plane (rebuild addition; core/health.py +
+    # native/ps.cc in-fold statistics, docs/observability.md
+    # "Training-health plane"). health=1 arms BOTH halves: the server's
+    # fused in-fold sum-of-squares/abs-max/NaN-Inf pass (read natively
+    # per Server instance) and the worker's drain tap + hysteresis
+    # detector (nonfinite / explode / collapse / fidelity-drift);
+    # nan_guard upgrades a nonfinite round to a fail-fast that dumps
+    # the flight record. The detector knobs mirror the codec
+    # controller's clockless streak/threshold shape. ---
+    health: bool = False                  # BYTEPS_HEALTH
+    nan_guard: bool = False               # BYTEPS_NAN_GUARD
+    health_window: int = 16               # BYTEPS_HEALTH_WINDOW
+    health_explode_ratio: float = 10.0    # BYTEPS_HEALTH_EXPLODE_RATIO
+    health_collapse_ratio: float = 0.01   # BYTEPS_HEALTH_COLLAPSE_RATIO
+    health_streak: int = 2                # BYTEPS_HEALTH_STREAK
+    health_drift_frac: float = 0.1        # BYTEPS_HEALTH_DRIFT_FRAC
+    health_drift_keys: int = 8            # BYTEPS_HEALTH_DRIFT_KEYS
+
     ledger: bool = True                   # BYTEPS_LEDGER
     peak_flops: float = 0.0               # BYTEPS_PEAK_FLOPS (0 = auto)
     peak_bw_gbps: float = 0.0             # BYTEPS_PEAK_BW_GBPS (0 = auto)
@@ -333,6 +351,17 @@ class Config:
             metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),
             stall_diag=_env_bool("BYTEPS_STALL_DIAG"),
             step_report_window=_env_int("BYTEPS_STEP_REPORTS", 64),
+            health=_env_bool("BYTEPS_HEALTH"),
+            nan_guard=_env_bool("BYTEPS_NAN_GUARD"),
+            health_window=_env_int("BYTEPS_HEALTH_WINDOW", 16),
+            health_explode_ratio=float(
+                _env_str("BYTEPS_HEALTH_EXPLODE_RATIO", "10")),
+            health_collapse_ratio=float(
+                _env_str("BYTEPS_HEALTH_COLLAPSE_RATIO", "0.01")),
+            health_streak=_env_int("BYTEPS_HEALTH_STREAK", 2),
+            health_drift_frac=float(
+                _env_str("BYTEPS_HEALTH_DRIFT_FRAC", "0.1")),
+            health_drift_keys=_env_int("BYTEPS_HEALTH_DRIFT_KEYS", 8),
             ledger=_env_bool("BYTEPS_LEDGER", True),
             peak_flops=float(_env_str("BYTEPS_PEAK_FLOPS", "0")),
             peak_bw_gbps=float(_env_str("BYTEPS_PEAK_BW_GBPS", "0")),

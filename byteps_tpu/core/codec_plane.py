@@ -77,18 +77,27 @@ _LOSSY_TIERS = frozenset(("onebit", "topk", "randomk", "dithering"))
 
 @dataclasses.dataclass
 class RoundSignal:
-    """One round boundary's deterministic inputs: the step ordinal and
+    """One round boundary's deterministic inputs: the step ordinal,
     the stage walls the diagnosis compares (core/metrics.py
-    classify_step). Milliseconds."""
+    classify_step, milliseconds), and the training-health verdict
+    (``degraded`` = the HealthPlane detector flagged an anomaly this
+    step — core/health.py). The nonfinite/explode/collapse inputs are
+    post-aggregation statistics (identical on every worker); the
+    drift class is additionally control-RPC-dependent — either way
+    the veto is exactly as skew-safe as the perf signal that already
+    drives this plane: quiescent-boundary application + the server's
+    loud codec-tag gate."""
 
     step: int
     compute_ms: float
     pull_ms: float  # max(pull p95, aggregate drain pull-wait)
+    degraded: bool = False
 
     @classmethod
     def from_report(cls, r) -> "RoundSignal":
         return cls(step=r.step, compute_ms=r.compute_ms or 0.0,
-                   pull_ms=max(r.pull_p95_ms or 0.0, r.pull_wait_ms or 0.0))
+                   pull_ms=max(r.pull_p95_ms or 0.0, r.pull_wait_ms or 0.0),
+                   degraded=bool(getattr(r, "health_flags", None)))
 
 
 @dataclasses.dataclass
@@ -129,10 +138,42 @@ class CodecController:
         a 1.01x 'PULL-bound' verdict would thrash the ladder)."""
         return sig.pull_ms > self.pull_ratio * max(sig.compute_ms, 1e-9)
 
+    def safe_rung(self, rung: int) -> Optional[int]:
+        """The highest numerics-safe (non-lossy) rung at or below
+        ``rung`` — where the health veto de-escalates to: ``lossless``
+        when the ladder carries it (bitwise round-trip, so it keeps
+        the wire win), else ``dense``. None when the operator built an
+        all-lossy ladder: there is nowhere safe to go, so the veto can
+        only hold (escalation stays blocked) rather than thrash."""
+        for i in range(min(rung, len(self.ladder) - 1), -1, -1):
+            if self.ladder[i] not in _LOSSY_TIERS:
+                return i
+        return None
+
     def decide(self, plan: CodecPlan, sig: RoundSignal) -> Optional[str]:
         """Advance ``plan``'s streaks with one round's signal; returns
         the tier to switch to, or None to hold. Deterministic: a pure
-        function of (plan state, signal)."""
+        function of (plan state, signal).
+
+        The numerics veto (core/health.py): a ``degraded`` signal can
+        NEVER escalate — and when the plan sits on a lossy rung it
+        de-escalates immediately (no down-streak wait) to the highest
+        numerics-safe rung, jumping rungs if it must. Perf pressure
+        resumes walking the ladder only after the health plane reads
+        healthy again — convergence outranks wire bytes."""
+        if sig.degraded:
+            plan.up_streak = 0
+            plan.down_streak = 0
+            if self.ladder[plan.rung] in _LOSSY_TIERS:
+                safe = self.safe_rung(plan.rung)
+                # no safe rung below (all-lossy ladder) or already
+                # there: hold — returning the same tier every degraded
+                # round would read as a switch per round and spam the
+                # apply path without changing anything
+                if safe is not None and safe != plan.rung:
+                    plan.rung = safe
+                    return self.ladder[safe]
+            return None
         if self.pull_bound(sig):
             plan.up_streak += 1
             plan.down_streak = 0
@@ -156,6 +197,7 @@ def register_codec_metrics(metrics) -> None:
     docs/observability.md schema resolves them on every deployment,
     adaptive or not (the same contract as the wire/retries family)."""
     metrics.counter("codec/switches")
+    metrics.counter("codec/health_vetoes")
     metrics.counter("codec/lossless_bytes_pre")
     metrics.counter("codec/lossless_bytes_post")
     for tier in ("dense", "lossless", "onebit", "randomk"):
@@ -208,6 +250,7 @@ class CodecPlane:
         if metrics is not None:
             register_codec_metrics(metrics)
             self._m_switches = metrics.counter("codec/switches")
+            self._m_vetoes = metrics.counter("codec/health_vetoes")
             pre = metrics.counter("codec/lossless_bytes_pre")
             post = metrics.counter("codec/lossless_bytes_post")
             metrics.gauge("codec/lossless_ratio").set_fn(
@@ -217,6 +260,7 @@ class CodecPlane:
                     lambda t=tier: self._active_count(t))
         else:
             self._m_switches = None
+            self._m_vetoes = None
 
     # ------------------------------------------------------------------ #
     # signal intake
@@ -229,12 +273,31 @@ class CodecPlane:
         for drivers with out-of-band signals; the scheduler path feeds
         it automatically from the StepReport ring."""
         switched = []
+        vetoed = False
         with self._mu:
             for name in sorted(self._adaptive_names):
                 plan = self._registry.codec_plan(name)
+                on_lossy = self._controller.ladder[plan.rung] \
+                    in _LOSSY_TIERS
                 tier = self._controller.decide(plan, sig)
+                if sig.degraded and (on_lossy or tier is None):
+                    vetoed = True
                 if tier is not None:
                     switched.append((name, tier))
+        if vetoed:
+            # the numerics veto engaged: escalation suppressed and/or
+            # lossy rungs forced down — the first consumer of a
+            # training-health signal (docs/compression.md)
+            if self._m_vetoes is not None:
+                self._m_vetoes.inc()
+            from . import flight
+            flight.record(
+                "codec_health_veto", key=sig.step,
+                detail=f"health-degraded signal at step {sig.step}: "
+                       f"escalation vetoed"
+                       + (f"; forced de-escalation of "
+                          f"{len(switched)} leaves"
+                          if switched else ""))
         return switched
 
     def _ingest_reports(self) -> None:

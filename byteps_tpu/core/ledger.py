@@ -479,7 +479,14 @@ class EfficiencyLedger:
         for k in ("step", "wall_ms", "compute_ms", "drain_ms",
                   "ttfp_ms", "pull_p95_ms", "achieved_flops", "mfu",
                   "overlap_frac", "wire_efficiency", "wire_bytes",
-                  "queue_depth_peak", "credit_stalls"):
+                  "queue_depth_peak", "credit_stalls",
+                  # training-health fields (core/health.py): archived
+                  # so a perf record also tells you whether the run
+                  # was numerically sane; ci/perf_gate.py skips
+                  # grad_norm/update_ratio_p95 (no better-direction)
+                  # and reads nonfinite_leaves lower-is-better
+                  "grad_norm", "update_ratio_p95", "nonfinite_leaves",
+                  "fidelity_drift"):
             v = getattr(report, k, None)
             if isinstance(v, float):
                 v = round(v, 6)

@@ -337,6 +337,22 @@ class StepReport:
     overlap_frac: Optional[float] = None
     wire_efficiency: Optional[float] = None
     wire_bytes: Optional[int] = None
+    # Training-health plane (core/health.py, BYTEPS_HEALTH): per-step
+    # numerics statistics tapped off the sharded-apply drain —
+    # grad_norm is the global post-aggregation gradient norm,
+    # update_ratio_p95 the p95 per-leaf ||g||/||p|| trust-ratio proxy,
+    # nonfinite_leaves how many leaves carried NaN/Inf, and
+    # fidelity_drift the worst server-vs-worker aggregate-norm
+    # divergence over lossy-codec leaves. health_flags is the
+    # detector's verdict for this step (tuple of anomaly-class names,
+    # () = checked and healthy), stamped by the HealthPlane observer —
+    # the codec plane's numerics veto reads it. All None when the
+    # health pass is off — never a silent 0.
+    grad_norm: Optional[float] = None
+    update_ratio_p95: Optional[float] = None
+    nonfinite_leaves: Optional[int] = None
+    fidelity_drift: Optional[float] = None
+    health_flags: Optional[tuple] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -447,6 +463,24 @@ def classify_step(r: StepReport) -> str:
         effs.append(f"wire {1.0 / r.wire_efficiency:.1f}x ideal")
     if effs:
         msg += "; " + "; ".join(effs)
+    # training-health verdict (core/health.py): "health: grad_norm
+    # 0.031, update p95 2.1e-4" on a healthy step; anomalies upgrade it
+    # to "HEALTH nonfinite,explode: 3 nonfinite leaves, ..."
+    if r.grad_norm is not None or r.nonfinite_leaves:
+        hp = []
+        if r.nonfinite_leaves:
+            hp.append(f"{r.nonfinite_leaves} nonfinite leaves")
+        if r.grad_norm is not None:
+            hp.append(f"grad_norm {r.grad_norm:.3g}")
+        if r.update_ratio_p95 is not None:
+            hp.append(f"update p95 {r.update_ratio_p95:.2g}")
+        if r.fidelity_drift is not None:
+            hp.append(f"drift {r.fidelity_drift * 100:.1f}%")
+        if r.health_flags:
+            msg += ("; HEALTH " + ",".join(r.health_flags) + ": "
+                    + ", ".join(hp))
+        else:
+            msg += "; health: " + ", ".join(hp)
     return msg
 
 
@@ -595,7 +629,8 @@ class StepProfiler:
         return self._current  # bps-lint: disable=guarded-by
 
     def end_step(self, b: Optional[_StepBuilder], ttfp_ms=None,
-                 streamed: int = 0, fallback: int = 0) -> Optional[StepReport]:
+                 streamed: int = 0, fallback: int = 0,
+                 health: Optional[dict] = None) -> Optional[StepReport]:
         if b is None:
             return None
         wall = (time.perf_counter() - b.t0) * 1e3
@@ -659,6 +694,10 @@ class StepProfiler:
             overlap_frac=eff.get("overlap_frac"),
             wire_efficiency=eff.get("wire_efficiency"),
             wire_bytes=eff.get("wire_bytes"),
+            grad_norm=(health or {}).get("grad_norm"),
+            update_ratio_p95=(health or {}).get("update_ratio_p95"),
+            nonfinite_leaves=(health or {}).get("nonfinite_leaves"),
+            fidelity_drift=(health or {}).get("fidelity_drift"),
         )
         with self._mu:
             self._reports.append(r)

@@ -163,6 +163,7 @@ class GlobalState:
         self.codec_plane = None      # adaptive codec plane (codec_plane.py)
         self.autoscaler = None       # autoscaler plane (autoscaler.py)
         self.ledger = None           # step efficiency ledger (ledger.py)
+        self.health = None           # training-health plane (health.py)
         # server spawn hook for the autoscaler's acting "add" path:
         # fn(index) -> "host:port" of a freshly-started server (or None
         # to decline); survives re-init (operator wiring, not lifecycle
@@ -262,6 +263,13 @@ class GlobalState:
             # or not the adaptive plane itself is enabled below
             from .codec_plane import register_codec_metrics
             register_codec_metrics(self.metrics)
+            # training-health plane (core/health.py, BYTEPS_HEALTH):
+            # instruments are eager like the codec family; the plane
+            # itself is constructed per lifecycle (fresh detector
+            # streaks) and observes steps only when enabled
+            from .health import HealthPlane, register_health_metrics
+            register_health_metrics(self.metrics)
+            self.health = HealthPlane(self.config, self.metrics)
             # elastic-lifecycle instruments too (registry/joins,
             # registry/drains, autoscale/decisions, server/evictions):
             # eagerly created so healthy static fleets export documented
@@ -323,6 +331,12 @@ class GlobalState:
                 fleet_probe=self._fleet_stage_probe,
                 ledger=self.ledger)
             self.metrics.section("steps", self.profiler.snapshot)
+            if self.health is not None and self.health.enabled:
+                # FIRST observer: the detector stamps health_flags on
+                # the report before the ledger archives it and before
+                # any later observer (autoscaler) — and before the
+                # codec plane's lazy ingest reads the ring next round
+                self.profiler.add_observer(self.health.on_step)
             if self.ledger is not None and self.ledger.enabled:
                 # archive append + efficiency-drop detection per
                 # finished step, on the train thread like the

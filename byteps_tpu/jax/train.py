@@ -20,6 +20,7 @@ Two flavors:
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -166,6 +167,34 @@ _COMP_POOL = None
 _EXPORT_POOL = None
 _rowsparse_warned: set = set()  # names warned about dense fallback
 _stream_build_warned: list = []  # once-only streamed-export build warning
+_chaos_nan_fired: set = set()   # BYTEPS_CHAOS_NAN_LEAF specs consumed
+
+
+def _chaos_nan_poison(spec: str, name: str, flat, step_no: int):
+    """``BYTEPS_CHAOS_NAN_LEAF="<substr>[@<step>]"``: poison the first
+    matching leaf's push with one NaN at/after ``<step>`` (default 3),
+    ONCE per process per spec value — the chaos harness for the
+    training-health plane's detect → flight-event → guard causality
+    (core/health.py, tests/test_health.py). Returns the payload to
+    push (a poisoned copy, or ``flat`` untouched)."""
+    sub, _, at = spec.partition("@")
+    try:
+        at_step = int(at) if at else 3
+    except ValueError:
+        at_step = 3
+    if spec in _chaos_nan_fired or step_no < at_step \
+            or not sub or sub not in name:
+        return flat
+    _chaos_nan_fired.add(spec)
+    poisoned = np.array(flat, copy=True)
+    poisoned.reshape(-1)[0] = np.nan
+    from ..core import flight
+    flight.record("chaos_nan_injected",
+                  detail=f"{name} step={step_no} spec={spec}")
+    from ..utils.logging import log
+    log.warning("CHAOS: injected NaN into %r push at step %d "
+                "(BYTEPS_CHAOS_NAN_LEAF=%s)", name, step_no, spec)
+    return poisoned
 
 
 def _export_pool():
@@ -855,6 +884,38 @@ def make_ps_train_step(
             params, opt_state = apply_fn(params, opt_state, grads)
             state.profiler.end_step(prof, fallback=len(names))
             return params, opt_state, loss
+        # ---- training-health collection (core/health.py,
+        # BYTEPS_HEALTH): per-leaf gradient statistics accumulate off
+        # the drain as each pulled aggregate lands; the param-norm
+        # program (one tiny jit, len(names) floats D2H) feeds the
+        # update-to-param ratios. Host tier only — the
+        # device-compressed round never materializes the aggregate
+        # host-side, so its health fields stay None, never a wrong 0.
+        hplane = getattr(state, "health", None)
+        # prof gates too: the detector/guard run from end_step's
+        # observer hook, so without an open step report the collection
+        # would be cost with no consumer (HealthPlane also refuses to
+        # arm under BYTEPS_METRICS=0 — this is the per-step mirror)
+        hc = hplane.begin_collect(len(names)) \
+            if hplane is not None and prof is not None else None
+        if hc is not None:
+            pnorm_key = stream_state.get("pnorm_key")
+            # identity-or-equality: PyTreeDef.__ne__ rejects None
+            if pnorm_key is None or pnorm_key != treedef:
+                def _pnorms(leaves):
+                    return jnp.sqrt(jnp.asarray(
+                        [jnp.sum(jnp.square(x.astype(jnp.float32)))
+                         for x in leaves]))
+                stream_state["pnorm_fn"] = jax.jit(_pnorms)
+                stream_state["pnorm_key"] = treedef
+            try:
+                hc.param_norms_dev = stream_state["pnorm_fn"](
+                    list(p_leaves))
+            except Exception:  # noqa: BLE001 - ratios degrade to None
+                hc.param_norms_dev = None
+        # chaos harness: BYTEPS_CHAOS_NAN_LEAF poisons one matching
+        # leaf's push mid-run (see _chaos_nan_poison)
+        chaos_nan = os.environ.get("BYTEPS_CHAOS_NAN_LEAF") or None
         # ---- host tier: dense D2H (streamed where possible), codecs
         # in numpy ----
         reg = None
@@ -933,6 +994,10 @@ def make_ps_train_step(
             reduced array (non-blocking once ``notifier`` — a Handle
             or Future with add_done_callback, or None for an already
             complete result — has fired)."""
+            if chaos_nan is not None:
+                flat = _chaos_nan_poison(
+                    chaos_nan, name, flat,
+                    prof.step if prof is not None else 0)
             mark_first_push()
             if reg is not None:
                 flat = flat.astype(np.float32, copy=False)
@@ -1423,6 +1488,8 @@ def make_ps_train_step(
 
             def land(s, piece):
                 t0 = _time.perf_counter()
+                if hc is not None:
+                    hc.leaf(s, piece)  # health tap: stats off the drain
                 arr = jax.device_put(piece.reshape(shapes[s]))
                 imported[s] = arr
                 if sa_round is not None:
@@ -1438,6 +1505,8 @@ def make_ps_train_step(
                 # owns it — 1/local_size of the H2D the whole-leaf
                 # import moved, overlapped with the remaining pulls
                 t0 = _time.perf_counter()
+                if hc is not None:
+                    hc.leaf(s, piece)  # shard pieces sum into the leaf
                 info = active_shard[s]
                 parts = shard_parts[s]
                 parts[dev] = jax.device_put(piece, axis_devs[dev])
@@ -1575,11 +1644,26 @@ def make_ps_train_step(
             grads = treedef.unflatten(imported)
             params, opt_state = apply_fn(params, opt_state, grads)
         n_streamed = round_obj.streamed if round_obj is not None else 0
+        # training-health finalize: close the step's per-leaf stats
+        # into the StepReport fields (incl. the bounded HEALTH_PULL
+        # fidelity sweep); the HealthPlane observer inside end_step
+        # then runs the detector, and with BYTEPS_NAN_GUARD a
+        # nonfinite round raises HERE — after the flight events and
+        # counters landed, never before (detect → record → fail-fast)
+        health_fields = None
+        if hc is not None:
+            try:
+                health_fields = hplane.finalize(hc, names, state)
+            except Exception:  # noqa: BLE001 - diagnostics never kill
+                health_fields = None          # the step
         state.profiler.end_step(
             prof,
             ttfp_ms=first_push[0] * 1e3 if first_push[0] is not None
             else None,
-            streamed=n_streamed, fallback=len(names) - n_streamed)
+            streamed=n_streamed, fallback=len(names) - n_streamed,
+            health=health_fields)
+        if hplane is not None:
+            hplane.raise_if_fatal()
         return params, opt_state, loss
 
     # tick the Chrome-trace step counter: the PUSH/PULL/COMPRESS spans the

@@ -299,6 +299,12 @@ enum Op : uint8_t {
   DRAIN_REQ = 17,   // mark this server draining (advisory flag + flight
                     // event); reply: {keys_held, 1} — the drain ACK a
                     // worker collects after migrating the keys away
+  // Training-health plane (docs/observability.md "Training-health
+  // plane"): per-key post-aggregation statistics computed by the
+  // in-fold pass (BYTEPS_HEALTH). Header-only request carrying the key;
+  // reply: one packed HealthRec for the key's last PUBLISHED round, or
+  // an error ACK when the key is unknown / the health pass is off.
+  HEALTH_PULL = 18,
 };
 
 enum ReqType : uint32_t {
@@ -1217,9 +1223,196 @@ __attribute__((target("avx512f,avx512bw"))) static void fold_bf16_avx512(
 }
 #endif  // x86_64 && !BYTEPS_SCALAR_ONLY
 
+// ------------------------------------------------------------------ //
+// in-fold training-health statistics (BYTEPS_HEALTH, docs/
+// observability.md "Training-health plane")
+//
+// Per-key per-round sum-of-squares, abs-max and nonfinite counts of
+// the POST-AGGREGATION value, computed either fused into the round's
+// LAST f32 fold (the dense multi-worker hot path: the same add
+// instructions write the same bits — bitwise-neutral by construction —
+// while the freshly-produced lanes feed the stat accumulators) or by a
+// one-pass read-only scan of the published aggregate (adopt-first-push
+// single-worker rounds, compressed/rowsparse publishes, bf16/f64).
+// Contract: sumsq/absmax accumulate over FINITE elements only (summed
+// in double); NaN/Inf elements are COUNTED, never folded into the
+// norms — a single poisoned lane must read as "1 nonfinite", not as a
+// NaN that erases the whole statistic. Off (the default) the pass does
+// not run at all: zero marginal cost.
+// ------------------------------------------------------------------ //
+
+struct HStat {
+  double sumsq = 0.0;     // over finite elements
+  double absmax = 0.0;    // over finite elements
+  uint64_t nonfinite = 0;
+  uint64_t elems = 0;
+  uint64_t round = 0;     // completed_rounds stamped at publish
+};
+
+static inline void stat_f32_one(float v, HStat* h) {
+  uint32_t bits;
+  std::memcpy(&bits, &v, 4);
+  uint32_t abs = bits & 0x7FFFFFFFu;
+  if (abs >= 0x7F800000u) {  // exponent all-ones: NaN or +-Inf
+    h->nonfinite++;
+    return;
+  }
+  double dv = (double)v;
+  h->sumsq += dv * dv;
+  double av = dv < 0 ? -dv : dv;
+  if (av > h->absmax) h->absmax = av;
+}
+
+static void fold_f32_stat_scalar(float* d, const float* s, size_t n,
+                                 HStat* h) {
+  for (size_t i = 0; i < n; ++i) {
+    d[i] += s[i];  // identical arithmetic to fold_f32_scalar: bitwise
+    stat_f32_one(d[i], h);
+  }
+}
+
+static void stat_scan_f32_scalar(const float* p, size_t n, HStat* h) {
+  for (size_t i = 0; i < n; ++i) stat_f32_one(p[i], h);
+}
+
+#ifdef BYTEPS_HAVE_SIMD_FOLD
+// Shared per-8-lane stat block: abs via sign-bit mask, finite lanes =
+// (abs < inf) as a signed compare (both operands <= 0x7F800000 range),
+// nonfinite lanes zeroed before the max/square so the accumulators
+// stay finite and meaningful. Squares accumulate in 2x4 f64 lanes.
+__attribute__((target("avx2"))) static inline void stat8_avx2(
+    __m256 r, __m256* vmax, __m256d* acc0, __m256d* acc1,
+    uint64_t* nonfin) {
+  const __m256i abs_mask = _mm256_set1_epi32(0x7FFFFFFF);
+  const __m256i inf = _mm256_set1_epi32(0x7F800000);
+  __m256i abs = _mm256_and_si256(_mm256_castps_si256(r), abs_mask);
+  __m256i isfin = _mm256_cmpgt_epi32(inf, abs);
+  *nonfin += 8 - (uint64_t)__builtin_popcount(
+      (unsigned)_mm256_movemask_ps(_mm256_castsi256_ps(isfin)));
+  __m256 rf = _mm256_and_ps(_mm256_castsi256_ps(abs),
+                            _mm256_castsi256_ps(isfin));
+  *vmax = _mm256_max_ps(*vmax, rf);
+  __m256d lo = _mm256_cvtps_pd(_mm256_castps256_ps128(rf));
+  __m256d hi = _mm256_cvtps_pd(_mm256_extractf128_ps(rf, 1));
+  *acc0 = _mm256_add_pd(*acc0, _mm256_mul_pd(lo, lo));
+  *acc1 = _mm256_add_pd(*acc1, _mm256_mul_pd(hi, hi));
+}
+
+__attribute__((target("avx2"))) static inline void stat8_avx2_flush(
+    __m256 vmax, __m256d acc0, __m256d acc1, uint64_t nonfin,
+    HStat* h) {
+  double tmp[4];
+  _mm256_storeu_pd(tmp, _mm256_add_pd(acc0, acc1));
+  h->sumsq += tmp[0] + tmp[1] + tmp[2] + tmp[3];
+  float fm[8];
+  _mm256_storeu_ps(fm, vmax);
+  double m = h->absmax;
+  for (int k = 0; k < 8; ++k)
+    if ((double)fm[k] > m) m = (double)fm[k];
+  h->absmax = m;
+  h->nonfinite += nonfin;
+}
+
+__attribute__((target("avx2"))) static void fold_f32_stat_avx2(
+    float* d, const float* s, size_t n, HStat* h) {
+  __m256 vmax = _mm256_setzero_ps();
+  __m256d acc0 = _mm256_setzero_pd(), acc1 = _mm256_setzero_pd();
+  uint64_t nonfin = 0;
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    // the exact fold_f32_avx2 add — the stored bits cannot differ
+    __m256 r = _mm256_add_ps(_mm256_loadu_ps(d + i),
+                             _mm256_loadu_ps(s + i));
+    _mm256_storeu_ps(d + i, r);
+    stat8_avx2(r, &vmax, &acc0, &acc1, &nonfin);
+  }
+  stat8_avx2_flush(vmax, acc0, acc1, nonfin, h);
+  for (; i < n; ++i) {
+    d[i] += s[i];
+    stat_f32_one(d[i], h);
+  }
+}
+
+__attribute__((target("avx2"))) static void stat_scan_f32_avx2(
+    const float* p, size_t n, HStat* h) {
+  __m256 vmax = _mm256_setzero_ps();
+  __m256d acc0 = _mm256_setzero_pd(), acc1 = _mm256_setzero_pd();
+  uint64_t nonfin = 0;
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8)
+    stat8_avx2(_mm256_loadu_ps(p + i), &vmax, &acc0, &acc1, &nonfin);
+  stat8_avx2_flush(vmax, acc0, acc1, nonfin, h);
+  for (; i < n; ++i) stat_f32_one(p[i], h);
+}
+
+__attribute__((target("avx512f"))) static void fold_f32_stat_avx512(
+    float* d, const float* s, size_t n, HStat* h) {
+  const __m512i abs_mask = _mm512_set1_epi32(0x7FFFFFFF);
+  const __m512i inf = _mm512_set1_epi32(0x7F800000);
+  __m512 vmax = _mm512_setzero_ps();
+  __m512d acc0 = _mm512_setzero_pd(), acc1 = _mm512_setzero_pd();
+  uint64_t nonfin = 0;
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m512 r = _mm512_add_ps(_mm512_loadu_ps(d + i),
+                             _mm512_loadu_ps(s + i));
+    _mm512_storeu_ps(d + i, r);
+    __m512i abs = _mm512_and_si512(_mm512_castps_si512(r), abs_mask);
+    __mmask16 fin = _mm512_cmplt_epi32_mask(abs, inf);
+    nonfin += 16 - (uint64_t)__builtin_popcount((unsigned)fin);
+    __m512 rf = _mm512_maskz_mov_ps(fin, _mm512_castsi512_ps(abs));
+    vmax = _mm512_max_ps(vmax, rf);
+    // low/high 8-lane halves widen to f64 (extractf64x4 is AVX512F;
+    // extractf32x8 would need DQ)
+    __m256 lo = _mm512_castps512_ps256(rf);
+    __m256 hi = _mm256_castpd_ps(
+        _mm512_extractf64x4_pd(_mm512_castps_pd(rf), 1));
+    __m512d dlo = _mm512_cvtps_pd(lo);
+    __m512d dhi = _mm512_cvtps_pd(hi);
+    acc0 = _mm512_add_pd(acc0, _mm512_mul_pd(dlo, dlo));
+    acc1 = _mm512_add_pd(acc1, _mm512_mul_pd(dhi, dhi));
+  }
+  h->sumsq += _mm512_reduce_add_pd(acc0) + _mm512_reduce_add_pd(acc1);
+  double m = (double)_mm512_reduce_max_ps(vmax);
+  if (m > h->absmax) h->absmax = m;
+  h->nonfinite += nonfin;
+  for (; i < n; ++i) {
+    d[i] += s[i];
+    stat_f32_one(d[i], h);
+  }
+}
+#endif  // BYTEPS_HAVE_SIMD_FOLD
+
+static void stat_scan_bf16_scalar(const uint16_t* p, size_t n,
+                                  HStat* h) {
+  for (size_t i = 0; i < n; ++i) stat_f32_one(bf16_to_float(p[i]), h);
+}
+
+static void stat_scan_f16_scalar(const uint16_t* p, size_t n, HStat* h) {
+  for (size_t i = 0; i < n; ++i) stat_f32_one(half_to_float(p[i]), h);
+}
+
+static void stat_scan_f64_scalar(const double* p, size_t n, HStat* h) {
+  for (size_t i = 0; i < n; ++i) {
+    double v = p[i];
+    if (!std::isfinite(v)) {
+      h->nonfinite++;
+      continue;
+    }
+    h->sumsq += v * v;
+    double av = v < 0 ? -v : v;
+    if (av > h->absmax) h->absmax = av;
+  }
+}
+
 struct FoldKernels {
   void (*f32)(float*, const float*, size_t) = fold_f32_scalar;
   void (*bf16)(uint16_t*, const uint16_t*, size_t) = fold_bf16_scalar;
+  // health-plane variants (BYTEPS_HEALTH): the fused last-fold kernel
+  // and the read-only aggregate scan, dispatched on the same tier
+  void (*f32_stat)(float*, const float*, size_t, HStat*) =
+      fold_f32_stat_scalar;
+  void (*scan_f32)(const float*, size_t, HStat*) = stat_scan_f32_scalar;
   int tier = kSimdScalar;
 };
 
@@ -1255,12 +1448,44 @@ static FoldKernels resolve_fold_kernels(const char* want) {
   if (tier == kSimdAvx512) {
     k.f32 = fold_f32_avx512;
     k.bf16 = fold_bf16_avx512;
+    k.f32_stat = fold_f32_stat_avx512;
+    k.scan_f32 = stat_scan_f32_avx2;  // AVX512F implies AVX2
   } else if (tier == kSimdAvx2) {
     k.f32 = fold_f32_avx2;
     k.bf16 = fold_bf16_avx2;
+    k.f32_stat = fold_f32_stat_avx2;
+    k.scan_f32 = stat_scan_f32_avx2;
   }
 #endif
   return k;
+}
+
+// Read-only aggregate statistics scan (the publish-path half of the
+// health plane: adopt-only rounds, compressed/rowsparse publishes and
+// non-f32 dtypes). Unsupported dtypes publish an all-zero stat with
+// elems=0 — identifiable as "no statistics", never stale.
+static void stat_scan(const void* p, size_t bytes, uint32_t dtype,
+                      const FoldKernels& k, HStat* h) {
+  switch (dtype) {
+    case F32:
+      k.scan_f32((const float*)p, bytes / 4, h);
+      h->elems += bytes / 4;
+      break;
+    case BF16:
+      stat_scan_bf16_scalar((const uint16_t*)p, bytes / 2, h);
+      h->elems += bytes / 2;
+      break;
+    case F16:
+      stat_scan_f16_scalar((const uint16_t*)p, bytes / 2, h);
+      h->elems += bytes / 2;
+      break;
+    case F64:
+      stat_scan_f64_scalar((const double*)p, bytes / 8, h);
+      h->elems += bytes / 8;
+      break;
+    default:
+      break;  // integer dtypes: no float statistics to take
+  }
 }
 
 // dtype-aware summation: dst += src. fp32/bf16 ride the dispatched
@@ -2126,6 +2351,26 @@ static_assert(sizeof(FlightRec) == 32, "flight record layout");
 static const char* const kFlightRecFields[] = {
     "ts_ns", "key", "detail", "rid", "sender", "kind", "pad"};
 
+// One key's post-aggregation health statistics (HEALTH_PULL reply).
+// The doubles travel as IEEE-754 bit patterns in u64 fields so the
+// record stays fixed-width for the slot-layout lint; the Python mirror
+// (server/__init__.py HEALTH_REC_FMT / _HEALTH_REC_FIELDS) reassembles
+// them. round = completed_rounds at publish, so a worker can check the
+// statistics describe the aggregate it just drained.
+#pragma pack(push, 1)
+struct HealthRec {
+  uint64_t key;
+  uint64_t round;
+  uint64_t sumsq_bits;   // double bit pattern: sum of squares (finite)
+  uint64_t absmax_bits;  // double bit pattern: max |x| (finite)
+  uint64_t nonfinite;
+  uint64_t elems;
+};
+#pragma pack(pop)
+static_assert(sizeof(HealthRec) == 48, "health record layout");
+static const char* const kHealthRecFields[] = {
+    "key", "round", "sumsq_bits", "absmax_bits", "nonfinite", "elems"};
+
 // bps_server_stats / STATS_PULL slot layout — the append-only contract
 // with server/__init__.py _STAT_SLOTS, enforced until PR 10 only by a
 // comment and now machine-checked: byteps-lint's slot-layout check
@@ -2137,7 +2382,8 @@ static const char* const kStatSlotNames[] = {
     "fold_count", "fold_bytes", "reply_ns", "reply_count",
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
-    "flight_dropped", "draining"};
+    "flight_dropped", "draining", "health_rounds",
+    "health_nonfinite"};
 static constexpr size_t kNumStatSlots =
     sizeof(kStatSlotNames) / sizeof(kStatSlotNames[0]);
 
@@ -2323,6 +2569,10 @@ struct KeyStore {
   // publishes a replacement.
   std::shared_ptr<const Buf> pub;       // dense
   std::shared_ptr<const Buf> pub_wire;  // compressed
+  // Training-health statistics of the last PUBLISHED aggregate
+  // (BYTEPS_HEALTH; guarded-by: mu). Overwritten at every publish,
+  // served over HEALTH_PULL.
+  HStat hstat;
 };
 
 struct EngineMsg {
@@ -2435,6 +2685,14 @@ class Server {
           const char* e = ::getenv("BYTEPS_FLIGHT_RING");
           long v = e && *e ? std::atol(e) : 2048;
           return (size_t)(v < 16 ? 16 : v);
+        }()),
+        // training-health in-fold statistics pass (BYTEPS_HEALTH, read
+        // per instance like the chaos/SIMD knobs so health-on and
+        // health-off servers coexist in one test process); off by
+        // default — the pass then does not run at all
+        health_([] {
+          const char* e = ::getenv("BYTEPS_HEALTH");
+          return e && *e && std::strcmp(e, "0") != 0;
         }()) {
     n_engines_ = num_engine_threads < 1 ? 1 : num_engine_threads;
     engine_bytes_.reset(new std::atomic<uint64_t>[n_engines_]);
@@ -2466,7 +2724,8 @@ class Server {
         st.oob_msgs.load(),     (uint64_t)simd_tier(),
         (uint64_t)n_engines_,   trace_ring_.total(),
         trace_ring_.dropped(),  flight_ring_.total(),
-        flight_ring_.dropped(), draining_.load() ? 1ull : 0ull};
+        flight_ring_.dropped(), draining_.load() ? 1ull : 0ull,
+        health_rounds_.load(),  health_nonfinite_.load()};
     int n = max_n < (int)kNumStatSlots ? max_n : (int)kNumStatSlots;
     for (int i = 0; i < n; ++i) out[i] = v[i];
     return n;
@@ -2475,6 +2734,32 @@ class Server {
     return (i >= 0 && i < n_engines_)
                ? engine_bytes_[i].load(std::memory_order_relaxed)
                : 0;
+  }
+
+  // In-process mirror of the HEALTH_PULL reply (bps_server_key_health):
+  // fills {round, sumsq_bits, absmax_bits, nonfinite, elems}. Returns
+  // false when the key is unknown or the health pass is off. The map
+  // lock is released BEFORE taking ks.mu (the TryReserveDirect
+  // pattern; stores_ never erases, so the pointer stays valid) — a
+  // health poll waiting out a multi-MB fold must stall only its key,
+  // never the whole key map.
+  bool KeyHealth(uint64_t key, uint64_t out[5]) {
+    if (!health_) return false;
+    KeyStore* ks = nullptr;
+    {
+      std::lock_guard<Mu> lk(stores_mu_);
+      auto it = stores_.find(key);
+      if (it == stores_.end()) return false;
+      ks = &it->second;
+    }
+    std::lock_guard<Mu> lk2(ks->mu);
+    const HStat& h = ks->hstat;
+    out[0] = h.round;
+    std::memcpy(&out[1], &h.sumsq, 8);
+    std::memcpy(&out[2], &h.absmax, 8);
+    out[3] = h.nonfinite;
+    out[4] = h.elems;
+    return true;
   }
 
   int Run() {
@@ -2701,8 +2986,8 @@ class Server {
       }
       if (h.op == STATS_PULL || h.op == TRACE_DRAIN ||
           h.op == FLIGHT_DRAIN || h.op == JOIN_PROBE ||
-          h.op == DRAIN_REQ) {
-        HandleControlPull(conn, h.rid, h.op, h.sender);
+          h.op == DRAIN_REQ || h.op == HEALTH_PULL) {
+        HandleControlPull(conn, h.rid, h.op, h.sender, h.key);
         continue;
       }
       if (h.op == BARRIER) {
@@ -2872,7 +3157,32 @@ class Server {
   }
 
   void HandleControlPull(const std::shared_ptr<Conn>& conn, uint32_t rid,
-                         uint8_t op, uint16_t sender = 0) {
+                         uint8_t op, uint16_t sender = 0,
+                         uint64_t key = 0) {
+    if (op == HEALTH_PULL) {
+      // per-key post-aggregation statistics (the training-health
+      // plane's wire surface): one fixed-width HealthRec for the key's
+      // last published round. Unknown key / health off -> error ACK,
+      // so a worker can tell "no statistics" from "all zeros". The
+      // ks.mu hold is a 5-word copy — no send happens under it.
+      HealthRec rec{};
+      rec.key = key;
+      uint64_t v[5];
+      if (!KeyHealth(key, v)) {
+        MsgHeader r = ReplyHeader(ACK, 1, 0, rid, key);
+        conn->send_msg(r, nullptr);
+        return;
+      }
+      rec.round = v[0];
+      rec.sumsq_bits = v[1];
+      rec.absmax_bits = v[2];
+      rec.nonfinite = v[3];
+      rec.elems = v[4];
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, key, 0,
+                                (uint32_t)sizeof(rec));
+      conn->send_msg(r, &rec);
+      return;
+    }
     if (op == JOIN_PROBE) {
       // scale-up join handshake: the worker verifies the newcomer is
       // reachable and agrees on the worker count BEFORE the registry
@@ -3415,6 +3725,30 @@ class Server {
     stats_.fold_bytes.fetch_add(bytes, std::memory_order_relaxed);
   }
 
+  // Training-health publish (call under ks.mu, AFTER completed_rounds
+  // was bumped, with `agg` the just-published dense aggregate): latch
+  // the round's statistics on the store and bump the server counters.
+  // `fused` carries the stats the round's LAST f32 fold computed
+  // in-pass (the dense multi-worker hot path); every other publish
+  // shape takes the read-only scan. No-op when BYTEPS_HEALTH is off.
+  void PublishHealth(KeyStore& ks, const void* agg, uint32_t len,
+                     uint32_t dtype, const HStat* fused) {
+    if (!health_) return;
+    HStat h;
+    if (fused != nullptr) {
+      h = *fused;
+    } else {
+      stat_scan(agg, len, dtype, kernels_, &h);
+    }
+    h.round = ks.completed_rounds;
+    ks.hstat = h;
+    health_rounds_.fetch_add(1, std::memory_order_relaxed);
+    if (h.nonfinite)
+      health_nonfinite_.fetch_add(h.nonfinite,
+                                  std::memory_order_relaxed);
+  }
+
+
   void FusedReply(KeyStore& ks, EngineMsg& m, bool compressed) {
     bool ready;
     {
@@ -3487,6 +3821,7 @@ class Server {
             ks.recv_count = 0;
             ks.round_codec = 0;
             ks.completed_rounds++;
+            PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
             chaos_.round_completed();
             flush.swap(ks.parked_pulls);
           }
@@ -3541,6 +3876,7 @@ class Server {
           ks.round_codec = 0;  // round completed without recv_count ever
                                // incrementing (single-worker publish)
           ks.completed_rounds++;
+          PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
           goto ack;
@@ -3609,6 +3945,7 @@ class Server {
         ks.recv_count = 0;
         ks.round_codec = 0;
         ks.completed_rounds++;
+        PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
         chaos_.round_completed();
         flush.swap(ks.parked_pulls);
       }
@@ -3703,6 +4040,7 @@ class Server {
           ks.recv_count = 0;
           ks.round_codec = 0;
           ks.completed_rounds++;
+          PublishHealth(ks, ks.pub->data(), ks.len, ks.dtype, nullptr);
           chaos_.round_completed();
           flush.swap(ks.parked_pulls);
         }
@@ -3797,6 +4135,14 @@ class Server {
           uint64_t t0 = now_ns();
           // captured BEFORE the adopt-move below empties m.payload
           size_t fold_len = m.size();
+          // in-fold health statistics (BYTEPS_HEALTH): the round's
+          // LAST f32 fold runs the fused stat kernel — same add
+          // instructions, same stored bits, the freshly-written lanes
+          // feed the accumulators in the same pass. Adopt-only rounds
+          // (first push, num_workers==1) and bf16 take the publish
+          // scan instead.
+          HStat hs;
+          bool hs_fused = false;
           if (ks.recv_count == 0) {
             if (m.oob) {
               // out-of-band first push: ONE copy out of the shared
@@ -3817,6 +4163,13 @@ class Server {
               // zero intermediate copies.
               ks.accum = std::move(m.payload);
             }
+          } else if (health_ && ks.dtype == F32 &&
+                     (int)ks.recv_count + 1 >= num_workers_) {
+            kernels_.f32_stat((float*)ks.accum.data(),
+                              (const float*)m.data(), m.size() / 4,
+                              &hs);
+            hs.elems = m.size() / 4;
+            hs_fused = true;
           } else {
             sum_into(ks.accum.data(), m.data(), m.size(), ks.dtype,
                      kernels_);
@@ -3841,6 +4194,8 @@ class Server {
             ks.recv_count = 0;
             ks.round_codec = 0;
             ks.completed_rounds++;
+            PublishHealth(ks, ks.pub->data(), ks.len, ks.dtype,
+                          hs_fused ? &hs : nullptr);
             chaos_.round_completed();
             flush.swap(ks.parked_pulls);
             // Echo eligibility: a single-worker round just completed
@@ -4061,6 +4416,12 @@ class Server {
   std::atomic<uint64_t> trace_seq_{0};
   EventRing<TraceRec> trace_ring_;
   EventRing<FlightRec> flight_ring_;
+  // training-health plane (BYTEPS_HEALTH): in-fold statistics pass +
+  // the cumulative counters behind the health_rounds/health_nonfinite
+  // stat slots
+  bool health_;
+  std::atomic<uint64_t> health_rounds_{0};
+  std::atomic<uint64_t> health_nonfinite_{0};
   BufPool pool_;         // recycled payload/fold-scratch buffers
 
   std::unordered_map<uint64_t, KeyStore> stores_;
@@ -4881,6 +5242,19 @@ class Client {
     return r == ~0u ? -1 : (int)r;
   }
 
+  // Keyed control pull (HEALTH_PULL): like Ctrl but the request header
+  // names a key, so the server can answer per-store questions inline.
+  int CtrlKey(int server, uint8_t op, uint64_t key, void* out,
+              uint32_t out_cap, long timeout_s) {
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return -1;
+    uint32_t r = groups_[server]->conns[0]->Request(
+        op, key, 0, worker_id_, nullptr, 0, out, out_cap, 0, 0,
+        timeout_s > 0 ? timeout_s : 5);
+    return r == ~0u ? -1 : (int)r;
+  }
+
   // One NTP-style clock probe: out = {t0 client-send, t1 server-recv,
   // t2 server-send, t3 client-recv}, all steady-clock ns (t0/t3 on the
   // client's clock, t1/t2 on the server's). Returns 0 or -1.
@@ -5133,6 +5507,15 @@ const char* bps_server_stat_name(int i) {
 
 int bps_server_stat_count() { return (int)bps::kNumStatSlots; }
 
+// In-process mirror of the HEALTH_PULL reply: out5 = {round,
+// sumsq_bits, absmax_bits, nonfinite, elems} for `key`'s last
+// published round (doubles as IEEE-754 bit patterns, like the wire
+// record). Returns 0, or -1 when the key is unknown / health off —
+// the loopback test surface for the in-fold statistics pass.
+int bps_server_key_health(void* s, uint64_t key, uint64_t* out5) {
+  return ((bps::Server*)s)->KeyHealth(key, out5) ? 0 : -1;
+}
+
 // Cumulative queued payload bytes per engine thread — the balance
 // proof for byte-weighted key placement. Returns engines filled.
 int bps_server_engine_bytes(void* s, uint64_t* out, int max_n) {
@@ -5254,6 +5637,16 @@ int bps_client_ctrl(void* c, int server, int op, void* out,
                     uint32_t out_cap, int timeout_s) {
   return ((bps::Client*)c)->Ctrl(server, (uint8_t)op, out, out_cap,
                                  timeout_s);
+}
+
+// Keyed control pull (HEALTH_PULL = 18): one packed HealthRec for
+// `key`'s last published aggregation round. Returns the reply length
+// (48) or -1 (unknown key / BYTEPS_HEALTH off on the server / stale
+// peer). Same bounded-timeout discipline as bps_client_ctrl.
+int bps_client_ctrl_key(void* c, int server, int op, uint64_t key,
+                        void* out, uint32_t out_cap, int timeout_s) {
+  return ((bps::Client*)c)->CtrlKey(server, (uint8_t)op, key, out,
+                                    out_cap, timeout_s);
 }
 
 // One NTP-style clock probe against `server`: fills out4 with {t0

@@ -44,7 +44,7 @@ _STAT_SLOTS = (
     "fold_count", "fold_bytes", "reply_ns", "reply_count",
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
-    "flight_dropped", "draining",
+    "flight_dropped", "draining", "health_rounds", "health_nonfinite",
 )
 
 # Wire-sampled trace record (native/ps.cc TraceRec, drained over the
@@ -69,6 +69,38 @@ _FLIGHT_REC_FIELDS = (
     "ts_ns", "key", "detail", "rid", "sender", "kind", "pad",
 )
 assert struct.calcsize(FLIGHT_REC_FMT) == FLIGHT_REC_BYTES
+
+# Per-key training-health record (native/ps.cc HealthRec, answered over
+# the HEALTH_PULL control op and mirrored in-process by
+# ``bps_server_key_health``). The two doubles (sum of squares / abs-max
+# over the FINITE elements of the last published aggregate) travel as
+# IEEE-754 bit patterns in u64 fields so the record stays fixed-width
+# for the slot-layout lint; ``parse_health_rec`` reassembles them.
+HEALTH_REC_FMT = "<QQQQQQ"
+HEALTH_REC_BYTES = 48
+_HEALTH_REC_FIELDS = (
+    "key", "round", "sumsq_bits", "absmax_bits", "nonfinite", "elems",
+)
+assert struct.calcsize(HEALTH_REC_FMT) == HEALTH_REC_BYTES
+
+
+def parse_health_rec(raw: bytes) -> Optional[Dict[str, float]]:
+    """One packed HealthRec -> dict with the doubles reassembled
+    (None on a length mismatch) — THE one parser for the wire reply
+    and the in-process mirror."""
+    if len(raw) != HEALTH_REC_BYTES:
+        return None
+    vals = dict(zip(_HEALTH_REC_FIELDS, struct.unpack(HEALTH_REC_FMT,
+                                                      raw)))
+    out = {
+        "key": vals["key"], "round": vals["round"],
+        "sumsq": struct.unpack(
+            "<d", struct.pack("<Q", vals["sumsq_bits"]))[0],
+        "absmax": struct.unpack(
+            "<d", struct.pack("<Q", vals["absmax_bits"]))[0],
+        "nonfinite": vals["nonfinite"], "elems": vals["elems"],
+    }
+    return out
 
 # native/ps.cc enum FlightKind — event names for the merged dump
 FLIGHT_KIND_NAMES = {
@@ -100,6 +132,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bps_server_stat_name.argtypes = [ctypes.c_int]
         lib.bps_server_stat_count.restype = ctypes.c_int
         lib.bps_server_stat_count.argtypes = []
+    if hasattr(lib, "bps_server_key_health"):
+        # training-health in-process mirror (guarded: stale .so)
+        lib.bps_server_key_health.restype = ctypes.c_int
+        lib.bps_server_key_health.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
     return lib
 
 
@@ -152,6 +190,8 @@ def derive_stage_section(raw: Dict[str, int]) -> Dict[str, float]:
         "flight_records": raw["flight_records"],
         "flight_dropped": raw["flight_dropped"],
         "draining": raw["draining"],
+        "health_rounds": raw["health_rounds"],
+        "health_nonfinite": raw["health_nonfinite"],
     }
 
 
@@ -194,6 +234,26 @@ def per_server_stats() -> List[Dict[str, int]]:
             n = lib.bps_server_stats(ptr, buf, len(_STAT_SLOTS))
             out.append(parse_stat_slots([buf[i] for i in range(n)]))
     return out
+
+
+def key_health(key: int) -> Optional[Dict[str, float]]:
+    """Per-key post-aggregation health statistics from the live
+    IN-PROCESS servers (the loopback test/bench topology): the first
+    server owning the key answers. None when no server holds the key
+    or the health pass (BYTEPS_HEALTH) is off — remote fleets answer
+    the same record over the HEALTH_PULL control op
+    (``PSClient.health_pull``)."""
+    buf = (ctypes.c_uint64 * 5)()
+    with _live_mu:  # see stage_stats: excludes a concurrent destroy
+        for lib, ptr in _live:
+            if not hasattr(lib, "bps_server_key_health"):
+                continue
+            if lib.bps_server_key_health(ptr, int(key), buf) == 0:
+                raw = struct.pack(
+                    HEALTH_REC_FMT, int(key),
+                    *[int(buf[i]) for i in range(5)])
+                return parse_health_rec(raw)
+    return None
 
 
 def engine_stats() -> List[List[int]]:

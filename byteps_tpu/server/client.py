@@ -57,6 +57,7 @@ WIRE_CTRL_OPS = {
     "CLOCK_PROBE": 15,
     "JOIN_PROBE": 16,
     "DRAIN_REQ": 17,
+    "HEALTH_PULL": 18,
 }
 
 # Control-pull reply size limits (native/ps.cc enum CtrlLimits, also
@@ -144,6 +145,14 @@ def _load_lib() -> ctypes.CDLL:
         lib.bps_client_clock_probe.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    if hasattr(lib, "bps_client_ctrl_key"):
+        # keyed control pull (HEALTH_PULL, the training-health plane);
+        # guarded — health_pull reads None on a stale .so
+        lib.bps_client_ctrl_key.restype = ctypes.c_int
+        lib.bps_client_ctrl_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_int]
     if hasattr(lib, "bps_client_add_server"):
         # runtime scale-up (elastic fleet); guarded — a stale .so simply
         # cannot grow its fleet and add_server() raises a clear error
@@ -456,6 +465,32 @@ class PSClient:
             d["kind"] = FLIGHT_KIND_NAMES.get(d["kind"], str(d["kind"]))
             out.append(d)
         return out
+
+    def health_pull(self, server: int, key: int,
+                    timeout_s: int = 5) -> Optional[dict]:
+        """Per-key POST-AGGREGATION health statistics (the training-
+        health plane, docs/observability.md): the server's in-fold
+        pass (BYTEPS_HEALTH) computes sum-of-squares / abs-max /
+        nonfinite counts of each published aggregate, and this keyed
+        control pull fetches the last round's record —
+        ``{key, round, sumsq, absmax, nonfinite, elems}``. None when
+        the key is unknown there, the server runs with the pass off,
+        or the ABI is stale. Bounded like every control pull: a wedged
+        server costs ``timeout_s`` seconds, never the data plane's
+        budget."""
+        self._check_server(server)
+        if self._closed:
+            raise RuntimeError("control pull on a closed PSClient")
+        if not hasattr(self._lib, "bps_client_ctrl_key"):
+            return None
+        from . import HEALTH_REC_BYTES, parse_health_rec
+        buf = (ctypes.c_uint8 * HEALTH_REC_BYTES)()
+        n = self._lib.bps_client_ctrl_key(
+            self._handle, server, WIRE_CTRL_OPS["HEALTH_PULL"],
+            int(key), buf, HEALTH_REC_BYTES, timeout_s)
+        if n != HEALTH_REC_BYTES:
+            return None
+        return parse_health_rec(bytes(buf))
 
     def clock_probe(self, server: int, probes: int = 8,
                     timeout_s: int = 5) -> Optional[tuple]:

@@ -104,6 +104,10 @@ class WireLayoutRule(Rule):
             project, text, rel_cc, "kFlightRecFields",
             "_FLIGHT_REC_FIELDS", struct_name="FlightRec",
             fmt_const="FLIGHT_REC_FMT")
+        findings += self._check_slot_manifest(
+            project, text, rel_cc, "kHealthRecFields",
+            "_HEALTH_REC_FIELDS", struct_name="HealthRec",
+            fmt_const="HEALTH_REC_FMT")
         findings += self._check_dict_enum(
             project, text, rel_cc, "WIRE_CTRL_OPS", "Op",
             "a skewed control op id reaches the server as an unknown op")

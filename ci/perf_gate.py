@@ -60,6 +60,16 @@ DIRECTION_OVERRIDES = {
     "ledger_wire_efficiency": "higher",
     "achieved_flops": "higher",
     "wire_bytes": "lower",
+    # training-health archive keys (core/health.py): a gradient norm
+    # has NO better-direction — an explicit None pins it skipped so a
+    # future suffix rule can never misread a healthy optimization
+    # change as a perf regression; update_ratio_p95 likewise (and its
+    # _efficiency-adjacent spelling must not hit a suffix rule).
+    # nonfinite_leaves IS directional: any growth is a poisoned run.
+    "grad_norm": None,
+    "update_ratio_p95": None,
+    "fidelity_drift": None,
+    "nonfinite_leaves": "lower",
 }
 # (suffix, direction) checked in order after the overrides; the first
 # match wins. "_ms" covers every step-wall key; "_pct" the overhead

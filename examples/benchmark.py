@@ -136,7 +136,17 @@ def main() -> None:
     ap.add_argument("--no-comm", action="store_true",
                     help="compute-only step (no gradient push_pull) for "
                          "A/B-ing the communication overhead")
+    ap.add_argument("--health-assert", action="store_true",
+                    help="arm the training-health plane (BYTEPS_HEALTH) "
+                         "and exit nonzero on ANY anomaly event — the "
+                         "dryrun numerics gate (covers the bert/llama "
+                         "zoo; docs/observability.md)")
     args = ap.parse_args()
+    if args.health_assert:
+        # before init(): config snapshot + in-process servers read it.
+        # Forced, not setdefault — an ambient BYTEPS_HEALTH=0 must not
+        # turn the gate into one that silently cannot fail.
+        os.environ["BYTEPS_HEALTH"] = "1"
 
     bps.init()
 
@@ -206,6 +216,37 @@ def main() -> None:
     log(f"Img/sec per worker: {mean:.1f} +-{conf:.1f}")
     log(f"Total img/sec on {bps.size()} worker(s): "
         f"{bps.size() * mean:.1f} +-{bps.size() * conf:.1f}")
+    if args.health_assert:
+        plane = get_state().health
+        if plane is None or not plane.enabled:
+            # armed-proof: a gate that could not arm must FAIL, never
+            # report a vacuous clean run
+            print("HEALTH ASSERT FAILED: health plane did not arm",
+                  file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        # engaged-proof: collection rides the DCN PS train step's
+        # drain — --no-comm and mesh-collective runs never collect,
+        # and an all-zero counter read there is no verdict at all
+        if not any(r.get("grad_norm") is not None
+                   for r in bps.get_step_reports()):
+            print("HEALTH ASSERT FAILED: the health plane never "
+                  "observed a gradient round — needs the DCN PS comm "
+                  "path (DMLC_NUM_SERVER>=1, not --no-comm)",
+                  file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        counters = bps.get_metrics().get("counters", {})
+        anomalies = {
+            k: v for k, v in counters.items()
+            if k in ("health/nonfinite_rounds", "health/explode_events",
+                     "health/collapse_events", "health/drift_events")
+            and v}
+        if anomalies:
+            print(f"HEALTH ASSERT FAILED: {anomalies}", file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        log("health assert: no anomaly events")
     bps.shutdown()
 
 

@@ -51,7 +51,19 @@ def main() -> None:
     ap.add_argument("--compression", default=None,
                     help="codec name for --ps, e.g. onebit")
     ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--health-assert", action="store_true",
+                    help="arm the training-health plane (BYTEPS_HEALTH) "
+                         "and exit nonzero on ANY anomaly event — the "
+                         "dryrun numerics gate the staleness/convergence "
+                         "harness reuses (docs/observability.md "
+                         "\"Training-health plane\")")
     args = ap.parse_args()
+    if args.health_assert:
+        # before init(): the config snapshot and the (possibly
+        # in-process) servers both read it at construction. Forced, not
+        # setdefault — an ambient BYTEPS_HEALTH=0 must not turn the
+        # gate into one that silently cannot fail.
+        os.environ["BYTEPS_HEALTH"] = "1"
     if args.fsdp and args.ps:
         raise SystemExit(
             "--fsdp and --ps are mutually exclusive: the PS train step "
@@ -123,7 +135,47 @@ def main() -> None:
     if bps.rank() == 0:
         print(f"throughput: {tok_s:,.0f} tokens/s "
               f"(mesh dp={dp} tp={args.tp})")
+    if args.health_assert:
+        from byteps_tpu.core.state import get_state
+        plane = get_state().health
+        if plane is None or not plane.enabled:
+            # armed-proof: a gate that could not arm (e.g.
+            # BYTEPS_METRICS=0 disabled the plane) must FAIL, never
+            # report a vacuous clean run
+            print("HEALTH ASSERT FAILED: health plane did not arm",
+                  file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        # engaged-proof: the plane must have OBSERVED gradient rounds
+        # (collection rides the PS train step's drain) — an all-zero
+        # counter read from a path that never collected is not a clean
+        # verdict, it is no verdict
+        if not any(r.get("grad_norm") is not None
+                   for r in bps.get_step_reports()):
+            print("HEALTH ASSERT FAILED: the health plane never "
+                  "observed a gradient round — run with --ps (the "
+                  "collection rides the DCN PS train step)",
+                  file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        anomalies = _health_anomalies()
+        if anomalies:
+            print(f"HEALTH ASSERT FAILED: {anomalies}", file=sys.stderr)
+            bps.shutdown()
+            raise SystemExit(2)
+        print("health assert: no anomaly events")
     bps.shutdown()
+
+
+def _health_anomalies() -> dict:
+    """Nonzero training-health anomaly counters (core/health.py):
+    nonfinite rounds, explosion/collapse/drift events — the
+    --health-assert gate. Empty dict = numerically clean run."""
+    counters = bps.get_metrics().get("counters", {})
+    return {k: v for k, v in counters.items()
+            if k in ("health/nonfinite_rounds", "health/explode_events",
+                     "health/collapse_events", "health/drift_events")
+            and v}
 
 
 if __name__ == "__main__":

@@ -89,6 +89,15 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "ledger_wire_efficiency": 0.52,
                     "ledger_cost_source": "xla",
                     "ledger_verdict_named": True}, None
+        if name == "health_ab":
+            return {"health_on_step_ms": 5.06,
+                    "health_off_step_ms": 5.0,
+                    "health_overhead_pct": 1.2,
+                    "health_grad_norm": 0.031,
+                    "health_update_ratio_p95": 2.1e-4,
+                    "health_nonfinite_leaves": 0,
+                    "health_infold_rounds": 48,
+                    "health_verdict_named": True}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8,
@@ -154,9 +163,9 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:7] == ["pushpull_throttled", "scaling", "churn_ab",
+    assert cpu_calls[:8] == ["pushpull_throttled", "scaling", "churn_ab",
                              "scaleup_ab", "codec_adapt_ab", "fold_ab",
-                             "ledger_ab"]
+                             "ledger_ab", "health_ab"]
     assert out["scaleup_proof"] is True
     assert out["scaleup_joins"] == 1
     assert out["scaleup_newcomer_bytes"] == 16777216
@@ -172,6 +181,10 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     assert out["ledger_mfu"] == 0.31
     assert out["ledger_overlap_frac"] == 0.62
     assert out["ledger_wire_efficiency"] == 0.52
+    assert out["health_on_step_ms"] == 5.06
+    assert out["health_overhead_pct"] == 1.2
+    assert out["health_grad_norm"] == 0.031
+    assert out["health_infold_rounds"] == 48
     assert out["trace_on_step_ms"] == 5.05
     assert out["trace_overhead_pct"] == 1.0
     assert out["trace_server_records"] == 96
@@ -232,6 +245,12 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
                     "ledger_off_step_ms": 5.0,
                     "ledger_overhead_pct": 1.6,
                     "ledger_mfu": 0.02}, None
+        if name == "health_ab":
+            return {"health_on_step_ms": 5.06,
+                    "health_off_step_ms": 5.0,
+                    "health_overhead_pct": 1.2,
+                    "health_grad_norm": 0.03,
+                    "health_infold_rounds": 12}, None
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8}, None
@@ -278,13 +297,13 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 15 CPU phases + finals
-    assert calls.count("probe") == 16 + n_final
+    # start + one attempt after each of the 16 CPU phases + finals
+    assert calls.count("probe") == 17 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
         "after_churn_ab", "after_scaleup_ab", "after_codec_adapt_ab",
-        "after_fold_ab", "after_ledger_ab",
+        "after_fold_ab", "after_ledger_ab", "after_health_ab",
         "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_trace_ab",
         "after_stream_ab", "after_wire_ab", "after_shard_ab",
@@ -440,9 +459,9 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "churn_ab",
                             "scaleup_ab", "codec_adapt_ab", "fold_ab",
-                            "ledger_ab", "arena_ab", "metrics_ab",
-                            "trace_ab", "stream_ab", "wire_ab",
-                            "shard_ab", "scaling"}
+                            "ledger_ab", "health_ab", "arena_ab",
+                            "metrics_ab", "trace_ab", "stream_ab",
+                            "wire_ab", "shard_ab", "scaling"}
 
 
 def test_multichip_envelope_bounded():

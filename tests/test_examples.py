@@ -47,6 +47,55 @@ def test_llama_pretrain_fsdp_tp():
     assert "mutually exclusive" in r.stdout + r.stderr
 
 
+def test_llama_pretrain_health_assert():
+    """The dryrun numerics gate (docs/observability.md): a clean tiny
+    PS run under --health-assert exits zero naming the verdict, and a
+    run whose code path can never collect (no PS) FAILS loudly instead
+    of passing vacuously — a gate that cannot fail is no gate."""
+    import socket
+
+    # negative first (cheap): without --ps the plane never observes a
+    # gradient round — the engaged-proof must refuse the clean verdict
+    r = _run_example("llama_pretrain.py",
+                     ["--size", "tiny", "--steps", "1", "--batch", "4",
+                      "--health-assert"])
+    assert r.returncode != 0
+    assert "never observed a gradient round" in r.stdout + r.stderr
+    # positive: loopback PS (server subprocess + worker example run)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+           "DMLC_PS_ROOT_URI": "127.0.0.1",
+           "DMLC_PS_ROOT_PORT": str(port),
+           "BYTEPS_FORCE_DISTRIBUTED": "1"}
+    srv = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "from byteps_tpu.config import Config; "
+         "from byteps_tpu.server import run_server; "
+         "run_server(%d, Config(num_workers=1, num_servers=1))"
+         % (REPO, port)],
+        cwd=REPO, env=env)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PIN,
+             os.path.join(REPO, "examples", "llama_pretrain.py"),
+             "--size", "tiny", "--steps", "2", "--batch", "4", "--ps",
+             "--health-assert"],
+            cwd=REPO, capture_output=True, text=True, timeout=420,
+            env=env)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert "health assert: no anomaly events" in r.stdout
+        srv.wait(timeout=30)  # worker shutdown stops the server
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+
+
 def test_train_mnist_runs():
     r = _run_example("train_mnist.py", ["--epochs", "1",
                                         "--batch-size", "64"])

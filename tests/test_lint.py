@@ -304,6 +304,74 @@ def test_ctrl_limit_skew(tmp_path):
     assert "silently empty" in findings[0].message
 
 
+# training-health record (PR 15): the HEALTH_PULL reply's fixed-width
+# HealthRec rides the same slot-manifest machinery as the trace/flight
+# records — manifest + struct size diffed against the Python mirror
+_CC_HEALTH = _CC_SLOTS + """
+    #pragma pack(push, 1)
+    struct HealthRec {
+      uint64_t key;
+      uint64_t round;
+      uint64_t sumsq_bits;
+      uint64_t absmax_bits;
+      uint64_t nonfinite;
+      uint64_t elems;
+    };
+    #pragma pack(pop)
+    static_assert(sizeof(HealthRec) == 48, "health record layout");
+    static const char* const kHealthRecFields[] = {
+        "key", "round", "sumsq_bits", "absmax_bits", "nonfinite",
+        "elems"};
+"""
+
+_PY_HEALTH = _PY_SLOTS + """
+    HEALTH_REC_FMT = "<QQQQQQ"
+    _HEALTH_REC_FIELDS = ("key", "round", "sumsq_bits", "absmax_bits",
+                          "nonfinite", "elems")
+"""
+
+
+def test_health_rec_clean_fixture(tmp_path):
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_HEALTH,
+        "server/client.py": _PY_HEALTH,
+    })
+    assert run_lint(root, ["wire-layout"]) == []
+
+
+def test_health_rec_renamed_field(tmp_path):
+    # the drift class: a field renamed native-side while the Python
+    # parser (which reassembles the double bit patterns) lags
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_HEALTH.replace('"sumsq_bits"',
+                                           '"sumsq"'),
+        "server/client.py": _PY_HEALTH,
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "_HEALTH_REC_FIELDS" in findings[0].message
+    assert "sumsq" in findings[0].message
+
+
+def test_health_rec_fmt_size_skew(tmp_path):
+    # the record grew native-side; the struct-format mirror that sizes
+    # the client's reply buffer still packs the old 48 bytes
+    root = _write_tree(tmp_path, {
+        "native/ps.cc": _CC_HEALTH.replace(
+            "uint64_t elems;", "uint64_t elems;\n      uint64_t rsvd;"
+        ).replace("sizeof(HealthRec) == 48",
+                  "sizeof(HealthRec) == 56").replace(
+            '"nonfinite",\n        "elems"};',
+            '"nonfinite",\n        "elems", "rsvd"};'),
+        "server/client.py": _PY_HEALTH.replace(
+            '"nonfinite", "elems")', '"nonfinite", "elems", "rsvd")'),
+    })
+    findings = run_lint(root, ["wire-layout"])
+    assert len(findings) == 1
+    assert "HEALTH_REC_FMT packs 48" in findings[0].message
+    assert "56" in findings[0].message
+
+
 # --------------------------------------------------------------------- #
 # guarded-by
 # --------------------------------------------------------------------- #

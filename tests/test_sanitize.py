@@ -67,6 +67,13 @@ def worker(w):
         for ctx in ctxs:
             x = rng.randn(3000).astype(np.float32)
             c.push_pull(ctx, x, average=True, num_workers=2)
+        # training-health leg (BYTEPS_HEALTH=1 in the test env): the
+        # fused in-fold stat kernel ran on the folds above; the keyed
+        # HEALTH_PULL control op races the data plane inline on the
+        # conn loop, and both workers read the same KeyStore hstat
+        # the engines publish under ks.mu
+        hp = ctxs[step % len(ctxs)].partitions[0]
+        c.health_pull(hp.server, hp.key, timeout_s=5)
         ct.push_pull(rng.randn(2048).astype(np.float32))
         # descriptor-tier round: arena in-place fold + fold scratch +
         # block reclaim, raced by both workers every step
@@ -272,6 +279,10 @@ def test_sanitized_loopback_stress(tmp_path, mode):
         # small arena: the stress's 96KB descriptor-tier rounds wrap
         # and reclaim the block ring many times under the sanitizer
         "BYTEPS_IPC_ARENA_BYTES": str(512 << 10),
+        # training-health leg: the in-fold stat pass (fused last-fold
+        # kernel + publish scans) and the HEALTH_PULL control op run
+        # under the sanitizer with both workers racing
+        "BYTEPS_HEALTH": "1",
         # jax under sanitizers is hopeless; the stress uses numpy only
         "JAX_PLATFORMS": "cpu",
     }
