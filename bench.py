@@ -1795,6 +1795,122 @@ def phase_stream_ab(steps: int = 6, reps: int = 4,
             "stream_fallback_leaves": stats["export_fallback_leaves"]}
 
 
+def phase_barrier_ab(steps: int = 8, reps: int = 4,
+                     slow_ms: int = 10) -> dict:
+    """A/B cross-barrier bounded-staleness pipelining
+    (BYTEPS_CROSS_BARRIER + BYTEPS_STALENESS, jax/train.py +
+    core/scheduler.py + the server's round window) on the PS train
+    step: the same model/batch trained with staleness 1 vs the
+    synchronous barrier, INTERLEAVED reps, best-of step wall per arm.
+    Staleness 1 releases the next step's forward once the front-of-
+    model leaves have imported; the tail leaves' PULL→H2D→UPDATE is
+    carried across the step boundary and drained under the NEXT step's
+    compute, so the end-of-step barrier no longer pays the straggling
+    tail. Host-CPU only.
+
+    The server runs under BYTEPS_CHAOS_SLOW_SERVER — the same core-
+    independent trick as phase_stream_ab's throttle: the chaos knob
+    SLEEPS the serving thread per request, making wire+server time a
+    genuinely non-CPU resource (the slow-straggler deployment the
+    bounded-staleness window exists for), so the A/B measures barrier
+    removal rather than core time-slicing. Two engaged-proofs ride the
+    result: the carried-leaf counters must be nonzero (the carry
+    actually crossed the step boundary — not a vacuous win) and the
+    ledger's ``overlap_frac`` must be strictly UP vs the sync arm (the
+    carried drain really ran under compute)."""
+    import gc
+
+    def run(enabled: bool, shared: dict):
+        os.environ["BYTEPS_CROSS_BARRIER"] = "1" if enabled else "0"
+        os.environ["BYTEPS_STALENESS"] = "1" if enabled else "0"
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # whole-leaf weights above the fusion threshold: the
+            # back-half of the flatten order is carry-eligible; biases
+            # ride the fused bucket, which keeps the synchronous drain
+            # (exactly the mixed layout a real model presents)
+            params = {f"w{i}": _cpu_put(
+                rng.randn(768, 768).astype(np.float32))
+                for i in range(6)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(768).astype(np.float32)) for i in range(6)})
+            batch = _cpu_put(rng.randn(32, 768).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(6):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.adam(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            for _ in range(steps):
+                gc.collect()
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, batch)
+                float(loss)
+                shared["walls"].append(time.perf_counter() - t0)
+            if hasattr(step, "flush"):  # fold the outstanding carry
+                params, opt = step.flush(params, opt)
+            m = get_state().metrics
+            shared["carried"] += m.counter(
+                "barrier/carried_leaves").value
+            shared["drained"] += m.counter(
+                "barrier/carry_drained").value
+            for rep in bps.get_step_reports():
+                if rep.get("overlap_frac") is not None:
+                    shared["overlaps"].append(rep["overlap_frac"])
+
+    saved = {k: os.environ.get(k) for k in (
+        "BYTEPS_CROSS_BARRIER", "BYTEPS_STALENESS",
+        "BYTEPS_CHAOS_SLOW_SERVER", "BYTEPS_LOCAL_SHARD_EXPORT")}
+    # slow server = the straggler regime; shard export off so the tail
+    # keys stay whole-leaf (shard subranges keep the sync drain by
+    # design and would leave the carry nothing to take)
+    os.environ["BYTEPS_CHAOS_SLOW_SERVER"] = str(slow_ms)
+    os.environ["BYTEPS_LOCAL_SHARD_EXPORT"] = "0"
+    # INTERLEAVED reps (the phase_scaling lesson): host-load drift on a
+    # shared box otherwise lands on one arm only and decides the A/B
+    on = {"walls": [], "overlaps": [], "carried": 0, "drained": 0}
+    off = {"walls": [], "overlaps": [], "carried": 0, "drained": 0}
+    try:
+        for _ in range(reps):
+            run(True, on)
+            run(False, off)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    on_ms = min(on["walls"]) * 1e3
+    off_ms = min(off["walls"]) * 1e3
+    ov_on = max(on["overlaps"]) if on["overlaps"] else None
+    ov_off = max(off["overlaps"]) if off["overlaps"] else None
+    return {"barrier_on_step_ms": round(on_ms, 2),
+            "barrier_off_step_ms": round(off_ms, 2),
+            "barrier_speedup": round(off_ms / on_ms, 3) if on_ms else
+            None,
+            "barrier_overlap_on_frac": round(ov_on, 4)
+            if ov_on is not None else None,
+            "barrier_overlap_off_frac": round(ov_off, 4)
+            if ov_off is not None else None,
+            "barrier_carried_leaves": on["carried"],
+            "barrier_carry_drained": on["drained"],
+            "barrier_sync_carried_leaves": off["carried"]}
+
+
 def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
                        steps: int = 3) -> dict:
     """The PS-worker-on-a-TPU-host measurement the CPU-forced phase
@@ -2037,6 +2153,7 @@ _PHASES = {
     "ledger_ab": phase_ledger_ab,
     "health_ab": phase_health_ab,
     "stream_ab": phase_stream_ab,
+    "barrier_ab": phase_barrier_ab,
     "wire_ab": phase_wire_ab,
     "fold_ab": phase_fold_ab,
     "shard_ab": phase_shard_ab,
@@ -2213,6 +2330,13 @@ def main() -> None:
         "stream_off_step_ms": None,
         "stream_ttfp_on_ms": None,
         "stream_ttfp_off_ms": None,
+        "barrier_on_step_ms": None,
+        "barrier_off_step_ms": None,
+        "barrier_speedup": None,
+        "barrier_overlap_on_frac": None,
+        "barrier_overlap_off_frac": None,
+        "barrier_carried_leaves": None,
+        "barrier_carry_drained": None,
         "wire_fused_step_ms": None,
         "wire_twoop_step_ms": None,
         "wire_request_ratio": None,
@@ -2449,6 +2573,13 @@ def main() -> None:
                             # export + sharded apply on vs off, step
                             # wall + time-to-first-push
                             ("stream_ab", 240.0),
+                            # cross-barrier bounded-staleness A/B:
+                            # staleness 1 vs the sync barrier under the
+                            # slow-server chaos knob, with the carried-
+                            # leaf counter + overlap_frac engaged-proof
+                            # — in the runs-first group (new driver
+                            # key)
+                            ("barrier_ab", 240.0),
                             # fused PUSHPULL wire-op A/B: one message
                             # vs push+pull pair, plus the deterministic
                             # half-the-request-messages counter proof

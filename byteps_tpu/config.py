@@ -170,6 +170,24 @@ class Config:
     # identical either way). ---
     fused_pushpull: bool = True           # BYTEPS_FUSED_PUSHPULL
 
+    # --- cross-barrier bounded-staleness pipelining (rebuild addition;
+    # the reference's cross_barrier torch hook, docs/cross-barrier.md,
+    # generalized to the JAX step). On: the train step releases step
+    # k+1's forward as soon as the FRONT-of-model leaves of step k have
+    # imported and applied; the tail leaves' PULL→H2D→UPDATE drains
+    # across the step boundary, overlapping the next step's compute —
+    # what production-order priority was built for. staleness bounds
+    # the pipeline: at most staleness+1 rounds of one key in flight
+    # worker-side, and the server parks (never folds) stamped rounds up
+    # to `staleness` ahead of the accepting one (native RoundGate
+    # window). staleness=0 with cross_barrier on degenerates to the
+    # synchronous path bit-for-bit. Numerics at staleness>=1 are the
+    # bounded-staleness lineage (PAPERS.md 2105.07829): tail leaves see
+    # a one-step-stale param/optimizer base; the health plane +
+    # BYTEPS_NAN_GUARD are the convergence guard. ---
+    cross_barrier: bool = False           # BYTEPS_CROSS_BARRIER
+    staleness: int = 1                    # BYTEPS_STALENESS
+
     # --- fault tolerance (rebuild addition; docs/fault-tolerance.md).
     # A failed wire exchange (fused PUSHPULL or two-op push/pull) no
     # longer hard-fails the round: the scheduler retries the partition
@@ -325,6 +343,8 @@ class Config:
             fusion_bytes=_env_int("BYTEPS_FUSION_BYTES",
                                   DEFAULT_FUSION_BYTES),
             fused_pushpull=_env_bool("BYTEPS_FUSED_PUSHPULL", True),
+            cross_barrier=_env_bool("BYTEPS_CROSS_BARRIER"),
+            staleness=max(0, min(8, _env_int("BYTEPS_STALENESS", 1))),
             wire_retry=_env_int("BYTEPS_WIRE_RETRY", 2),
             wire_backoff_ms=float(
                 _env_str("BYTEPS_WIRE_BACKOFF_MS", "50")),

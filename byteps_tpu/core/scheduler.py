@@ -49,7 +49,8 @@ UNLIMITED_CREDIT = 32 << 30
 class ScheduledQueue:
     """Priority + credit gated task queue (scheduled_queue.cc)."""
 
-    def __init__(self, credit_bytes: int = 0, metrics=None, profiler=None):
+    def __init__(self, credit_bytes: int = 0, metrics=None, profiler=None,
+                 window: int = 0):
         # credit_bytes <= 0 -> scheduling disabled -> huge credit
         self._credit = (credit_bytes if credit_bytes > 0
                         else UNLIMITED_CREDIT)  # guarded-by: _cv|_mu
@@ -61,10 +62,20 @@ class ScheduledQueue:
         self._heap: List = []          # guarded-by: _cv|_mu
         self._counter = itertools.count()
         self._stopped = False          # guarded-by: _cv|_mu
-        # keys with a task currently running: same-key tasks are serialized
-        # so overlapping push_pulls of one tensor can't interleave their
-        # PUSH/PULL into the same server aggregation round
-        self._inflight: set = set()    # guarded-by: _cv|_mu
+        # in-flight task count per key: same-key tasks are serialized —
+        # overlapping push_pulls of one tensor must not interleave their
+        # PUSH/PULL into the same server aggregation round — EXCEPT
+        # under the cross-barrier staleness credit (window > 0), where
+        # up to window+1 SUCCESSIVE rounds of one dense fused key may be
+        # in flight at once: each carries its own round stamp, and the
+        # server's RoundGate window parks (never mis-sums) the round
+        # that arrives ahead. Submission order is preserved by seq, so
+        # round k always admits before round k+1 of the same key.
+        self._inflight: Dict[int, int] = {}  # guarded-by: _cv|_mu
+        # staleness credit (BYTEPS_STALENESS, plumbed by the pipeline
+        # scheduler ONLY for fused-pushpull dense traffic): bound on
+        # extra same-key rounds admitted while one is in flight
+        self._window = max(0, int(window))
         # measurement plane (core/metrics.py); None when metrics off —
         # instrument refs cached here so the hot path never takes the
         # registry lock
@@ -108,7 +119,8 @@ class ScheduledQueue:
                 task = self._pop_admissible_locked()
                 if task is not None:
                     self._credit -= task.nbytes
-                    self._inflight.add(task.key)
+                    self._inflight[task.key] = \
+                        self._inflight.get(task.key, 0) + 1
                     depth = len(self._heap)
                     break
                 if (self._credit_blocked and not stall_counted
@@ -131,7 +143,10 @@ class ScheduledQueue:
 
     def _pop_admissible_locked(self) -> Optional["PartitionTask"]:
         """Pop the highest-priority admissible task. In-flight keys are
-        skipped (their next task runs when the current one finishes); a
+        skipped (their next task runs when the current one finishes)
+        unless the staleness window grants them extra same-key credit —
+        plain (uncompressed) tasks only, whose round-stamped folds the
+        server's window gate can park without mis-summing; a
         credit-starved head blocks admission entirely — lower-priority
         tasks must not overtake it just because they're smaller
         (scheduled_queue.cc:136-149 admits strictly in order)."""
@@ -141,7 +156,8 @@ class ScheduledQueue:
         while self._heap:
             item = heapq.heappop(self._heap)
             t = item[3]
-            if t.key in self._inflight:
+            limit = 1 + (self._window if t.stack is None else 0)
+            if self._inflight.get(t.key, 0) >= limit:
                 skipped.append(item)
                 continue
             # a task larger than the whole capacity must still run once
@@ -159,7 +175,11 @@ class ScheduledQueue:
     def report_finish(self, task: "PartitionTask") -> None:
         with self._cv:
             self._credit += task.nbytes
-            self._inflight.discard(task.key)
+            n = self._inflight.get(task.key, 0) - 1
+            if n > 0:
+                self._inflight[task.key] = n
+            else:
+                self._inflight.pop(task.key, None)
             self._cv.notify_all()
 
     def stop(self) -> None:
@@ -188,7 +208,7 @@ class ScheduledQueue:
         state under it)."""
         with self._mu:
             ks = set(keys)
-            if ks & self._inflight:
+            if ks & self._inflight.keys():
                 return False
             return not any(item[1] in ks for item in self._heap)
 
@@ -458,8 +478,20 @@ class PipelineScheduler:
                 "0", "false", "off", "no")
         self._fused = bool(fused_flag) and getattr(
             client, "supports_fused", False)
+        # Cross-barrier staleness credit (BYTEPS_CROSS_BARRIER /
+        # BYTEPS_STALENESS): the carried drain in jax/train.py submits
+        # step k+1's push_pull for a leaf whose step-k round may still
+        # be in flight, so the queue must admit up to window+1 rounds of
+        # one key. Fused-only: on the two-op path a pipelined PULL could
+        # read the PREVIOUS round's aggregate (the fused op's reply is
+        # round-stamped and parked server-side; a bare PULL is not).
+        xb_window = 0
+        if (self._fused and config is not None
+                and getattr(config, "cross_barrier", False)):
+            xb_window = max(0, int(getattr(config, "staleness", 0)))
+        self.xb_window = xb_window  # read by the train step's carry gate
         self._queue = ScheduledQueue(credit_bytes, metrics=metrics,
-                                     profiler=profiler)
+                                     profiler=profiler, window=xb_window)
         self._tracer = tracer
         self._telemetry = telemetry
         self._config = config

@@ -276,6 +276,11 @@ class GlobalState:
             # zeros, exactly like the wire/retries family
             from .autoscaler import register_autoscale_metrics
             register_autoscale_metrics(self.metrics)
+            # cross-barrier carry counters (jax/train.py): eager zeros
+            # on sync deployments — the perf gate reads "sync arm
+            # carried 0" as a contract, not a missing key
+            self.metrics.counter("barrier/carried_leaves")
+            self.metrics.counter("barrier/carry_drained")
             # Multi-process topology: rendezvous at the coordination
             # service (the reference's ps::StartPS + barrier,
             # global.cc:283-297) before any device query.

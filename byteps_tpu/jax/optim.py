@@ -123,6 +123,12 @@ class ShardedApply:
         # donating them would hand leaf 0 the buffer leaf 1 still needs
         self._jit = jax.jit(leaf_update,
                             donate_argnums=(0, 1) if donate else ())
+        # non-donating twin for the cross-barrier carried drain: a
+        # carried leaf's base param is ALSO the value the previous step
+        # returned to the caller (it rides the next forward while its
+        # update is still in flight), so donating it would invalidate a
+        # buffer the user's tree still references
+        self._jit_keep = jax.jit(leaf_update)
 
     # -- state plumbing ------------------------------------------------ #
 
@@ -147,6 +153,20 @@ class ShardedApply:
         use ``begin(opt_state)`` + ``round.apply``."""
         return _ShardedRound(self, opt_state).apply(param_leaf, i,
                                                     grad_leaf)
+
+    def apply_with(self, param_leaf, pparts, shared, grad_leaf):
+        """Explicit-base apply: update from caller-supplied
+        ``(param_parts, shared_parts)`` instead of slicing a live
+        opt_state. The cross-barrier carried drain needs this — when a
+        tail leaf's step-k gradient lands AFTER step k+1 has begun, its
+        base state is the snapshot captured at step k (the live
+        opt_state has moved on), so the carry hands that snapshot back
+        in. Returns ``(new_param_leaf, (param_parts, shared_parts))``
+        like ``_ShardedRound.apply``. Never donates: the base buffers
+        are shared with the caller's (stale) params/opt_state trees."""
+        new_p, n_pparts, n_shared = self._jit_keep(param_leaf, pparts,
+                                                   shared, grad_leaf)
+        return new_p, (n_pparts, n_shared)
 
     def merge(self, opt_state_template, results: List[Tuple[list, list]]):
         """Reassemble the full optimizer state from every leaf's

@@ -684,6 +684,15 @@ def make_ps_train_step(
                       "ssa_key": None, "gather": None}
     # deferred arena releases from sharded rounds: (leases, imported)
     pending: list = []
+    # cross-barrier pipelining state (BYTEPS_CROSS_BARRIER): "carry" is
+    # the previous step's still-in-flight tail — per-leaf waiters plus
+    # the exact (param, param_parts, shared) base their stale apply
+    # must chain from; "over" maps leaf index -> (new_param,
+    # new_pparts) produced by a carried apply, consumed as the base of
+    # that leaf's NEXT apply (or folded in by ``flush``); "par" is the
+    # step parity that keeps two live rounds of one key on disjoint
+    # arena slots. All touched from the step thread only.
+    xb_state: dict = {"carry": None, "over": {}, "par": 0}
 
     def local_grads(params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -1012,8 +1021,14 @@ def make_ps_train_step(
                     reg.push_pull, state, name, flat, True)
                 return fut.result, fut
             if state.scheduler is not None:
-                obuf = checkout(f"{name}:out", flat.nbytes, flat.dtype,
-                                tag=tag)
+                # carry-eligible keys alternate arena slots by step
+                # parity: with cross-barrier staleness the step-k slot
+                # can still be awaiting its pull when step k+1 checks
+                # the same key out, and a conflicting checkout would
+                # fall back to a fresh allocation every step
+                okey = (f"{name}:out~x{xb_par}"
+                        if name in xb_carry_names else f"{name}:out")
+                obuf = checkout(okey, flat.nbytes, flat.dtype, tag=tag)
                 hd = bps.push_pull_async(flat, name, average=True,
                                          priority=priority, out=obuf)
                 return (lambda: bps.synchronize(hd),
@@ -1304,6 +1319,42 @@ def make_ps_train_step(
             from .optim import LeafGather
             sa_state["gather"] = LeafGather(mesh, axis)
 
+        # ---- cross-barrier bounded staleness (BYTEPS_CROSS_BARRIER /
+        # BYTEPS_STALENESS, the PR 16 tentpole): instead of barriering
+        # on the full drain, the step releases once the front-of-model
+        # leaves (a flatten-order prefix — what the next forward reads
+        # first) have imported; the tail leaves' PULL→H2D→UPDATE is
+        # carried across the step boundary and drained after the NEXT
+        # step's export, overlapping its compute. Carry-eligible leaves
+        # are the plain dense whole-leaf keys only: bucket members,
+        # shard subranges, rowsparse and host-compressed keys keep the
+        # synchronous drain (their codec/assembly state is not
+        # round-windowed). Requires the per-leaf sharded apply (the
+        # carried update is a single-leaf chain) and the scheduler's
+        # staleness credit (window > 0 implies fused pushpull, whose
+        # replies are round-stamped server-side).
+        xb_window = getattr(state.scheduler, "xb_window", 0) \
+            if state.scheduler is not None else 0
+        xb_on = bool(xb_window > 0 and sa is not None and reg is None)
+        xb_over = xb_state["over"]
+        xb_carry_set: set = set()
+        if xb_on:
+            xb_state["par"] ^= 1
+            shard_planned = set(shard_set)
+            rel_n = max(1, (len(names) + 1) // 2)
+            for i, nm in enumerate(names):
+                if i < rel_n:
+                    continue
+                nb = getattr(p_leaves[i], "nbytes", 0)
+                if nb == 0 or nb < fusion or i in shard_planned:
+                    continue
+                if rowsparse_params and any(s in nm
+                                            for s in rowsparse_params):
+                    continue
+                xb_carry_set.add(i)
+        xb_carry_names = {names[i] for i in xb_carry_set}
+        xb_par = xb_state["par"]
+
         # ---- dispatch the backward (tapped when streaming) ----
         round_obj = None
         loss = grads = None
@@ -1457,6 +1508,60 @@ def make_ps_train_step(
                 # np.asarray above blocked on ITS leaf): the compute +
                 # export wall of this step's report
                 prof.mark("export_done")
+            # ---- carried drain (BYTEPS_CROSS_BARRIER): the PREVIOUS
+            # step's tail rounds land here, AFTER this step's backward
+            # has been dispatched and its exports submitted — their
+            # PULL wait overlaps this step's compute, which is the
+            # whole point. Each carried apply chains from the exact
+            # base captured at carry time (never the live opt_state,
+            # which has moved on) via the non-donating apply_with, and
+            # its result becomes this step's base for the same leaf
+            # (``xb_over``). Health stats tap into THIS step's
+            # collector: one tap per leaf per step at steady state, so
+            # the per-round detectors see divergence within one step.
+            prev_carry = xb_state["carry"]
+            xb_state["carry"] = None
+            if prev_carry is not None:
+                try:
+                    for (s, fin, _nt, bp, bpp, bsh) in \
+                            prev_carry["entries"]:
+                        piece = fin()
+                        if hc is not None:
+                            hc.leaf(s, piece)
+                        arr = jax.device_put(
+                            piece.reshape(np.shape(bp)))
+                        npar, nparts = prev_carry["sa"].apply_with(
+                            bp, bpp, bsh, arr)
+                        xb_over[s] = (npar, nparts[0])
+                        prev_carry["imported"].append(arr)
+                except BaseException:
+                    # a failed carried pull loses step k's update for
+                    # this leaf: same contract as a mid-drain failure
+                    # of the donated apply — abandon, surface, restart
+                    # from a checkpoint
+                    for lease in prev_carry["leases"]:
+                        lease.abandon()
+                    for (_s, _f, nt, *_rest) in prev_carry["entries"]:
+                        if hasattr(nt, "id"):
+                            state.handles.discard(nt.id)
+                    raise
+                centry = (prev_carry["leases"], prev_carry["imported"])
+                pending.append(centry)
+
+                def _xb_release(entry=centry):
+                    try:
+                        jax.block_until_ready([a for a in entry[1]
+                                               if a is not None])
+                    except Exception:  # noqa: BLE001 - failed imports:
+                        for lease in entry[0]:  # never recycle
+                            lease.abandon()
+                        return
+                    for lease in entry[0]:
+                        lease.release()
+
+                _release_pool().submit(_xb_release)
+                metrics.counter("barrier/carry_drained").inc(
+                    len(prev_carry["entries"]))
             # param shapes, not gradient-output shapes: a shard-planned
             # leaf's program output is the flat padded sharded layout,
             # but everything imported/applied below is leaf-shaped
@@ -1493,8 +1598,16 @@ def make_ps_train_step(
                 arr = jax.device_put(piece.reshape(shapes[s]))
                 imported[s] = arr
                 if sa_round is not None:
-                    new_params[s], apply_parts[s] = sa_round.apply(
-                        p_leaves[s], s, arr)
+                    ov = xb_over.pop(s, None) if xb_over else None
+                    if ov is not None:
+                        # this leaf's previous round was carried: chain
+                        # from the carried apply's result, not the
+                        # (one-step-stale) tree slices
+                        new_params[s], apply_parts[s] = sa.apply_with(
+                            ov[0], ov[1], sa_round.slice(s)[1], arr)
+                    else:
+                        new_params[s], apply_parts[s] = sa_round.apply(
+                            p_leaves[s], s, arr)
                 dt = _time.perf_counter() - t0
                 h2d_hist.record_seconds(dt)
                 if prof is not None:
@@ -1549,13 +1662,7 @@ def make_ps_train_step(
                 if prof is not None:
                     prof.stage_sample("ALLGATHER", dt)
 
-            for _ in range(len(waiters)):
-                t_wait = _time.perf_counter()
-                wi = ready.get()
-                if prof is not None:
-                    # time the drain sat blocked waiting for a pull to
-                    # land — the direct "PULL is the bottleneck" signal
-                    prof.add_pull_wait(_time.perf_counter() - t_wait)
+            def _dispatch(wi):
                 slot, finish, _ = waiters[wi]
                 if isinstance(slot, list):
                     for s, piece in zip(slot, finish()):
@@ -1564,6 +1671,67 @@ def make_ps_train_step(
                     land_shard(slot[1], slot[2], finish())
                 else:
                     land(slot, finish())
+
+            # cross-barrier release condition: every NON-carryable
+            # waiter must land this step (front-of-model leaves,
+            # buckets, shards, rowsparse); carry-eligible tail leaves
+            # land if their pull has already fired, and are otherwise
+            # carried across the step boundary. With the window off
+            # this is exactly the old "drain everything" loop.
+            xb_carry_wi = {wi for wi, (sl, _f, _n) in enumerate(waiters)
+                           if isinstance(sl, int) and sl in xb_carry_set}
+            must_land = len(waiters) - len(xb_carry_wi)
+            done_wi: set = set()
+            landed_req = 0
+            while landed_req < must_land:
+                t_wait = _time.perf_counter()
+                wi = ready.get()
+                if prof is not None:
+                    # time the drain sat blocked waiting for a pull to
+                    # land — the direct "PULL is the bottleneck" signal
+                    prof.add_pull_wait(_time.perf_counter() - t_wait)
+                _dispatch(wi)
+                done_wi.add(wi)
+                if wi not in xb_carry_wi:
+                    landed_req += 1
+            # opportunistic: a carry-eligible pull that already fired
+            # costs nothing to drain now
+            while xb_carry_wi:
+                try:
+                    wi = ready.get_nowait()
+                except _queue.Empty:
+                    break
+                _dispatch(wi)
+                done_wi.add(wi)
+            xb_pend = sorted(xb_carry_wi - done_wi)
+            if xb_pend:
+                centries = []
+                for wi in xb_pend:
+                    s, fin, notif = waiters[wi]
+                    pparts, shared = sa_round.slice(s)
+                    ov = xb_over.pop(s, None)
+                    bp = ov[0] if ov is not None else p_leaves[s]
+                    bpp = ov[1] if ov is not None else pparts
+                    centries.append((s, fin, notif, bp, bpp, shared))
+                    # the step returns the freshest APPLIED value for a
+                    # carried leaf — at most one step behind — and its
+                    # stale state slices; the carry's base_override
+                    # chain keeps the true state, and ``flush`` folds
+                    # the final values in at end of run
+                    new_params[s] = bp
+                    apply_parts[s] = (bpp, shared)
+                ckeys = {f"{names[s]}:out~x{xb_par}"
+                         for (s, *_rest) in centries}
+                # the carried leaves' result slots stay leased until
+                # the carried drain consumes them next step — they must
+                # NOT ride this step's deferred release
+                cleases = [lz for lz in leases if lz.key in ckeys]
+                leases[:] = [lz for lz in leases if lz.key not in ckeys]
+                xb_state["carry"] = {"entries": centries,
+                                     "leases": cleases,
+                                     "imported": [], "sa": sa}
+                metrics.counter("barrier/carried_leaves").inc(
+                    len(centries))
             if sa is None:
                 # fused apply: wait for the H2D transfers (apply_fn
                 # needs them anyway) so the arena slots are provably
@@ -1586,6 +1754,18 @@ def make_ps_train_step(
                 # mid-flight on the export worker may still be checking
                 # out a lease / allocating a handle
                 round_obj.cancel()
+            # a raised step voids the cross-barrier chain: overrides
+            # reference buffers from the failed round, and a restarted
+            # run must not apply them onto checkpoint-restored trees
+            xbc = xb_state["carry"]
+            xb_state["carry"] = None
+            if xbc is not None:
+                for lease in xbc["leases"]:
+                    lease.abandon()
+                for (_s, _f, nt, *_rest) in xbc["entries"]:
+                    if hasattr(nt, "id"):
+                        state.handles.discard(nt.id)
+            xb_state["over"].clear()
             for lease in leases:
                 lease.abandon()
             for _, _, notifier in waiters:
@@ -1666,9 +1846,51 @@ def make_ps_train_step(
             hplane.raise_if_fatal()
         return params, opt_state, loss
 
+    def flush(params, opt_state):
+        """Drain the cross-barrier carry and fold every outstanding
+        override into ``(params, opt_state)`` — call once after the
+        LAST step of a run (a checkpoint cut counts). Without
+        BYTEPS_CROSS_BARRIER (or with nothing carried) this returns
+        its arguments unchanged."""
+        carry = xb_state["carry"]
+        xb_state["carry"] = None
+        over = xb_state["over"]
+        if carry is not None:
+            try:
+                for (s, fin, _nt, bp, bpp, bsh) in carry["entries"]:
+                    piece = fin()
+                    arr = jax.device_put(piece.reshape(np.shape(bp)))
+                    npar, nparts = carry["sa"].apply_with(
+                        bp, bpp, bsh, arr)
+                    over[s] = (npar, nparts[0])
+                    carry["imported"].append(arr)
+                jax.block_until_ready(carry["imported"])
+            except BaseException:
+                for lease in carry["leases"]:
+                    lease.abandon()
+                raise
+            for lease in carry["leases"]:
+                lease.release()
+        if not over:
+            return params, opt_state
+        sa = sa_state["sa"]
+        leaves, tdef = jax.tree_util.tree_flatten(params)
+        rnd = sa.begin(opt_state)
+        results = []
+        for s in range(len(leaves)):
+            pp, sh = rnd.slice(s)
+            ov = over.pop(s, None)
+            if ov is not None:
+                leaves[s] = ov[0]
+                pp = ov[1]
+            results.append((pp, sh))
+        return tdef.unflatten(leaves), sa.merge(opt_state, results)
+
     # tick the Chrome-trace step counter: the PUSH/PULL/COMPRESS spans the
     # scheduler records are windowed by step (BYTEPS_TRACE_START/END_STEP)
-    return _with_tracer_tick(step)
+    stepper = _with_tracer_tick(step)
+    stepper.flush = flush
+    return stepper
 
 
 def make_async_ps_train_step(

@@ -2383,7 +2383,7 @@ static const char* const kStatSlotNames[] = {
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
     "flight_dropped", "draining", "health_rounds",
-    "health_nonfinite"};
+    "health_nonfinite", "window_deferred", "window_rejected"};
 static constexpr size_t kNumStatSlots =
     sizeof(kStatSlotNames) / sizeof(kStatSlotNames[0]);
 
@@ -2486,15 +2486,24 @@ struct ParkedPull {
   // key carried for the flight/trace planes (a chaos-dropped reply
   // names the partition it starved, rid+key-matchable worker-side)
   uint64_t key = 0;
+  // round this pull must be answered WITH (epoch >> 16 of the fused
+  // push; 0 = unstamped/two-op -> positional semantics). Under the
+  // cross-barrier window two rounds of one key can be parked at once,
+  // and round R's requester must get round R's aggregate even after
+  // R+1 published over pub/pub_wire (KeyStore::pub_hist).
+  uint64_t round = 0;
   ParkedPull() = default;
   // explicit ctor (not aggregate init): trailing fields grew twice now
   // and -Wmissing-field-initializers + 10 brace sites is exactly the
   // drift the ReplyHeader() factory exists to avoid
   ParkedPull(std::shared_ptr<Conn> c, uint32_t r, uint16_t s,
-             bool comp = false, uint8_t tr = 0, uint64_t k = 0)
+             bool comp = false, uint8_t tr = 0, uint64_t k = 0,
+             uint64_t rnd = 0)
       : conn(std::move(c)), rid(r), sender(s), compressed(comp),
-        traced(tr), key(k) {}
+        traced(tr), key(k), round(rnd) {}
 };
+
+struct EngineMsg;  // defined below; KeyStore::deferred parks copies
 
 struct KeyStore {
   Mu mu;                 // per-key lock: sums/copies of different
@@ -2573,6 +2582,30 @@ struct KeyStore {
   // (BYTEPS_HEALTH; guarded-by: mu). Overwritten at every publish,
   // served over HEALTH_PULL.
   HStat hstat;
+  // ---- cross-barrier bounded-staleness window (BYTEPS_STALENESS) --- //
+  // Stamped folds carrying a round AHEAD of the one currently
+  // accepting — within window W — are PARKED here in owned storage and
+  // redispatched when their round becomes current (publish of the
+  // round before them). They are NEVER folded early, so a mis-sum of
+  // two training rounds stays impossible by construction; rounds still
+  // complete strictly in order. One entry per (sender, round), bounded
+  // by W x num_workers; empty whenever the window is 0.
+  std::vector<EngineMsg> deferred;  // guarded-by: mu
+  // Round number of the newest PUBLISHED aggregate (0 = the last round
+  // completed unstamped). Round-stamped parked pulls become answerable
+  // when this reaches their round — positional push-count bookkeeping
+  // can't distinguish two parked rounds of one key.
+  uint64_t pub_round = 0;           // guarded-by: mu
+  // Published-aggregate history (the W+1 newest rounds, oldest first):
+  // a parked pull for round R must be answered with ROUND R's
+  // aggregate even after R+1 published over pub/pub_wire. Populated
+  // only when the server's window is nonzero.
+  struct PubHist {
+    uint64_t round;
+    std::shared_ptr<const Buf> pub;
+    std::shared_ptr<const Buf> pub_wire;
+  };
+  std::vector<PubHist> pub_hist;    // guarded-by: mu
 };
 
 struct EngineMsg {
@@ -2693,6 +2726,20 @@ class Server {
         health_([] {
           const char* e = ::getenv("BYTEPS_HEALTH");
           return e && *e && std::strcmp(e, "0") != 0;
+        }()),
+        // cross-barrier staleness window (read per instance like the
+        // chaos knobs, so an A/B bench can run windowed and strict
+        // servers in one process): BYTEPS_STALENESS wins when set;
+        // otherwise BYTEPS_CROSS_BARRIER implies its default of 1.
+        // 0 = today's strict RoundAligned gate, bit-for-bit.
+        window_([] {
+          const char* e = ::getenv("BYTEPS_STALENESS");
+          if (e && *e) {
+            long v = std::atol(e);
+            return (uint64_t)(v < 0 ? 0 : v > 8 ? 8 : v);
+          }
+          const char* x = ::getenv("BYTEPS_CROSS_BARRIER");
+          return (uint64_t)(x && *x && std::strcmp(x, "0") != 0 ? 1 : 0);
         }()) {
     n_engines_ = num_engine_threads < 1 ? 1 : num_engine_threads;
     engine_bytes_.reset(new std::atomic<uint64_t>[n_engines_]);
@@ -2725,7 +2772,8 @@ class Server {
         (uint64_t)n_engines_,   trace_ring_.total(),
         trace_ring_.dropped(),  flight_ring_.total(),
         flight_ring_.dropped(), draining_.load() ? 1ull : 0ull,
-        health_rounds_.load(),  health_nonfinite_.load()};
+        health_rounds_.load(),  health_nonfinite_.load(),
+        window_deferred_.load(), window_rejected_.load()};
     int n = max_n < (int)kNumStatSlots ? max_n : (int)kNumStatSlots;
     for (int i = 0; i < n; ++i) out[i] = v[i];
     return n;
@@ -3051,6 +3099,15 @@ class Server {
         std::lock_guard<Mu> lk2(ks.mu);
         for (auto& p : ks.parked_pulls) victims.push_back(p);
         for (auto& p : ks.parked_inits) victims.push_back(p);
+        // deferred folds belong to rounds AFTER the one the rollback
+        // just dropped; their senders' last_round resets below, so the
+        // retries (error-reply -> client resend) fold normally against
+        // the re-armed round sequence
+        for (auto& d : ks.deferred) {
+          victims.push_back({d.conn, d.rid, d.sender});
+          if (!d.payload.empty()) pool_.put(std::move(d.payload));
+        }
+        ks.deferred.clear();
         ks.parked_pulls.clear();
         ks.parked_inits.clear();
         // re-arm: the incomplete round's partial sum is dropped (next
@@ -3463,6 +3520,11 @@ class Server {
     return true;
   }
 
+  // Round-alignment gate verdicts. kGateAligned folds now; kGateDefer
+  // parks the message for a later round (cross-barrier window only);
+  // kGateReject error-replies — the fold never happens.
+  enum GateVerdict { kGateAligned = 0, kGateDefer, kGateReject };
+
   // Round-alignment gate (call under ks.mu, after IsReplay, before the
   // fold): sync-mode stamped folds summing into ONE aggregation round
   // must all carry the SAME round number. The first fold of a round
@@ -3470,25 +3532,158 @@ class Server {
   // hazard — after a migration, a worker that consumed round N's reply
   // pushes N+1 while a worker whose reply was lost re-pushes N, and
   // positional counting would silently sum the two rounds together.
-  // Unstamped folds (legacy) and async mode keep positional semantics.
-  bool RoundAligned(KeyStore& ks, const EngineMsg& m) {
-    if (async_) return true;
+  // The cross-barrier GENERALIZATION (window_ > 0): a fold up to
+  // window_ rounds AHEAD of the accepting round is kGateDefer — parked
+  // by DeferFold, folded only when its round becomes current, so the
+  // mis-sum stays impossible by construction — and anything beyond the
+  // window is still the loud reject. window_ == 0 keeps these exact
+  // semantics: rnd ahead mid-round rejects, and a fresh round latches
+  // whatever opens it. Unstamped folds (legacy) and async mode keep
+  // positional semantics throughout.
+  GateVerdict RoundGate(KeyStore& ks, const EngineMsg& m) {
+    if (async_) return kGateAligned;
     uint64_t rnd = m.epoch >> 16;
     if (ks.recv_count == 0) {
+      if (window_ && rnd) {
+        // between rounds, the next stamped round that may OPEN is the
+        // one after the last PUBLISHED round (pub_round survives a
+        // departure rollback; round_open does not roll back, so it
+        // would mis-read an aborted round as completed). A stamped
+        // fold further ahead is a pipelined worker running ahead of a
+        // straggler — park it (within W) instead of latching a round
+        // the slow worker could never join; beyond W is the loud
+        // reject. No stamped history at all (fresh store / migration
+        // landing) latches freely, as the strict gate always has.
+        uint64_t expect = ks.pub_round
+                              ? ks.pub_round + 1
+                              : (ks.round_open ? ks.round_open + 1 : rnd);
+        if (rnd > expect) {
+          if (rnd <= expect + window_) return kGateDefer;
+          return RejectSkew(ks, m, rnd);
+        }
+      }
       ks.round_open = rnd;  // rnd==0: round opened unstamped, no gate
-      return true;
+      return kGateAligned;
     }
-    if (!rnd || ks.round_open == 0 || rnd == ks.round_open) return true;
+    if (!rnd || ks.round_open == 0 || rnd == ks.round_open)
+      return kGateAligned;
+    if (window_ && rnd > ks.round_open && rnd <= ks.round_open + window_)
+      return kGateDefer;
+    return RejectSkew(ks, m, rnd);
+  }
+
+  GateVerdict RejectSkew(KeyStore& ks, const EngineMsg& m, uint64_t rnd) {
     std::fprintf(stderr,
                  "[bps-server] round skew key=%llu sender=%u: round "
-                 "opened at %llu, this push carries %llu — refusing to "
-                 "fold (workers are folding different training rounds; "
-                 "partial-reply window after a migration?)\n",
+                 "opened at %llu, this push carries %llu (window %llu) "
+                 "— refusing to fold (workers are folding different "
+                 "training rounds; partial-reply window after a "
+                 "migration, or staleness beyond the bound?)\n",
                  (unsigned long long)m.key, (unsigned)m.sender,
                  (unsigned long long)ks.round_open,
-                 (unsigned long long)rnd);
+                 (unsigned long long)rnd,
+                 (unsigned long long)window_);
     Flight(kFlightRoundSkew, m.key, m.rid, m.sender, rnd);
-    return false;
+    if (window_)
+      window_rejected_.fetch_add(1, std::memory_order_relaxed);
+    return kGateReject;
+  }
+
+  // Park a within-window future-round fold (call under ks.mu, verdict
+  // kGateDefer). The message moves into OWNED storage: an out-of-band
+  // payload is copied out so its shm arena block releases through the
+  // normal engine epilogue (a parked fold must never pin the peer's
+  // arena across rounds), and a moved-out owned payload leaves
+  // m.payload empty so the epilogue's pool recycle skips it. One
+  // parked fold per (sender, round): a retry of an already-parked
+  // round REPLACES the original — its rid is newer, and the client
+  // abandoned the old one. Overflow past W x workers is a protocol
+  // violation (the worker-side staleness credit should make it
+  // impossible) and rejects loudly. Returns false on overflow; the
+  // caller error-replies.
+  bool DeferFold(KeyStore& ks, EngineMsg& m) {
+    uint64_t rnd = m.epoch >> 16;
+    EngineMsg d;
+    d.op = m.op;
+    d.key = m.key;
+    d.req = m.req;
+    d.dtype = m.dtype;
+    d.rid = m.rid;
+    d.sender = m.sender;
+    d.epoch = m.epoch;
+    d.codec = m.codec;
+    d.traced = m.traced;
+    d.conn = m.conn;
+    if (m.oob) {
+      d.payload.assign(m.data(), m.data() + m.size());
+    } else {
+      d.payload = std::move(m.payload);
+    }
+    for (auto& e : ks.deferred) {
+      if (e.sender == m.sender && (e.epoch >> 16) == rnd) {
+        e = std::move(d);
+        window_deferred_.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
+    }
+    size_t cap = (size_t)window_ *
+                 (size_t)(num_workers_ > 0 ? num_workers_ : 1);
+    if (ks.deferred.size() >= cap) {
+      m.payload = std::move(d.payload);  // give the bytes back for the
+                                         // epilogue's pool recycle
+      RejectSkew(ks, m, rnd);
+      return false;
+    }
+    ks.deferred.push_back(std::move(d));
+    window_deferred_.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  // Publish epilogue (call under ks.mu at EVERY aggregate publish,
+  // after completed_rounds++ / PublishHealth): flush the parked pulls
+  // this publish satisfies and hand back any deferred folds for
+  // redispatch. With the window off (or async) this is exactly the old
+  // flush.swap — every parked pull was waiting for this one round.
+  // Windowed, the just-completed round is recorded (pub_round +
+  // history ring) and only the parked pulls whose round has now
+  // published flush; a pull parked for a round still aggregating stays
+  // parked — answering it with this round's bytes would hand a
+  // pipelined worker round N's aggregate stamped as N+1.
+  void WindowPublishLocked(KeyStore& ks, std::vector<ParkedPull>* flush,
+                           std::vector<EngineMsg>* defer) {
+    if (window_ == 0 || async_) {
+      flush->swap(ks.parked_pulls);
+      return;
+    }
+    ks.pub_round = ks.round_open;
+    ks.pub_hist.push_back({ks.pub_round, ks.pub, ks.pub_wire});
+    if (ks.pub_hist.size() > (size_t)window_ + 1)
+      ks.pub_hist.erase(ks.pub_hist.begin());
+    std::vector<ParkedPull> keep;
+    for (auto& p : ks.parked_pulls) {
+      if (ParkedReadyLocked(ks, p))
+        flush->push_back(p);
+      else
+        keep.push_back(p);
+    }
+    ks.parked_pulls.swap(keep);
+    if (!ks.deferred.empty()) defer->swap(ks.deferred);
+  }
+
+  // Re-run parked future-round folds after their blocking round
+  // published. Call WITHOUT ks.mu held: each redispatch re-enters
+  // DoPush and takes the key lock itself; a fold whose round is STILL
+  // ahead simply re-parks. Recursion (a redispatched fold completing
+  // its round redispatches the next) is bounded by the window, <= 8.
+  // The deferred copies own their payloads, so the engine epilogue's
+  // recycle is replayed here by hand.
+  void RedispatchDeferred(std::vector<EngineMsg>& msgs) {
+    for (auto& dm : msgs) {
+      DoPush(dm, /*fused=*/dm.op == PUSHPULL);
+      if (!dm.payload.empty()) pool_.put(std::move(dm.payload));
+      dm.conn.reset();
+    }
+    msgs.clear();
   }
 
   // Record a successful fold's round (call under ks.mu, next to the
@@ -3535,9 +3730,20 @@ class Server {
         // pull answered later with new-length bytes is silently discarded
         // by the client (out_len mismatch) and reads as success with an
         // unwritten output buffer.
-        stale.reserve(ks.parked_pulls.size() + ks.parked_inits.size());
+        stale.reserve(ks.parked_pulls.size() + ks.parked_inits.size() +
+                      ks.deferred.size());
         for (auto& p : ks.parked_pulls) stale.push_back(p);
         for (auto& p : ks.parked_inits) stale.push_back(p);
+        // deferred future-round folds were parked against the OLD
+        // length/round numbering: error-reply so the workers retry
+        // them against the re-initialized store
+        for (auto& d : ks.deferred) {
+          stale.push_back({d.conn, d.rid, d.sender});
+          if (!d.payload.empty()) pool_.put(std::move(d.payload));
+        }
+        ks.deferred.clear();
+        ks.pub_round = 0;
+        ks.pub_hist.clear();
         ks.parked_pulls.clear();
         ks.parked_inits.clear();
         ks.init_count = 0;
@@ -3750,21 +3956,23 @@ class Server {
 
 
   void FusedReply(KeyStore& ks, EngineMsg& m, bool compressed) {
+    // the fused reply is FOR the round this push folded into: carry
+    // the stamp so a windowed park waits for (and answers with) that
+    // round's aggregate, not whichever publishes first
+    ParkedPull p{m.conn, m.rid,    m.sender, compressed,
+                 m.traced, m.key, m.epoch >> 16};
     bool ready;
     {
       std::lock_guard<Mu> lk(ks.mu);
-      ready = PullReady(ks, m.sender);
-      if (!ready)
-        ks.parked_pulls.push_back(
-            {m.conn, m.rid, m.sender, compressed, m.traced, m.key});
+      ready = PullReady(ks, p);
+      if (!ready) ks.parked_pulls.push_back(p);
     }
-    if (ready)
-      AnswerPull(ks,
-                 {m.conn, m.rid, m.sender, compressed, m.traced, m.key});
+    if (ready) AnswerPull(ks, p);
   }
 
   void DoPushCompressed(EngineMsg& m, KeyStore& ks, bool fused) {
     std::vector<ParkedPull> flush;
+    std::vector<EngineMsg> defer;
     {
       std::lock_guard<Mu> lk(ks.mu);
       if (m.conn->dead.load()) {  // fenced: see Conn::dead
@@ -3773,7 +3981,21 @@ class Server {
         return;
       }
       if (IsReplay(ks, m)) goto ack;  // fold at most once per round
-      if (!CodecTagOk(ks, m) || !RoundAligned(ks, m)) {
+      // RoundGate BEFORE CodecTagOk: a deferred future-round fold must
+      // not be validated against (or latch) the CURRENT round's codec
+      // tag — its own round re-checks the tag at redispatch
+      switch (RoundGate(ks, m)) {
+        case kGateDefer:
+          if (DeferFold(ks, m)) return;  // answered at redispatch
+          [[fallthrough]];               // overflow: rejected loudly
+        case kGateReject: {
+          MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
+          m.conn->send_msg(r, nullptr);
+          return;
+        }
+        default: break;
+      }
+      if (!CodecTagOk(ks, m)) {
         MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
         m.conn->send_msg(r, nullptr);
         return;
@@ -3823,7 +4045,7 @@ class Server {
             ks.completed_rounds++;
             PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
             chaos_.round_completed();
-            flush.swap(ks.parked_pulls);
+            WindowPublishLocked(ks, &flush, &defer);
           }
           goto ack;  // shared ACK + parked-pull flush tail
         }
@@ -3878,7 +4100,7 @@ class Server {
           ks.completed_rounds++;
           PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
           chaos_.round_completed();
-          flush.swap(ks.parked_pulls);
+          WindowPublishLocked(ks, &flush, &defer);
           goto ack;
         }
         // invalid wire: fall through to the generic path's error report
@@ -3947,7 +4169,7 @@ class Server {
         ks.completed_rounds++;
         PublishHealth(ks, ks.pub->data(), ks.len, F32, nullptr);
         chaos_.round_completed();
-        flush.swap(ks.parked_pulls);
+        WindowPublishLocked(ks, &flush, &defer);
       }
     }
   ack:
@@ -3959,6 +4181,9 @@ class Server {
     // fused: the compressed-wire aggregate IS the reply — parked (or
     // answered now) instead of the push ACK
     if (fused) FusedReply(ks, m, /*compressed=*/true);
+    // a publish unblocks the NEXT round: fold its parked (deferred)
+    // pushes now that their round is current
+    RedispatchDeferred(defer);
   }
 
   void DoPushSparse(EngineMsg& m, KeyStore& ks, bool fused) {
@@ -3970,6 +4195,7 @@ class Server {
     // compose with the normal round protocol — and with dense pushes
     // from other workers in the same round.
     std::vector<ParkedPull> flush;
+    std::vector<EngineMsg> defer;
     bool ok = false;
     {
       std::lock_guard<Mu> lk(ks.mu);
@@ -3979,8 +4205,13 @@ class Server {
           ok = true;  // already folded: answer, don't double-count
           break;
         }
+        {
+          GateVerdict g = RoundGate(ks, m);
+          if (g == kGateDefer && DeferFold(ks, m))
+            return;  // answered at redispatch
+          if (g != kGateAligned) break;
+        }
         if (!CodecTagOk(ks, m)) break;  // rowsparse rides the dense mode
-        if (!RoundAligned(ks, m)) break;
         if (ks.len == 0 || ks.dtype != F32) break;
         if (ks.comp.type != CompressorCfg::NONE) break;  // no comp mixing
         if (m.size() < 8) break;
@@ -4016,7 +4247,7 @@ class Server {
           RecordFold(t0, m.size());
           ks.completed_rounds++;
           chaos_.round_completed();
-          flush.swap(ks.parked_pulls);
+          WindowPublishLocked(ks, &flush, &defer);
           ok = true;
           break;
         }
@@ -4042,7 +4273,7 @@ class Server {
           ks.completed_rounds++;
           PublishHealth(ks, ks.pub->data(), ks.len, ks.dtype, nullptr);
           chaos_.round_completed();
-          flush.swap(ks.parked_pulls);
+          WindowPublishLocked(ks, &flush, &defer);
         }
         ok = true;
       } while (false);
@@ -4059,10 +4290,12 @@ class Server {
     // fused rowsparse: the reply is the DENSE aggregate (exactly what
     // the two-op path pulls with cmd_dense after its sparse push)
     if (ok && fused) FusedReply(ks, m, /*compressed=*/false);
+    RedispatchDeferred(defer);
   }
 
   void DoPush(EngineMsg& m, bool fused = false) {
     std::vector<ParkedPull> flush;
+    std::vector<EngineMsg> defer;
     bool echo_ok = false;  // single-worker fused shm echo fast path
     KeyStore& ks = store_of(m.key);
     if (m.req == kRowSparsePushPull) {
@@ -4110,7 +4343,20 @@ class Server {
         return;
       }
       if (!IsReplay(ks, m)) {
-        if (!CodecTagOk(ks, m) || !RoundAligned(ks, m)) {
+        // RoundGate before CodecTagOk: a deferred future-round fold
+        // must not latch (or be judged by) the current round's codec
+        switch (RoundGate(ks, m)) {
+          case kGateDefer:
+            if (DeferFold(ks, m)) return;  // answered at redispatch
+            [[fallthrough]];               // overflow: rejected loudly
+          case kGateReject: {
+            MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
+            m.conn->send_msg(r, nullptr);
+            return;
+          }
+          default: break;
+        }
+        if (!CodecTagOk(ks, m)) {
           MsgHeader r = ReplyHeader(ACK, 1, 0, m.rid, m.key);
           m.conn->send_msg(r, nullptr);
           return;
@@ -4128,7 +4374,7 @@ class Server {
           RecordFold(t0, m.size());
           ks.completed_rounds++;
           chaos_.round_completed();
-          flush.swap(ks.parked_pulls);
+          WindowPublishLocked(ks, &flush, &defer);
         } else {
           DebugPrint(ks.recv_count == 0 ? "COPY_FIRST" : "SUM_RECV",
                      m.key, m.data(), (uint32_t)m.size(), ks.dtype);
@@ -4197,7 +4443,7 @@ class Server {
             PublishHealth(ks, ks.pub->data(), ks.len, ks.dtype,
                           hs_fused ? &hs : nullptr);
             chaos_.round_completed();
-            flush.swap(ks.parked_pulls);
+            WindowPublishLocked(ks, &flush, &defer);
             // Echo eligibility: a single-worker round just completed
             // from THIS out-of-band payload, so the published
             // aggregate is bit-identical to the bytes still sitting
@@ -4251,13 +4497,24 @@ class Server {
         FusedReply(ks, m, /*compressed=*/false);
       }
     }
+    RedispatchDeferred(defer);
   }
 
-  bool PullReady(KeyStore& ks, uint16_t sender) {
+  // Readiness of a parked (or about-to-park) pull — call under ks.mu.
+  // Round-stamped pulls under the cross-barrier window wait for THEIR
+  // round to publish (pub_round): the positional push-count rule
+  // cannot distinguish two parked rounds of one key. Everything else —
+  // unstamped pulls, window off — keeps the positional bookkeeping:
+  // ready once every round this worker pushed has completed.
+  bool PullReady(KeyStore& ks, const ParkedPull& p) {
     if (async_) return true;
-    uint64_t pushed = sender < ks.worker_push_count.size()
-                          ? ks.worker_push_count[sender] : 0;
+    if (window_ && p.round) return ks.pub_round >= p.round;
+    uint64_t pushed = p.sender < ks.worker_push_count.size()
+                          ? ks.worker_push_count[p.sender] : 0;
     return ks.completed_rounds >= pushed;
+  }
+  bool ParkedReadyLocked(KeyStore& ks, const ParkedPull& p) {
+    return PullReady(ks, p);
   }
 
   // kind-1 reply trace event for a sampled request whose aggregate just
@@ -4311,6 +4568,20 @@ class Server {
     {
       std::lock_guard<Mu> lk(ks.mu);
       snap = p.compressed ? ks.pub_wire : ks.pub;
+      if (window_ && p.round) {
+        // windowed round-stamped reply: serve the EXACT round the pull
+        // waited for from the history ring — the live pub may already
+        // be a newer round. Missing from the ring (evicted; only
+        // possible across a migration/re-init) falls back to the
+        // newest published view, matching the post-migration legacy
+        // behavior.
+        for (auto& h : ks.pub_hist) {
+          if (h.round == p.round) {
+            snap = p.compressed ? h.pub_wire : h.pub;
+            break;
+          }
+        }
+      }
     }
     if (!snap) {  // defensive: pull answered before any init
       MsgHeader r = ReplyHeader(ACK, 1, 0, p.rid);
@@ -4353,11 +4624,10 @@ class Server {
       }
       uninit = ks.len == 0 ||
                (comp && ks.comp.type == CompressorCfg::NONE);
-      ready = !uninit && PullReady(ks, m.sender);
-      if (!uninit && !ready) {
-        ks.parked_pulls.push_back(
-            {m.conn, m.rid, m.sender, comp, m.traced, m.key});
-      }
+      ParkedPull p{m.conn, m.rid,   m.sender, comp,
+                   m.traced, m.key, m.epoch >> 16};
+      ready = !uninit && PullReady(ks, p);
+      if (!uninit && !ready) ks.parked_pulls.push_back(p);
     }
     if (uninit) {
       // pull before init: error reply (DoInit never flushes parked pulls,
@@ -4369,7 +4639,8 @@ class Server {
       return;
     }
     if (ready)
-      AnswerPull(ks, {m.conn, m.rid, m.sender, comp, m.traced, m.key});
+      AnswerPull(ks, {m.conn, m.rid, m.sender, comp, m.traced, m.key,
+                      m.epoch >> 16});
   }
 
   // per-stage value printing for one key (reference: BYTEPS_SERVER_DEBUG
@@ -4422,6 +4693,16 @@ class Server {
   bool health_;
   std::atomic<uint64_t> health_rounds_{0};
   std::atomic<uint64_t> health_nonfinite_{0};
+  // cross-barrier staleness window (BYTEPS_STALENESS /
+  // BYTEPS_CROSS_BARRIER): how many rounds AHEAD of the currently
+  // accepting one a stamped fold may arrive and be parked instead of
+  // rejected. 0 = strict same-round gate (today's semantics).
+  uint64_t window_;
+  // cumulative window verdicts behind the window_deferred /
+  // window_rejected stat slots (engaged-proof for the barrier_ab
+  // bench; a rejection is also a kFlightRoundSkew flight event)
+  std::atomic<uint64_t> window_deferred_{0};
+  std::atomic<uint64_t> window_rejected_{0};
   BufPool pool_;         // recycled payload/fold-scratch buffers
 
   std::unordered_map<uint64_t, KeyStore> stores_;

@@ -45,6 +45,7 @@ _STAT_SLOTS = (
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
     "flight_dropped", "draining", "health_rounds", "health_nonfinite",
+    "window_deferred", "window_rejected",
 )
 
 # Wire-sampled trace record (native/ps.cc TraceRec, drained over the
@@ -192,6 +193,8 @@ def derive_stage_section(raw: Dict[str, int]) -> Dict[str, float]:
         "draining": raw["draining"],
         "health_rounds": raw["health_rounds"],
         "health_nonfinite": raw["health_nonfinite"],
+        "window_deferred": raw["window_deferred"],
+        "window_rejected": raw["window_rejected"],
     }
 
 

@@ -70,6 +70,16 @@ DIRECTION_OVERRIDES = {
     "update_ratio_p95": None,
     "fidelity_drift": None,
     "nonfinite_leaves": "lower",
+    # cross-barrier pipelining (bench.py barrier_ab): the step-wall and
+    # overlap keys ride the suffix rules (_step_ms lower, _frac
+    # higher); the engaged-proof counters are directional — a drop to
+    # zero means the carry silently disengaged (the win evaporates),
+    # and the sync arm carrying ANYTHING is a staleness-0 contract
+    # violation.
+    "barrier_speedup": "higher",
+    "barrier_carried_leaves": "higher",
+    "barrier_carry_drained": "higher",
+    "barrier_sync_carried_leaves": "lower",
 }
 # (suffix, direction) checked in order after the overrides; the first
 # match wins. "_ms" covers every step-wall key; "_pct" the overhead

@@ -103,6 +103,15 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "stream_off_step_ms": 4.8,
                     "stream_ttfp_on_ms": 0.9,
                     "stream_ttfp_off_ms": 3.1}, None
+        if name == "barrier_ab":
+            return {"barrier_on_step_ms": 3.4,
+                    "barrier_off_step_ms": 4.6,
+                    "barrier_speedup": 1.353,
+                    "barrier_overlap_on_frac": 0.71,
+                    "barrier_overlap_off_frac": 0.12,
+                    "barrier_carried_leaves": 96,
+                    "barrier_carry_drained": 96,
+                    "barrier_sync_carried_leaves": 0}, None
         if name == "wire_ab":
             return {"wire_fused_step_ms": 3.6,
                     "wire_twoop_step_ms": 4.1,
@@ -191,6 +200,9 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     assert out["trace_rid_links"] == 24
     assert out["stream_on_step_ms"] == 4.0
     assert out["stream_ttfp_on_ms"] == 0.9
+    assert out["barrier_on_step_ms"] == 3.4
+    assert out["barrier_overlap_on_frac"] == 0.71
+    assert out["barrier_carried_leaves"] == 96
     assert out["wire_fused_step_ms"] == 3.6
     assert out["wire_request_ratio"] == 0.5
     assert out["fold_simd_gbps"] == 6.1
@@ -254,6 +266,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
         if name == "stream_ab":
             return {"stream_on_step_ms": 4.0,
                     "stream_off_step_ms": 4.8}, None
+        if name == "barrier_ab":
+            return {"barrier_on_step_ms": 3.4,
+                    "barrier_off_step_ms": 4.6,
+                    "barrier_carried_leaves": 96}, None
         if name == "wire_ab":
             return {"wire_fused_step_ms": 3.6,
                     "wire_twoop_step_ms": 4.1,
@@ -297,8 +313,8 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 16 CPU phases + finals
-    assert calls.count("probe") == 17 + n_final
+    # start + one attempt after each of the 17 CPU phases + finals
+    assert calls.count("probe") == 18 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
@@ -306,7 +322,8 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
         "after_fold_ab", "after_ledger_ab", "after_health_ab",
         "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_trace_ab",
-        "after_stream_ab", "after_wire_ab", "after_shard_ab",
+        "after_stream_ab", "after_barrier_ab", "after_wire_ab",
+        "after_shard_ab",
         *[f"final_{i}" for i in range(1, n_final + 1)]]
     # the wedged stage and its traceback ride every diag entry — a dead
     # round is attributable from BENCH_rNN.json alone
@@ -461,7 +478,8 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                             "scaleup_ab", "codec_adapt_ab", "fold_ab",
                             "ledger_ab", "health_ab", "arena_ab",
                             "metrics_ab", "trace_ab", "stream_ab",
-                            "wire_ab", "shard_ab", "scaling"}
+                            "barrier_ab", "wire_ab", "shard_ab",
+                            "scaling"}
 
 
 def test_multichip_envelope_bounded():

@@ -240,7 +240,8 @@ def test_key_health_none_when_off(monkeypatch):
 def test_stat_slots_appended():
     names = native_stat_slot_names()
     assert names == list(_STAT_SLOTS)
-    assert names[-2:] == ["health_rounds", "health_nonfinite"]
+    assert names[-4:] == ["health_rounds", "health_nonfinite",
+                          "window_deferred", "window_rejected"]
 
 
 def _bf16(x: np.ndarray) -> np.ndarray:

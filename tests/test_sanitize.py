@@ -53,6 +53,13 @@ def worker(w):
     c.init_tensor(rctx, np.zeros(1024, np.float32))
     fctx = r.init_tensor("fusedep", 1024 * 4, DataType.FLOAT32)
     c.init_tensor(fctx, np.zeros(1024, np.float32))
+    # bounded-staleness window key (BYTEPS_STALENESS=1 in the test
+    # env): worker 0 pushes one round AHEAD of the open round every
+    # step, so DeferFold's payload copy, WindowPublishLocked's
+    # pub_hist ring + selective parked-pull scan and the out-of-lock
+    # RedispatchDeferred all race the data plane under the sanitizer
+    wctx = r.init_tensor("window", 1024 * 4, DataType.FLOAT32)
+    c.init_tensor(wctx, np.zeros(1024, np.float32))
     # descriptor-tier key (>= 64KB): over the shm transport the payload
     # rides the ring as an 8-byte descriptor and the server folds it IN
     # PLACE from the shared arena — worker 0's push lands in the key's
@@ -110,6 +117,18 @@ def worker(w):
                           rng.randn(1024).astype(np.float32), fout, CMD,
                           lambda n, err, d=fdone: d.set(), epoch=ep)
         assert fdone.wait(60), "fused completion never fired"
+        # staleness-window round: both workers fold round step+1; w0
+        # then BLOCKS on a deliberately ahead round step+2 fold — it
+        # parks in the window, w1's aligned fold publishes and the
+        # redispatch replies it (the blocking wait also fences w0 to
+        # skew <= 1, keeping every fold inside window W). Next step's
+        # own push of that round is then epoch-deduped (last_round
+        # raced by both engines).
+        wp = wctx.partitions[0]
+        wbuf = np.ones(1024, np.float32)
+        c.zpush(wp.server, wp.key, wbuf, CMD, epoch=(step + 1) << 16)
+        if w == 0:
+            c.zpush(wp.server, wp.key, wbuf, CMD, epoch=(step + 2) << 16)
         # Waiter-lifecycle burst (the PR-6 TSAN finding's minimal
         # repro, promoted): tight concurrent BLOCKING request loops on
         # shared striped conns churn Waiter completions across threads
@@ -166,6 +185,13 @@ stats = clients[1].server_stats(1)
 assert stats and stats["draining"] == 1
 
 for t in threads: t.join()
+# the staleness window was armed (BYTEPS_STALENESS=1 rides the test
+# env) and its bookkeeping slots published; whether a given run
+# actually deferred is a scheduling race — the POINT of running it
+# under the sanitizer — so only the no-reject invariant is hard
+wstats = clients[0].server_stats(0)
+assert "window_deferred" in wstats, wstats
+assert wstats["window_rejected"] == 0, wstats
 clients[0].close()  # both workers SHUTDOWN: both servers exit cleanly
 clients[1].close()
 server.join(timeout=20)
@@ -283,6 +309,10 @@ def test_sanitized_loopback_stress(tmp_path, mode):
         # kernel + publish scans) and the HEALTH_PULL control op run
         # under the sanitizer with both workers racing
         "BYTEPS_HEALTH": "1",
+        # staleness-window leg: both stress servers construct with
+        # window 1 so worker 0's deliberately ahead folds park in
+        # DeferFold and redispatch at publish instead of rejecting
+        "BYTEPS_STALENESS": "1",
         # jax under sanitizers is hopeless; the stress uses numpy only
         "JAX_PLATFORMS": "cpu",
     }
