@@ -40,6 +40,15 @@ Extra keys in the same line:
   construction (BYTEPS_SERVER_THROTTLE_MBPS sleeps its threads, so the
   cap binds even on 1 core) — 1 throttled server reads ~the throttle,
   2 throttled servers splitting the keys read ~2x it.
+- ``stripe_ab_legacy_gbps`` / ``stripe_ab_ring_gbps`` /
+  ``stripe_ab_striped_gbps`` — the cross-host wire plane A/B'd between
+  two real OS processes over loopback TCP (non-shm): the retired
+  per-message path vs batched submission rings vs rings + striped data
+  connections, with hard byte-conservation and batch-counter proofs
+  per arm; ``stripe_ab_throttled_{dense,lossless}_gbps`` replay the
+  codec story on the new plane under a server-side wire cap (the
+  lossless tier's fused decode-into-fold must move more
+  dense-equivalent bytes than dense under the same cap).
 - ``pushpull_dense_tpu_gbps`` / ``pushpull_onebit_tpu_gbps`` /
   ``pushpull_randomk_tpu_gbps`` — the device tier (grads start on
   chip; the codec compresses ON chip so the D2H hop moves wire-sized
@@ -1496,6 +1505,270 @@ def phase_wire_ab(steps: int = 6, reps: int = 3) -> dict:
             "wire_half_proof": True}
 
 
+# --------------------------------------------------------------------------
+# Cross-host wire-rate A/B (PR 17): batched submission rings + striped
+# data connections + decompress-on-the-fabric. The BYTEPS_WIRE_RING /
+# BYTEPS_WIRE_STRIPES knobs are LATCHED per process in the native lib,
+# so unlike the in-process env flips above, every arm runs as a fresh
+# server SUBPROCESS + worker SUBPROCESS pair over real loopback TCP
+# (BYTEPS_ENABLE_IPC=0 — the shm descriptor tier would bypass the wire
+# entirely). Two real OS processes per arm is also exactly the shape
+# the acceptance criterion names ("2-process TCP (non-shm) bench arm").
+# --------------------------------------------------------------------------
+
+_STRIPE_SRV = r"""
+import os, sys
+sys.path.insert(0, os.environ["BPS_REPO"])
+from byteps_tpu.config import Config
+from byteps_tpu.server import run_server
+run_server(int(os.environ["BPS_PORT"]), Config(num_workers=1,
+                                               num_servers=1))
+"""
+
+_STRIPE_WRK = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.environ["BPS_REPO"])
+import numpy as np
+from byteps_tpu.config import Config
+from byteps_tpu.core.registry import TensorRegistry
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.server.client import PSClient
+from byteps_tpu.server.compressed import CompressedTensor
+from byteps_tpu.utils.net import wait_port
+
+port = int(os.environ["BPS_PORT"])
+mode = os.environ["BPS_STRIPE_MODE"]          # dense | lossless
+total = int(os.environ["BPS_STRIPE_BYTES"])
+steps = int(os.environ["BPS_STRIPE_STEPS"])
+nt = int(os.environ["BPS_STRIPE_NT"])
+wait_port(port)
+c = PSClient([f"127.0.0.1:{port}"], worker_id=0)
+CMD = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+n = total // (4 * nt)
+rng = np.random.RandomState(7)
+res = {}
+
+def dense_round(keys, xs, outs, epoch):
+    # one bench round = every key's fused PUSHPULL in flight at once
+    # (the steady-state shape: the reply ring sees concurrent replies
+    # to batch, the striper sees every key's segments interleaved)
+    done = threading.Event(); left = [len(keys)]; err = [None]
+    lock = threading.Lock()
+    def cb(name, e):
+        with lock:
+            if e is not None and err[0] is None:
+                err[0] = e
+            left[0] -= 1
+            if left[0] == 0:
+                done.set()
+    for k, x, o in zip(keys, xs, outs):
+        c.zpushpull_async(0, k, x, o, CMD, cb, epoch=epoch)
+    assert done.wait(300), "fused round timed out"
+    if err[0]:
+        raise err[0]
+
+if mode == "dense":
+    keys = list(range(100, 100 + nt))
+    xs = [rng.randn(n).astype(np.float32) for _ in keys]
+    outs = [np.empty_like(x) for x in xs]
+    for k, x in zip(keys, xs):
+        c.init_key(0, k, np.zeros_like(x), CMD)
+    dense_round(keys, xs, outs, 1 << 16)      # warmup + parity check
+    for x, o in zip(xs, outs):
+        assert np.array_equal(o, x), "single-worker fused parity"
+    best = float("inf")
+    for s in range(steps):
+        t0 = time.perf_counter()
+        dense_round(keys, xs, outs, (s + 2) << 16)
+        best = min(best, time.perf_counter() - t0)
+else:
+    # lossless EFFECTIVE rate: low-entropy payload (a 16-value
+    # lattice) so the zlib byte-plane codec shrinks the wire bytes the
+    # server throttle actually charges for; GB/s counts the
+    # dense-equivalent bytes moved, as the onebit/randomk figures do
+    reg = TensorRegistry(Config(num_workers=1, num_servers=1))
+    lattice = np.linspace(-1.0, 1.0, 16).astype(np.float32)
+    cts, xs = [], []
+    for i in range(nt):
+        ctx = reg.init_tensor(f"sl{i}", n * 4, DataType.FLOAT32)
+        cts.append(CompressedTensor(c, ctx, {"compressor": "lossless"},
+                                    1))
+        xs.append(rng.choice(lattice, size=n).astype(np.float32))
+    for ct, x in zip(cts, xs):                # warmup + parity check
+        o = np.asarray(ct.push_pull(x, average=False))
+        assert o.tobytes() == x.tobytes(), "lossless parity"
+    best = float("inf")
+    for s in range(steps):
+        t0 = time.perf_counter()
+        for ct, x in zip(cts, xs):
+            ct.push_pull(x, average=False)
+        best = min(best, time.perf_counter() - t0)
+res["gbps"] = (total * 2 / best) / 1e9
+
+res["transport"] = c.transport_stats()
+res["conn_bytes"] = c.stripe_conn_bytes(0)
+srv = c.server_stats(0)   # fetched OVER THE WIRE from the server proc
+res["server"] = {k: int(srv[k]) for k in (
+    "tx_batches", "tx_msgs", "rx_batches", "rx_msgs", "stripe_segs",
+    "stripe_bytes", "fused_decode_folds", "reg_blocks", "reg_miss")}
+c.close()
+print("STRIPE_WRK " + json.dumps(res), flush=True)
+"""
+
+
+def phase_stripe_ab(total_bytes: int = 64 << 20, n_tensors: int = 64,
+                    steps: int = 3, reps: int = 2,
+                    chunk_bytes: int = 64 << 10,
+                    throttle_mbps: float = 20.0) -> dict:
+    """A/B the PR-17 cross-host wire plane on the raw fused-PUSHPULL
+    loop between two real OS processes over loopback TCP, three dense
+    arms INTERLEAVED (host-load drift lands on all of them), best GB/s
+    per arm, fresh process pair per run so every counter is per-arm:
+
+    - ``legacy``  — BYTEPS_WIRE_RING=0, stripes off: the per-message
+      send/recv path this PR retires;
+    - ``ring``    — batched submission/completion rings, single data
+      conn: the syscall-batching win in isolation;
+    - ``striped`` — rings + BYTEPS_WIRE_STRIPES=4 data conns with
+      stripe-aware reassembly: the full plane.
+
+    On a 1-core host the three dense walls read within noise of each
+    other — the copies, not the syscalls, set the wall, so the batching
+    and striping wins need cores/NIC queues to back them (the
+    pushpull_dense_2srv_gbps caveat, same shape). The A/B therefore
+    rests on HARD deterministic proofs from the wire counters, checked
+    on EVERY run: the striped arm must conserve bytes exactly across
+    its conns (sum(per-conn tx) == stripe payload + 72B framing x
+    segments, control lane untouched at 0) and the SERVER's reassembly
+    counters — fetched over the wire from the other process — must
+    mirror the client's split; ring arms must show every reply riding
+    a tx batch (tx_batches > 0, legacy pinned to 0: the per-message
+    path is RETIRED, not merely preferred — and under the 64-leaf
+    concurrent round at least one sendmsg must have coalesced several
+    replies); non-striped arms must count zero segments.
+
+    A throttled pair (BYTEPS_SERVER_THROTTLE_MBPS, server-side, so the
+    cap binds even on 1 core) then replays the codec story on the new
+    plane: the lossless tier's decompress-on-the-fabric path
+    (fused_decode_folds > 0, decode straight into the accumulator)
+    must move MORE dense-equivalent GB/s than the dense tier under the
+    same wire cap."""
+    from byteps_tpu.utils.net import free_port
+
+    def run(tag: str, knobs: dict, mode: str, nbytes: int, nt: int,
+            throttle: float = 0.0) -> dict:
+        port = free_port()
+        env = {**os.environ, "BPS_REPO": REPO, "BPS_PORT": str(port),
+               "JAX_PLATFORMS": "cpu",
+               "BYTEPS_ENABLE_IPC": "0",
+               "BYTEPS_STRIPE_CHUNK_BYTES": str(chunk_bytes),
+               **knobs}
+        env.pop("BYTEPS_SERVER_THROTTLE_MBPS", None)
+        if throttle:
+            env["BYTEPS_SERVER_THROTTLE_MBPS"] = str(throttle)
+        srv = subprocess.Popen([sys.executable, "-c", _STRIPE_SRV],
+                               env=env, cwd=REPO)
+        try:
+            wrk = subprocess.run(
+                [sys.executable, "-c", _STRIPE_WRK],
+                env={**env, "BPS_STRIPE_MODE": mode,
+                     "BPS_STRIPE_BYTES": str(nbytes),
+                     "BPS_STRIPE_NT": str(nt),
+                     "BPS_STRIPE_STEPS": str(steps)},
+                capture_output=True, text=True, timeout=180.0, cwd=REPO)
+        finally:
+            srv.kill()
+            srv.wait()
+        assert wrk.returncode == 0, \
+            (tag, (wrk.stdout + wrk.stderr)[-4000:])
+        for line in reversed(wrk.stdout.splitlines()):
+            if line.startswith("STRIPE_WRK "):
+                return json.loads(line[len("STRIPE_WRK "):])
+        raise AssertionError(f"{tag}: no worker result line")
+
+    def check(tag: str, r: dict, striped: bool, ring: bool,
+              lossless: bool) -> None:
+        tr, sc = r["transport"], r["server"]
+        segs, sbytes = tr["stripe_segs"], tr["stripe_bytes"]
+        if striped:
+            conn = r["conn_bytes"]
+            assert segs > 0, (tag, tr)
+            assert conn and conn[0] == 0, (tag, conn)
+            assert sum(conn) == sbytes + 72 * segs, (tag, conn, tr)
+            assert sc["stripe_segs"] == segs, (tag, sc, tr)
+            assert sc["stripe_bytes"] == sbytes, (tag, sc, tr)
+        else:
+            assert segs == 0 and sbytes == 0, (tag, tr)
+        if ring:
+            assert sc["tx_batches"] > 0, (tag, sc)
+            assert sc["tx_msgs"] >= sc["tx_batches"], (tag, sc)
+            assert sc["rx_batches"] > 0, (tag, sc)
+        else:
+            assert sc["tx_batches"] == 0, (tag, sc)
+            assert sc["rx_batches"] == 0, (tag, sc)
+        if lossless:
+            assert sc["fused_decode_folds"] > 0, (tag, sc)
+        else:
+            assert sc["fused_decode_folds"] == 0, (tag, sc)
+
+    arms = {
+        "legacy": {"BYTEPS_WIRE_RING": "0", "BYTEPS_WIRE_STRIPES": "1"},
+        "ring": {"BYTEPS_WIRE_RING": "1", "BYTEPS_WIRE_STRIPES": "1"},
+        "striped": {"BYTEPS_WIRE_RING": "1", "BYTEPS_WIRE_STRIPES": "4"},
+    }
+    best = {name: 0.0 for name in arms}
+    last: dict = {}
+    for _ in range(reps):
+        for name, knobs in arms.items():
+            r = run(name, knobs, "dense", total_bytes, n_tensors)
+            check(name, r, striped=(name == "striped"),
+                  ring=(name != "legacy"), lossless=False)
+            best[name] = max(best[name], r["gbps"])
+            last[name] = r
+
+    # throttled pair on the full plane (16MB set in 8 leaves: 2MB
+    # clears the 2x-chunk striping floor, and the cap, not the host,
+    # sets the wall). Lossless rides the two-op compressed wire — its
+    # zero stripe segments double as the never-stripes regression guard.
+    thr_bytes, thr_nt = 16 << 20, 8
+    thr_dense = thr_lossless = 0.0
+    for _ in range(reps):
+        rd = run("thr_dense", arms["striped"], "dense", thr_bytes,
+                 thr_nt, throttle_mbps)
+        check("thr_dense", rd, striped=True, ring=True, lossless=False)
+        thr_dense = max(thr_dense, rd["gbps"])
+        rl = run("thr_lossless", arms["striped"], "lossless", thr_bytes,
+                 thr_nt, throttle_mbps)
+        check("thr_lossless", rl, striped=False, ring=True,
+              lossless=True)
+        thr_lossless = max(thr_lossless, rl["gbps"])
+
+    # coalescing evidence from the dense concurrent round: 4 rounds x
+    # 64 in-flight replies — if every one of those ~256 replies went
+    # out as a solo batch, the ring never coalesced and the syscall
+    # story is hollow (the throttled arms run only 8 leaves, so the
+    # pin sits on the dense arms where the pressure is real)
+    for name in ("ring", "striped"):
+        sc = last[name]["server"]
+        assert sc["tx_msgs"] > sc["tx_batches"], (name, sc)
+    sc = last["striped"]["server"]
+    return {
+        "stripe_ab_legacy_gbps": round(best["legacy"], 3),
+        "stripe_ab_ring_gbps": round(best["ring"], 3),
+        "stripe_ab_striped_gbps": round(best["striped"], 3),
+        "stripe_ab_speedup": round(best["striped"] / best["legacy"], 3),
+        "stripe_ab_segs": sc["stripe_segs"],
+        "stripe_ab_msgs_per_batch": round(
+            sc["tx_msgs"] / max(1, sc["tx_batches"]), 2),
+        "stripe_ab_conservation": True,
+        "stripe_ab_throttled_dense_gbps": round(thr_dense, 3),
+        "stripe_ab_throttled_lossless_gbps": round(thr_lossless, 3),
+        "stripe_ab_lossless_gain": round(
+            thr_lossless / max(thr_dense, 1e-9), 3),
+        "stripe_ab_throttle_mbps": throttle_mbps,
+    }
+
+
 def phase_fold_ab(total_bytes: int = 96 << 20, n_tensors: int = 8,
                   steps: int = 3, reps: int = 2) -> dict:
     """A/B the native data plane's SIMD fold (BYTEPS_SIMD,
@@ -2155,6 +2428,7 @@ _PHASES = {
     "stream_ab": phase_stream_ab,
     "barrier_ab": phase_barrier_ab,
     "wire_ab": phase_wire_ab,
+    "stripe_ab": phase_stripe_ab,
     "fold_ab": phase_fold_ab,
     "shard_ab": phase_shard_ab,
     "pushpull_tpu": phase_pushpull_tpu,
@@ -2365,6 +2639,16 @@ def main() -> None:
         "codec_lossless_bitwise": None,
         "codec_tag_mismatch_rejected": None,
         "codec_adapt_proof": None,
+        "stripe_ab_legacy_gbps": None,
+        "stripe_ab_ring_gbps": None,
+        "stripe_ab_striped_gbps": None,
+        "stripe_ab_speedup": None,
+        "stripe_ab_segs": None,
+        "stripe_ab_msgs_per_batch": None,
+        "stripe_ab_conservation": None,
+        "stripe_ab_throttled_dense_gbps": None,
+        "stripe_ab_throttled_lossless_gbps": None,
+        "stripe_ab_lossless_gain": None,
     }
     errors = {}
     # per-attempt tunnel diagnostics: probe wall time, platform, errors —
@@ -2536,6 +2820,14 @@ def main() -> None:
                             # that has never landed in a driver
                             # artifact)
                             ("codec_adapt_ab", 300.0),
+                            # cross-host wire-plane A/B: per-message
+                            # legacy vs batched rings vs rings+striped
+                            # conns, 2-process TCP arms with the
+                            # byte-conservation + batch counter proofs,
+                            # plus the throttled lossless-vs-dense
+                            # effective-rate pair — in the runs-first
+                            # group (new driver key)
+                            ("stripe_ab", 300.0),
                             # SIMD-fold A/B: vectorized vs scalar
                             # server fold on the zero-copy dense path,
                             # with the equal-fold_bytes counter proof —

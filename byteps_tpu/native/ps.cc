@@ -51,6 +51,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sched.h>
+#include <sys/epoll.h>  // edge-triggered deadline waits (recv_all_deadline)
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -85,6 +86,7 @@
 #include <malloc.h>  // mallopt (the call itself is #ifdef-guarded too)
 #endif
 #include <cstring>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -92,13 +94,14 @@
 #include <queue>
 #include <string>
 #include <thread>
+#include <tuple>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
 namespace bps {
 
-static constexpr uint32_t kMagic = 0xB17E5002;  // 5001 + codec-tag field
+static constexpr uint32_t kMagic = 0xB17E5003;  // 5002 + striped segments
 
 // MsgHeader::flags bits. Bit 0 (error) is wire contract both
 // transports. Bit 7 (out-of-band payload) is SHM-RING-ONLY framing: it
@@ -115,6 +118,13 @@ static constexpr uint8_t kFlagOob = 0x80;
 // the client just pushed, so the server sends 8 bytes instead of
 // copying the payload back (see DoPush's echo tail).
 static constexpr uint8_t kFlagOobEcho = 0x40;
+// Wire framing (TCP only): the payload of this PUSH/PUSHPULL message is
+// ONE SEGMENT of a larger striped payload — a 32-byte SegHdr follows
+// the MsgHeader, then the chunk bytes (h.len covers both). Segments of
+// one logical push fan out over the worker's striped data connections
+// and reassemble server-side before the engine ever sees the message,
+// so the flag never reaches the engine/waiter layers either.
+static constexpr uint8_t kFlagSeg = 0x20;
 
 // TSAN-visible mutex/condvar with EXPLICIT pthread init/destroy. glibc's
 // std::mutex / std::condition_variable are zero-initialized (no
@@ -239,11 +249,13 @@ class BufPool {
         Buf b = std::move(free_.back());
         free_.pop_back();
         b.resize(n);
+        if (on_alloc_) on_alloc_(b.data(), b.capacity());
         return b;
       }
     }
     Buf b;
     b.resize(n);
+    if (on_alloc_) on_alloc_(b.data(), b.capacity());
     return b;
   }
 
@@ -255,10 +267,21 @@ class BufPool {
     free_.push_back(std::move(b));
   }
 
+  // RDMA-shaped registration hook (TransportReg): invoked with the
+  // (base, capacity) of every block the lease path ALLOCATES (cache
+  // hits recycle already-registered memory and skip it), so the
+  // transport layer's registry tracks exactly the blocks the recv path
+  // can land payloads in. Set once at Server construction, before any
+  // conn thread leases.
+  void set_alloc_hook(std::function<void(const void*, size_t)> h) {
+    on_alloc_ = std::move(h);
+  }
+
  private:
   static constexpr size_t kMaxPooled = 32;
   Mu mu_;
   std::vector<Buf> free_;  // guarded-by: mu_
+  std::function<void(const void*, size_t)> on_alloc_;
 };
 
 enum Op : uint8_t {
@@ -363,6 +386,30 @@ struct MsgHeader {
 
 static_assert(sizeof(MsgHeader) == 40, "header layout");
 
+// Striped-segment subheader (kFlagSeg): follows the MsgHeader on the
+// wire, before the chunk bytes. `seq` is the sender's per-key striped-
+// send ordinal — the server dispatches reassembled messages of one
+// (sender, key) stream in seq order, so segments racing across stripe
+// connections cannot reorder two rounds of the same key. `off`/`total`
+// place the chunk inside the reassembled payload (chunk length =
+// h.len - sizeof(SegHdr)).
+#pragma pack(push, 1)
+struct SegHdr {
+  uint32_t seq;
+  uint32_t idx;
+  uint32_t nseg;
+  uint32_t rsvd;
+  uint64_t off;
+  uint64_t total;
+};
+#pragma pack(pop)
+static_assert(sizeof(SegHdr) == 32, "segment header layout");
+// reassembly bounds: a stripe group never cuts a payload finer than
+// this many segments, and a claimed total past the cap is a protocol
+// error (bounds the lease a malformed header can force)
+static constexpr uint32_t kMaxSegs = 256;
+static constexpr uint64_t kMaxStripeTotal = 1ull << 31;
+
 // Reply/control header factory: the trailing epoch/codec fields are
 // always 0 on server replies and handshake messages, and spelling that
 // with 8-field aggregate initializers tripped
@@ -416,35 +463,42 @@ static bool recv_all_deadline(int fd, void* buf, size_t len,
   // the TCP byte stream remains message-aligned for the caller's
   // fallback path (a late-completing message is drained whole by the
   // normal read loop).
+  //
+  // Waiting rides an EDGE-TRIGGERED epoll: level-triggered POLLIN would
+  // return instantly while a PARTIAL message sits buffered (the old
+  // 1ms-nanosleep spin burned a core per idle conn), whereas EPOLLET
+  // only wakes when NEW bytes arrive. The initial EPOLL_CTL_ADD reports
+  // the current readiness once, which just costs one extra peek.
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
+  int ep = ::epoll_create1(EPOLL_CLOEXEC);
+  if (ep < 0) return false;
+  struct epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLET;
+  if (::epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) != 0) {
+    ::close(ep);
+    return false;
+  }
+  bool full = false;
   for (;;) {
     ssize_t n = ::recv(fd, buf, len, MSG_PEEK | MSG_DONTWAIT);
-    if (n == 0) return false;  // peer closed
+    if (n == 0) break;  // peer closed
     if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-      return false;
-    if (n >= (ssize_t)len) break;
-    auto now = std::chrono::steady_clock::now();
-    if (now >= deadline) return false;
-    if (n > 0) {
-      // partial message buffered: POLLIN is level-triggered and would
-      // return instantly on the bytes already there — sleep instead of
-      // busy-spinning a core until the rest (or the deadline) arrives
-      struct timespec ts;
-      ts.tv_sec = 0;
-      ts.tv_nsec = 1000000;  // 1ms
-      ::nanosleep(&ts, nullptr);
-      continue;
+      break;
+    if (n >= (ssize_t)len) {
+      full = true;
+      break;
     }
+    auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) break;
     int remain = (int)std::chrono::duration_cast<std::chrono::milliseconds>(
         deadline - now).count();
-    struct pollfd pfd;
-    pfd.fd = fd;
-    pfd.events = POLLIN;
-    pfd.revents = 0;
-    ::poll(&pfd, 1, remain > 0 ? remain : 1);  // EINTR: loop re-checks
+    struct epoll_event out;
+    ::epoll_wait(ep, &out, 1, remain > 0 ? remain : 1);
+    // EINTR / spurious wake / timeout all re-peek and re-check the clock
   }
-  return recv_all(fd, buf, len);
+  ::close(ep);
+  return full && recv_all(fd, buf, len);
 }
 
 // header+payload in one gathered send; sendmsg (not writev) so
@@ -478,6 +532,77 @@ static bool send_msg_iov(int fd, const MsgHeader& h, const void* payload) {
   return true;
 }
 
+// N-entry generalization of send_msg_iov's short-write walk: one
+// gathered sendmsg per kernel acceptance, advancing through the iovec
+// array until every byte left. The submission-ring flushers (server tx
+// ring, client stripe fan-out) stage whole batches through this — a
+// round's worth of replies/segments is one syscall, not N.
+static bool send_iovs(int fd, iovec* iov, int cnt) {
+  int idx = 0;
+  while (idx < cnt) {
+    msghdr msg{};
+    msg.msg_iov = &iov[idx];
+    int take = cnt - idx;
+    if (take > IOV_MAX) take = IOV_MAX;
+    msg.msg_iovlen = (size_t)take;
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    while (idx < cnt && iov[idx].iov_len <= (size_t)w) {
+      w -= (ssize_t)iov[idx].iov_len;
+      idx++;
+    }
+    if (idx < cnt && w > 0) {
+      iov[idx].iov_base = (char*)iov[idx].iov_base + w;
+      iov[idx].iov_len -= (size_t)w;
+    }
+  }
+  return true;
+}
+
+// BYTEPS_WIRE_RING (default 1): batched-submission wire plane — the
+// per-conn tx rings + the buffered rx batcher. 0 restores the legacy
+// one-syscall-per-message path, the A/B lever for bench --phase
+// stripe_ab and the parity tests.
+static bool wire_ring_enabled() {
+  static const bool v = [] {
+    const char* e = ::getenv("BYTEPS_WIRE_RING");
+    return !(e && (e[0] == '0' || e[0] == 'f' || e[0] == 'F'));
+  }();
+  return v;
+}
+
+// BYTEPS_WIRE_STRIPES (default 4): data connections per worker<->server
+// pair. >1 dedicates conn 0 to control ops and stripes large pushes
+// over the rest. Takes precedence over the legacy BYTEPS_CLIENT_CONNS.
+static int wire_stripes() {
+  static const int v = [] {
+    long n = 0;
+    if (const char* e = ::getenv("BYTEPS_WIRE_STRIPES")) n = std::atol(e);
+    if (n <= 0) return 0;  // unset: caller falls back to CLIENT_CONNS
+    if (n > 16) n = 16;
+    return (int)n;
+  }();
+  return v;
+}
+
+// BYTEPS_STRIPE_CHUNK_BYTES (default 1 MB): striping granularity. A
+// payload shorter than 2 chunks is never striped (the SegHdr + fan-out
+// overhead would exceed the head-of-line win).
+static uint32_t stripe_chunk_bytes() {
+  static const uint32_t v = [] {
+    long n = 1 << 20;
+    if (const char* e = ::getenv("BYTEPS_STRIPE_CHUNK_BYTES"))
+      n = std::atol(e);
+    if (n < (4 << 10)) n = 4 << 10;
+    if (n > (256 << 20)) n = 256 << 20;
+    return (uint32_t)n;
+  }();
+  return v;
+}
+
 // Multi-MB partition buffers churn every round; glibc's default
 // M_MMAP_THRESHOLD (128KB) services each one with mmap and returns it
 // with munmap, so every allocation re-faults ~1K pages — on a small-core
@@ -494,7 +619,21 @@ static const bool malloc_tuned = [] {
 static void tune_socket(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  int buf = 8 << 20;  // 8 MB socket buffers for multi-MB partitions
+  // BYTEPS_SOCK_BUF_BYTES: SO_SNDBUF/SO_RCVBUF per data connection, so
+  // a cross-host deployment can size the buffers to its bandwidth-delay
+  // product instead of inheriting the kernel default (or the 8 MB
+  // loopback tuning). Clamped to sane bounds; the kernel doubles the
+  // requested value and may cap it at net.core.{r,w}mem_max.
+  static const int buf = [] {
+    long v = 8 << 20;  // 8 MB default for multi-MB partitions
+    if (const char* e = ::getenv("BYTEPS_SOCK_BUF_BYTES")) {
+      long req = std::atol(e);
+      if (req > 0) v = req;
+    }
+    if (v < (64 << 10)) v = 64 << 10;
+    if (v > (256 << 20)) v = 256 << 20;
+    return (int)v;
+  }();
   ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
@@ -2215,6 +2354,44 @@ class Chaos {
   std::atomic<long> rounds_{0};
 };
 
+// Per-stage server accounting (recv -> queue-wait -> fold -> reply),
+// exposed over the C ABI (bps_server_stats) and mirrored into the
+// Python metrics snapshot's `server` section — so the next bound stage
+// of the data plane is measured, not guessed. All relaxed atomics:
+// totals, not synchronization.
+struct StageStats {
+  std::atomic<uint64_t> recv_ns{0};
+  std::atomic<uint64_t> recv_count{0};
+  std::atomic<uint64_t> queue_ns{0};
+  std::atomic<uint64_t> queue_count{0};
+  std::atomic<uint64_t> fold_ns{0};
+  std::atomic<uint64_t> fold_count{0};
+  std::atomic<uint64_t> fold_bytes{0};
+  std::atomic<uint64_t> reply_ns{0};
+  std::atomic<uint64_t> reply_count{0};
+  std::atomic<uint64_t> direct_recvs{0};  // zero-copy recv-into-store
+  std::atomic<uint64_t> oob_msgs{0};      // descriptor-ring payloads
+  // batched-submission wire plane (BYTEPS_WIRE_RING): syscall batches
+  // vs messages on each side — tx_msgs/tx_batches is the per-sendmsg
+  // reply batch depth, rx_msgs/rx_batches the per-recv message count.
+  // The stripe_ab bench uses these to PROVE the per-message syscall
+  // path retired, not just that throughput moved.
+  std::atomic<uint64_t> tx_batches{0};
+  std::atomic<uint64_t> tx_msgs{0};
+  std::atomic<uint64_t> rx_batches{0};
+  std::atomic<uint64_t> rx_msgs{0};
+  // striped data connections: segments reassembled + their chunk bytes
+  std::atomic<uint64_t> stripe_segs{0};
+  std::atomic<uint64_t> stripe_bytes{0};
+  // lossless pushes decoded straight into the accumulator (fused
+  // decode-into-fold; BYTEPS_FUSED_DECODE)
+  std::atomic<uint64_t> fused_decode_folds{0};
+  // RDMA-shaped transport registration (TransportReg): blocks
+  // registered at allocation; recv targets that missed the registry
+  std::atomic<uint64_t> reg_blocks{0};
+  std::atomic<uint64_t> reg_miss{0};
+};
+
 struct Conn {
   int fd;
   // worker id observed on this connection's first message; -1 until then
@@ -2238,12 +2415,80 @@ struct Conn {
   // IpcChan dtor — when any other message arrives first or the conn dies
   std::unique_ptr<IpcChan> ipc_pending;
   Throttle* thr = nullptr;  // server's bucket; null on the client side
+  StageStats* stats = nullptr;  // server's counters; null client side
+
+  // ---- tx submission ring (BYTEPS_WIRE_RING) -----------------------
+  // Replies staged under write_mu, flushed kTxBatch at a time through
+  // one gathered sendmsg each (send_iovs). Engine threads stage with
+  // send_msg_queued and flush at their queue-drain boundary, so a
+  // burst of N replies leaves in ~1 syscall instead of N. Blocking
+  // send_msg drains the ring first — per-conn FIFO order is preserved
+  // no matter how queued and direct sends interleave. The shm
+  // transport bypasses the ring entirely (its send is already a
+  // user-space copy, there is no syscall to batch).
+  static constexpr size_t kTxBatch = 64;
+  struct TxEntry {
+    MsgHeader h;
+    std::shared_ptr<const Buf> pin;  // keeps payload bytes alive
+  };
+  std::deque<TxEntry> tx_q;  // guarded by write_mu
+  bool tx_failed = false;    // guarded by write_mu; conn is dying
+
+  bool send_msg_queued(const MsgHeader& h,
+                       std::shared_ptr<const Buf> pin) {
+    if (ipc || !wire_ring_enabled())
+      return send_msg(h, pin ? (const void*)pin->data() : nullptr);
+    if (thr) thr->charge(h.len);
+    std::lock_guard<Mu> lk(write_mu);
+    tx_q.push_back({h, std::move(pin)});
+    if (tx_q.size() >= kTxBatch) return flush_locked();
+    return true;
+  }
+  bool tx_flush() {
+    std::lock_guard<Mu> lk(write_mu);
+    return flush_locked();
+  }
+  bool flush_locked() {
+    if (tx_failed) {
+      tx_q.clear();
+      return false;
+    }
+    while (!tx_q.empty()) {
+      size_t take = std::min(tx_q.size(), kTxBatch);
+      iovec iov[2 * kTxBatch];
+      int n = 0;
+      for (size_t i = 0; i < take; ++i) {
+        TxEntry& e = tx_q[i];
+        iov[n].iov_base = (void*)&e.h;
+        iov[n].iov_len = sizeof(MsgHeader);
+        n++;
+        if (e.pin && e.h.len) {
+          iov[n].iov_base = (void*)e.pin->data();
+          iov[n].iov_len = e.h.len;
+          n++;
+        }
+      }
+      if (!send_iovs(fd, iov, n)) {
+        tx_failed = true;
+        tx_q.clear();
+        return false;
+      }
+      if (stats) {
+        stats->tx_batches.fetch_add(1, std::memory_order_relaxed);
+        stats->tx_msgs.fetch_add(take, std::memory_order_relaxed);
+      }
+      tx_q.erase(tx_q.begin(), tx_q.begin() + (long)take);
+    }
+    return true;
+  }
+
   bool send_msg(const MsgHeader& h, const void* payload) {
     // charge OUTSIDE write_mu: a sleeping throttle must not also block
     // the other engine threads replying on this connection
     if (thr) thr->charge(h.len);
     std::lock_guard<Mu> lk(write_mu);
     if (ipc) return ipc->send_msg(h, payload);
+    if (!tx_q.empty() && !flush_locked()) return false;
     return send_msg_iov(fd, h, payload);
   }
   bool recv_bytes(void* p, size_t n) {  // conn-loop thread only
@@ -2270,23 +2515,60 @@ struct Conn {
   }
 };
 
-// Per-stage server accounting (recv -> queue-wait -> fold -> reply),
-// exposed over the C ABI (bps_server_stats) and mirrored into the
-// Python metrics snapshot's `server` section — so the next bound stage
-// of the data plane is measured, not guessed. All relaxed atomics:
-// totals, not synchronization.
-struct StageStats {
-  std::atomic<uint64_t> recv_ns{0};
-  std::atomic<uint64_t> recv_count{0};
-  std::atomic<uint64_t> queue_ns{0};
-  std::atomic<uint64_t> queue_count{0};
-  std::atomic<uint64_t> fold_ns{0};
-  std::atomic<uint64_t> fold_count{0};
-  std::atomic<uint64_t> fold_bytes{0};
-  std::atomic<uint64_t> reply_ns{0};
-  std::atomic<uint64_t> reply_count{0};
-  std::atomic<uint64_t> direct_recvs{0};  // zero-copy recv-into-store
-  std::atomic<uint64_t> oob_msgs{0};      // descriptor-ring payloads
+// Buffered receive batcher (BYTEPS_WIRE_RING), the rx half of the
+// submission-ring plane: one recv() syscall pulls as many buffered wire
+// messages as the kernel holds, and headers + small payloads parse out
+// of the staging buffer with no further syscalls. Large payloads keep
+// the zero-copy tier — the buffered prefix is copied out and the
+// REMAINDER is received straight into the final target (direct_buf /
+// pooled lease / stripe assembly buffer), so the staging copy is
+// bounded by kBigPayload per message. Owned by one conn loop; no locks.
+struct RxBuf {
+  static constexpr size_t kCap = 256 << 10;
+  static constexpr size_t kBigPayload = 16 << 10;
+  int fd;
+  StageStats* st;
+  Buf buf;
+  size_t head = 0, tail = 0;
+  RxBuf(int f, StageStats* s) : fd(f), st(s) { buf.resize(kCap); }
+  size_t avail() const { return tail - head; }
+  bool fill() {  // blocks for >=1 fresh byte; false = conn dead/closed
+    if (head == tail) {
+      head = tail = 0;
+    } else if (tail == buf.size()) {
+      std::memmove(buf.data(), buf.data() + head, avail());
+      tail -= head;
+      head = 0;
+    }
+    ssize_t r = ::recv(fd, buf.data() + tail, buf.size() - tail, 0);
+    if (r <= 0) return false;
+    tail += (size_t)r;
+    if (st) st->rx_batches.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  bool recv_exact(void* out, size_t n) {
+    uint8_t* p = (uint8_t*)out;
+    while (n) {
+      if (avail() == 0 && !fill()) return false;
+      size_t take = std::min(n, avail());
+      std::memcpy(p, buf.data() + head, take);
+      head += take;
+      p += take;
+      n -= take;
+    }
+    return true;
+  }
+  bool recv_payload(uint8_t* dst, size_t n) {
+    size_t pre = std::min(n, avail());
+    if (pre) {
+      std::memcpy(dst, buf.data() + head, pre);
+      head += pre;
+    }
+    size_t rest = n - pre;
+    if (!rest) return true;
+    if (rest >= kBigPayload) return recv_all(fd, dst + pre, rest);
+    return recv_exact(dst + pre, rest);
+  }
 };
 
 static inline uint64_t now_ns() {
@@ -2383,7 +2665,11 @@ static const char* const kStatSlotNames[] = {
     "direct_recvs", "oob_msgs", "simd_tier", "engine_threads",
     "trace_records", "trace_dropped", "flight_records",
     "flight_dropped", "draining", "health_rounds",
-    "health_nonfinite", "window_deferred", "window_rejected"};
+    "health_nonfinite", "window_deferred", "window_rejected",
+    // PR 17 wire plane: tx/rx submission-ring batching, stripe
+    // reassembly, fused lossless decode, transport registration
+    "tx_batches", "tx_msgs", "rx_batches", "rx_msgs", "stripe_segs",
+    "stripe_bytes", "fused_decode_folds", "reg_blocks", "reg_miss"};
 static constexpr size_t kNumStatSlots =
     sizeof(kStatSlotNames) / sizeof(kStatSlotNames[0]);
 
@@ -2664,6 +2950,17 @@ class EngineQueue {
     return true;
   }
 
+  // Nonblocking pop — the engine loop uses an empty queue as the
+  // submission-ring flush boundary (a batch of queued replies is one
+  // sendmsg once no further work is immediately runnable).
+  bool try_pop(EngineMsg* out) {
+    std::lock_guard<Mu> lk(mu_);
+    if (q_.empty()) return false;
+    *out = std::move(const_cast<Item&>(q_.top()).msg);
+    q_.pop();
+    return true;
+  }
+
   void stop() {
     {
       std::lock_guard<Mu> lk(mu_);
@@ -2688,6 +2985,42 @@ class EngineQueue {
   std::priority_queue<Item> q_;
   uint64_t seq_ = 0;
   bool stop_ = false;
+};
+
+// RDMA-shaped transport registration: every BufPool block is
+// "registered" with the transport at allocation time — exactly where an
+// RDMA provider would pin and key the memory. On TCP the registry is a
+// range map plus two counters, but it makes the recv path
+// registration-STABLE: reg_blocks plateaus once the pool warmed up
+// (steady state allocates nothing new) and reg_miss counts recv targets
+// a real NIC would have had to pin on the critical path (~0 after
+// warmup is the signal a provider could rely on).
+class TransportReg {
+ public:
+  void add(const void* base, size_t cap, StageStats* st) {
+    std::lock_guard<Mu> lk(mu_);
+    if (blocks_.size() >= kMaxBlocks) blocks_.clear();
+    bool fresh = blocks_.insert_or_assign((uintptr_t)base, cap).second;
+    if (fresh && st) st->reg_blocks.fetch_add(1, std::memory_order_relaxed);
+  }
+  // containing-range lookup: is [ptr, ptr+n) inside a registered block?
+  bool covers(const void* ptr, size_t n) const {
+    uintptr_t p = (uintptr_t)ptr;
+    std::lock_guard<Mu> lk(mu_);
+    auto it = blocks_.upper_bound(p);
+    if (it == blocks_.begin()) return false;
+    --it;
+    return p >= it->first && p + n <= it->first + it->second;
+  }
+  void check(const void* ptr, size_t n, StageStats* st) const {
+    if (!covers(ptr, n) && st)
+      st->reg_miss.fetch_add(1, std::memory_order_relaxed);
+  }
+
+ private:
+  static constexpr size_t kMaxBlocks = 8192;
+  mutable Mu mu_;
+  std::map<uintptr_t, size_t> blocks_;  // base -> capacity
 };
 
 class Server {
@@ -2740,7 +3073,20 @@ class Server {
           }
           const char* x = ::getenv("BYTEPS_CROSS_BARRIER");
           return (uint64_t)(x && *x && std::strcmp(x, "0") != 0 ? 1 : 0);
+        }()),
+        // decompress-on-the-fabric (BYTEPS_FUSED_DECODE, default on;
+        // per instance so the bitwise A/B test runs fused and legacy
+        // servers in one process): LOSSLESS pushes decode straight into
+        // the accumulator / fold instead of a scratch pass + copy
+        fused_decode_([] {
+          const char* e = ::getenv("BYTEPS_FUSED_DECODE");
+          return !(e && *e && (*e == '0' || *e == 'f' || *e == 'F'));
         }()) {
+    // RDMA-shaped registration: pin every pool block as it is carved,
+    // off the recv critical path
+    pool_.set_alloc_hook([this](const void* base, size_t cap) {
+      reg_.add(base, cap, &stats_);
+    });
     n_engines_ = num_engine_threads < 1 ? 1 : num_engine_threads;
     engine_bytes_.reset(new std::atomic<uint64_t>[n_engines_]);
     for (int i = 0; i < n_engines_; ++i) {
@@ -2773,7 +3119,12 @@ class Server {
         trace_ring_.dropped(),  flight_ring_.total(),
         flight_ring_.dropped(), draining_.load() ? 1ull : 0ull,
         health_rounds_.load(),  health_nonfinite_.load(),
-        window_deferred_.load(), window_rejected_.load()};
+        window_deferred_.load(), window_rejected_.load(),
+        st.tx_batches.load(),   st.tx_msgs.load(),
+        st.rx_batches.load(),   st.rx_msgs.load(),
+        st.stripe_segs.load(),  st.stripe_bytes.load(),
+        st.fused_decode_folds.load(), st.reg_blocks.load(),
+        st.reg_miss.load()};
     int n = max_n < (int)kNumStatSlots ? max_n : (int)kNumStatSlots;
     for (int i = 0; i < n; ++i) out[i] = v[i];
     return n;
@@ -2936,9 +3287,30 @@ class Server {
   }
 
   void ConnLoop(std::shared_ptr<Conn> conn) {
+    conn->stats = &stats_;  // tx submission-ring accounting
+    // rx half of the submission ring: one recv() syscall pulls as many
+    // buffered wire messages as the kernel holds. TCP only — a conn
+    // upgraded to shm keeps its own ring, and the switch is safe
+    // because no TCP bytes ever follow IPC_CONFIRM (the staging buffer
+    // is empty at the moment ipc engages).
+    RxBuf rx(conn->fd, &stats_);
+    const bool use_rx = wire_ring_enabled();
+    auto next_msg = [&](MsgHeader* hh, OobRef* oo) {
+      if (use_rx && !conn->ipc) {
+        oo->ptr = nullptr;
+        if (!rx.recv_exact(hh, sizeof(*hh))) return false;
+        stats_.rx_msgs.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
+      return conn->recv_header(hh, oo);
+    };
+    auto recv_payload = [&](uint8_t* dst, size_t n) {
+      if (use_rx && !conn->ipc) return rx.recv_payload(dst, n);
+      return conn->recv_bytes(dst, n);
+    };
     MsgHeader h;
     OobRef oob;
-    while (conn->recv_header(&h, &oob)) {
+    while (next_msg(&h, &oob)) {
       if (h.magic != kMagic) {
         std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
         break;
@@ -2951,6 +3323,17 @@ class Server {
         // messages from before the death are fenced by their own (dead)
         // Conn, not by worker id
         clean_exit_.erase((int)h.sender);
+      }
+      // striped data message: the payload is a SegHdr-framed chunk of a
+      // larger (sender, key, seq) message being reassembled across this
+      // sender's data conns; never reaches the engine as-is
+      if ((h.flags & kFlagSeg) && !oob.ptr) {
+        if ((h.op != PUSH && h.op != PUSHPULL) || conn->ipc ||
+            !HandleSegment(conn, h, rx, use_rx)) {
+          std::fprintf(stderr, "[bps-server] bad stripe segment\n");
+          break;
+        }
+        continue;
       }
       EngineMsg m;
       m.op = h.op;
@@ -2992,7 +3375,8 @@ class Server {
           // zero-copy tier: the payload lands straight in the key's
           // reserved recv buffer, which the engine will adopt as (or
           // fold into) the accumulator
-          if (!conn->recv_bytes(direct_dst, h.len)) {
+          reg_.check(direct_dst, h.len, &stats_);
+          if (!recv_payload(direct_dst, h.len)) {
             ClearDirect(h.key);  // the key must not stay reserved
             break;
           }
@@ -3000,7 +3384,8 @@ class Server {
           stats_.direct_recvs.fetch_add(1, std::memory_order_relaxed);
         } else {
           m.payload = pool_.lease(h.len);
-          if (!conn->recv_bytes(m.payload.data(), h.len)) break;
+          reg_.check(m.payload.data(), h.len, &stats_);
+          if (!recv_payload(m.payload.data(), h.len)) break;
         }
         stats_.recv_ns.fetch_add(now_ns() - t0,
                                  std::memory_order_relaxed);
@@ -3046,21 +3431,7 @@ class Server {
         HandleShutdown(std::move(m));
         break;
       }
-      uint64_t prio = 0;
-      if (schedule_) {
-        std::lock_guard<Mu> lk(stores_mu_);
-        auto it = stores_.find(h.key);
-        // fewer completed pushes -> earlier (queue.h:31-105)
-        prio = it == stores_.end()
-                   ? 0
-                   : it->second.total_pushes.load(std::memory_order_relaxed);
-      }
-      // ThreadForKey also accumulates h.len into engine_bytes_ — the
-      // placement signal AND the balance proof surface
-      // (bps_server_engine_bytes)
-      int eng = ThreadForKey(h.key, h.len);
-      m.enq_ns = now_ns();
-      queues_[eng]->push(std::move(m), prio);
+      EnqueueData(std::move(m), h.len);
     }
     // Failure detection (beyond the reference, which has none —
     // SURVEY.md §5.3): when the LAST connection of a worker closes and
@@ -3082,7 +3453,213 @@ class Server {
           if (!clean_exit_.count(snd)) departed = true;
         }
       }
+      // any conn death invalidates in-flight stripe assemblies of this
+      // sender (a lost segment can never arrive) and resyncs its seq
+      // gate so the surviving stripes don't wedge behind the gap
+      StripeReset((uint16_t)snd, departed);
       if (departed && !shutting_down_.load()) OnWorkerDeparted(snd);
+    }
+  }
+
+  // Shared dispatch tail for data messages — conn loops and the stripe
+  // reassembly path both funnel here. ThreadForKey also accumulates
+  // `len` into engine_bytes_: the placement signal AND the balance
+  // proof surface (bps_server_engine_bytes).
+  void EnqueueData(EngineMsg&& m, uint32_t len) {
+    uint64_t prio = 0;
+    if (schedule_) {
+      std::lock_guard<Mu> lk(stores_mu_);
+      auto it = stores_.find(m.key);
+      // fewer completed pushes -> earlier (queue.h:31-105)
+      prio = it == stores_.end()
+                 ? 0
+                 : it->second.total_pushes.load(std::memory_order_relaxed);
+    }
+    int eng = ThreadForKey(m.key, len);
+    m.enq_ns = now_ns();
+    queues_[eng]->push(std::move(m), prio);
+  }
+
+  // One striped segment: [MsgHeader (kFlagSeg)][SegHdr][chunk]. The
+  // chunk is received straight into the shared assembly buffer
+  // (disjoint [off, off+chunk) ranges, written OUTSIDE stripe_mu_); the
+  // conn loop that lands the LAST segment rebuilds the message and
+  // dispatches it through the (sender, key) seq gate. Returns false
+  // only on protocol violation / dead conn (caller closes).
+  bool HandleSegment(const std::shared_ptr<Conn>& conn, const MsgHeader& h,
+                     RxBuf& rx, bool use_rx) {
+    SegHdr sh;
+    if (h.len < sizeof(SegHdr)) return false;
+    if (!(use_rx ? rx.recv_exact(&sh, sizeof(sh))
+                 : conn->recv_bytes(&sh, sizeof(sh))))
+      return false;
+    uint64_t chunk = (uint64_t)h.len - sizeof(SegHdr);
+    if (sh.nseg == 0 || sh.nseg > kMaxSegs || sh.idx >= sh.nseg ||
+        sh.total == 0 || sh.total > kMaxStripeTotal ||
+        sh.off > sh.total || chunk > sh.total - sh.off)
+      return false;
+    throttle_.charge((uint32_t)chunk);  // ingress side of the cap
+    uint64_t t0 = now_ns();
+    auto akey = std::make_tuple(h.sender, h.key, sh.seq);
+    std::shared_ptr<StripeAsm> as;
+    {
+      std::lock_guard<Mu> lk(stripe_mu_);
+      auto it = stripe_asm_.find(akey);
+      if (it == stripe_asm_.end()) {
+        as = std::make_shared<StripeAsm>();
+        as->base = h;
+        as->seq = sh.seq;
+        as->buf = pool_.lease((uint32_t)sh.total);
+        as->nseg = sh.nseg;
+        as->seen.assign(sh.nseg, 0);
+        stripe_asm_[akey] = as;
+      } else {
+        as = it->second;
+        // inconsistent framing or a duplicate segment is a protocol
+        // violation (the client never re-sends a segment on a live
+        // stream) — kill the conn rather than risk a torn payload
+        if (as->nseg != sh.nseg || as->buf.size() != sh.total ||
+            as->seen[sh.idx])
+          return false;
+      }
+      as->seen[sh.idx] = 1;
+      // segment 0 rides the sender's HOME conn for this key — where the
+      // client registered its reply waiter
+      if (sh.idx == 0) as->reply_conn = conn;
+    }
+    uint8_t* dst = as->buf.data() + sh.off;
+    reg_.check(dst, (size_t)chunk, &stats_);
+    if (!(use_rx ? rx.recv_payload(dst, (size_t)chunk)
+                 : conn->recv_bytes(dst, (size_t)chunk)))
+      return false;
+    stats_.recv_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+    stats_.recv_count.fetch_add(1, std::memory_order_relaxed);
+    stats_.stripe_segs.fetch_add(1, std::memory_order_relaxed);
+    stats_.stripe_bytes.fetch_add(chunk, std::memory_order_relaxed);
+    bool complete = false;
+    {
+      std::lock_guard<Mu> lk(stripe_mu_);
+      auto it = stripe_asm_.find(akey);
+      // a StripeReset raced this write: the assembly was dropped (the
+      // shared_ptr kept the buffer alive for our write) — segment
+      // discarded, conn stays healthy, client-side retry covers it
+      if (it == stripe_asm_.end() || it->second.get() != as.get())
+        return true;
+      if (++as->got == as->nseg) {
+        stripe_asm_.erase(it);
+        complete = true;
+      }
+    }
+    if (!complete) return true;
+    MsgHeader bh = as->base;
+    bh.flags = (uint8_t)(bh.flags & ~kFlagSeg);
+    bh.len = (uint32_t)as->buf.size();
+    EngineMsg m;
+    m.op = bh.op;
+    m.key = bh.key;
+    m.rid = bh.rid;
+    m.sender = bh.sender;
+    m.epoch = bh.epoch;
+    m.codec = bh.codec;
+    m.conn = as->reply_conn ? as->reply_conn : conn;
+    uint32_t req, dtype;
+    decode_cmd(bh.cmd, &req, &dtype);
+    m.req = req;
+    m.dtype = dtype;
+    m.payload = std::move(as->buf);
+    DispatchSeq(bh.sender, bh.key, as->seq, std::move(m), bh.len);
+    return true;
+  }
+
+  // Per-(sender, key) sequencing across the stripe group: the client
+  // stamps each striped message with a monotone seq, and reassembled
+  // messages enter the engine in exactly that order no matter which
+  // conn loop finished last. After a stripe death the gate resyncs —
+  // held survivors flush in ascending order and the next completion
+  // adopts its seq — so the group never wedges behind a lost message
+  // (the engine's replay/round gates own semantic correctness there).
+  void DispatchSeq(uint16_t sender, uint64_t key, uint32_t seq,
+                   EngineMsg&& m, uint32_t len) {
+    std::vector<EngineMsg> ready;
+    {
+      std::lock_guard<Mu> lk(stripe_mu_);
+      StripeGate& g = stripe_gates_[{sender, key}];
+      if (g.resync) {
+        g.held.emplace(seq, std::move(m));
+        for (auto& [s, hm] : g.held) {
+          ready.push_back(std::move(hm));
+          g.next = s + 1;
+        }
+        g.held.clear();
+        g.resync = false;
+      } else if (seq == g.next) {
+        ready.push_back(std::move(m));
+        ++g.next;
+        for (auto it = g.held.find(g.next); it != g.held.end();
+             it = g.held.find(g.next)) {
+          ready.push_back(std::move(it->second));
+          g.held.erase(it);
+          ++g.next;
+        }
+      } else if (seq > g.next) {
+        g.held.emplace(seq, std::move(m));
+        return;
+      } else {
+        // stale completion from before a resync: the client-side
+        // request already failed over; drop it
+        if (!m.payload.empty()) pool_.put(std::move(m.payload));
+        return;
+      }
+    }
+    for (auto& r : ready) {
+      uint32_t l = r.payload.empty() ? len : (uint32_t)r.payload.size();
+      EnqueueData(std::move(r), l);
+    }
+  }
+
+  // Conn-death hook: drop this sender's in-flight assemblies (a lost
+  // segment can never arrive; the shared_ptr keeps buffers alive for
+  // any conn loop mid-write), flush held-but-unordered survivors, and
+  // arm resync. Full departure erases the gates outright so a
+  // reconnecting worker restarts cleanly at seq 0.
+  void StripeReset(uint16_t sender, bool departed) {
+    std::vector<EngineMsg> ready;
+    {
+      std::lock_guard<Mu> lk(stripe_mu_);
+      for (auto it = stripe_asm_.begin(); it != stripe_asm_.end();) {
+        if (std::get<0>(it->first) == sender)
+          it = stripe_asm_.erase(it);
+        else
+          ++it;
+      }
+      for (auto it = stripe_gates_.begin(); it != stripe_gates_.end();) {
+        if (it->first.first != sender) {
+          ++it;
+          continue;
+        }
+        StripeGate& g = it->second;
+        if (departed) {
+          // the worker is gone: its held folds must be dropped, not
+          // folded into a round OnWorkerDeparted is about to roll back
+          for (auto& [s, hm] : g.held) {
+            (void)s;
+            if (!hm.payload.empty()) pool_.put(std::move(hm.payload));
+          }
+          it = stripe_gates_.erase(it);
+        } else {
+          for (auto& [s, hm] : g.held) {
+            ready.push_back(std::move(hm));
+            g.next = s + 1;
+          }
+          g.held.clear();
+          g.resync = true;
+          ++it;
+        }
+      }
+    }
+    for (auto& r : ready) {
+      uint32_t l = (uint32_t)r.payload.size();
+      EnqueueData(std::move(r), l);
     }
   }
 
@@ -3363,9 +3940,41 @@ class Server {
     }
   }
 
+  // tx half of the submission ring: data-plane replies queue on the
+  // destination conn's tx ring (QueueReply) and leave as ONE gathered
+  // sendmsg when the engine's queue momentarily drains — a round's
+  // worth of ACKs/aggregates is one syscall batch, not N. Registered
+  // per engine thread; null on conn-loop/control threads, which keep
+  // blocking sends.
+  inline static thread_local std::vector<std::shared_ptr<Conn>>*
+      t_touched_ = nullptr;
+
+  void QueueReply(const std::shared_ptr<Conn>& conn, const MsgHeader& r,
+                  std::shared_ptr<const Buf> pin) {
+    if (t_touched_ && !conn->ipc && wire_ring_enabled()) {
+      if (conn->send_msg_queued(r, std::move(pin))) {
+        auto& v = *t_touched_;
+        for (auto& c : v)
+          if (c.get() == conn.get()) return;
+        v.push_back(conn);
+      }
+      return;
+    }
+    conn->send_msg(r, pin ? (const void*)pin->data() : nullptr);
+  }
+
   void EngineLoop(int idx) {
+    std::vector<std::shared_ptr<Conn>> touched;
+    t_touched_ = &touched;
     EngineMsg m;
-    while (queues_[idx]->wait_pop(&m)) {
+    while ([&] {
+      if (queues_[idx]->try_pop(&m)) return true;
+      // drain boundary: no immediately-runnable work — flush every
+      // conn holding queued replies before blocking
+      for (auto& c : touched) c->tx_flush();
+      touched.clear();
+      return queues_[idx]->wait_pop(&m);
+    }()) {
       // gray-failure injection (BYTEPS_CHAOS_SLOW_SERVER): the sleep
       // sits between dequeue and the queue-wait accounting below, so it
       // COUNTS as queue-wait — the stage a real straggler inflates
@@ -3443,6 +4052,8 @@ class Server {
       if (!m.payload.empty()) pool_.put(std::move(m.payload));
       m.conn.reset();
     }
+    for (auto& c : touched) c->tx_flush();
+    t_touched_ = nullptr;
   }
 
   KeyStore& store_of(uint64_t key) {
@@ -3970,6 +4581,81 @@ class Server {
     if (ready) AnswerPull(ks, p);
   }
 
+  // Decompress-on-the-fabric (BYTEPS_FUSED_DECODE, tentpole move 3):
+  // decode the LOSSLESS byte-plane wire straight into the accumulator.
+  // The legacy path materializes a full dense scratch (inflate ->
+  // scatter n*4 bytes -> memcpy/fold n*4 bytes, re-streamed from RAM);
+  // here the first push of a round scatters the decoded floats IN
+  // PLACE of the accumulator (no scratch, no memcpy) and later pushes
+  // scatter one cache-sized block at a time with the SIMD fold
+  // consuming it while L1/L2-hot — one full memory pass removed per
+  // push. Fold order is unchanged (kernels_.f32 is elementwise
+  // left-to-right), so the aggregate is bitwise-identical to the
+  // legacy path — the fused/legacy A/B test pins that. Atomicity: the
+  // byte planes inflate into thread-local staging FIRST, exhausting
+  // every failure mode (zlib errors, bad lengths) before the first
+  // accumulator write, so a rejected wire leaves the round exactly as
+  // the legacy scratch path would. Call under ks.mu.
+  bool LosslessDecodeInto(const uint8_t* in, uint32_t len, KeyStore& ks) {
+    const uint32_t n = ks.comp.n;
+    if (len < CompressorCfg::kLosslessHdr) return false;
+    uint32_t wn;
+    std::memcpy(&wn, in, 4);
+    uint8_t mode = in[4], nplanes = in[5];
+    if (wn != n || nplanes != 4 || mode > 1) return false;
+    uint32_t plens[4];
+    std::memcpy(plens, in + 8, 16);
+    uint64_t total = 0;
+    for (int j = 0; j < 4; ++j) total += plens[j];
+    if (CompressorCfg::kLosslessHdr + total != len) return false;
+    static thread_local std::vector<uint8_t> tl_planes[4];
+    const uint8_t* plane[4];
+    size_t pos = CompressorCfg::kLosslessHdr;
+    for (int j = 0; j < 4; ++j) {
+      const uint8_t* src = in + pos;
+      if (mode == 0) {  // raw planes ride the wire: zero-copy pointers
+        if (plens[j] != n) return false;
+        plane[j] = src;
+      } else {
+        tl_planes[j].resize(n);
+        uLongf dl = n;
+        if (uncompress(tl_planes[j].data(), &dl, src, plens[j]) != Z_OK ||
+            dl != n)
+          return false;
+        plane[j] = tl_planes[j].data();
+      }
+      pos += plens[j];
+    }
+    const bool first = ks.recv_count == 0;
+    if (first && ks.accum.size() != ks.len) {
+      if ((uint64_t)n * 4 == ks.len) {
+        // the scatter below writes every byte: skip the zero-fill
+        if (ks.accum.capacity() >= ks.len)
+          ks.accum.resize(ks.len);
+        else
+          ks.accum = pool_.lease(ks.len);
+      } else {
+        ks.accum.assign(ks.len, 0);
+      }
+    }
+    static thread_local std::vector<float> tl_block;
+    constexpr uint32_t kChunk = 16384;  // 64 KiB of f32 per block
+    float* accum = (float*)ks.accum.data();
+    if (!first) tl_block.resize(kChunk);
+    for (uint32_t off = 0; off < n; off += kChunk) {
+      uint32_t c = n - off < kChunk ? n - off : kChunk;
+      uint8_t* dst =
+          first ? (uint8_t*)(accum + off) : (uint8_t*)tl_block.data();
+      for (int j = 0; j < 4; ++j) {
+        const uint8_t* p = plane[j] + off;
+        for (uint32_t i = 0; i < c; ++i) dst[i * 4 + j] = p[i];
+      }
+      if (!first) kernels_.f32(accum + off, tl_block.data(), c);
+    }
+    stats_.fused_decode_folds.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
   void DoPushCompressed(EngineMsg& m, KeyStore& ks, bool fused) {
     std::vector<ParkedPull> flush;
     std::vector<EngineMsg> defer;
@@ -4106,7 +4792,16 @@ class Server {
         // invalid wire: fall through to the generic path's error report
       }
       uint64_t t_fold = now_ns();
-      if (!ks.comp.Decompress(m.data(), (uint32_t)m.size(),
+      bool fused_decoded = false;
+      if (ks.comp.type == CompressorCfg::LOSSLESS && fused_decode_) {
+        // decompress-on-the-fabric: decode straight into the
+        // accumulator / fold, skipping the dense scratch pass (and on
+        // the first push of a round, the scratch->accum memcpy too)
+        fused_decoded = LosslessDecodeInto(m.data(), (uint32_t)m.size(),
+                                           ks);
+      }
+      if (!fused_decoded &&
+          !ks.comp.Decompress(m.data(), (uint32_t)m.size(),
                               ks.scratch.data(),
                               ks.recv_count == 0 ? &ks.round_idx : nullptr)) {
         // Decompress validates the length itself (exact for the fixed
@@ -4125,19 +4820,21 @@ class Server {
         ks.worker_push_count[m.sender]++;
       if (m.sender < ks.pull_abort.size()) ks.pull_abort[m.sender] = 0;
       RecordRound(ks, m);
-      DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
-                 ks.comp.n * 4, F32);
-      // defensive resize: accum can be moved-out empty after a dense
-      // round on this key (ALL_RECV publish-by-move); the first recv of
-      // a compressed round writes the full dense length
-      if (ks.recv_count == 0 && ks.accum.size() != ks.len)
-        ks.accum.assign(ks.len, 0);
-      float* accum = (float*)ks.accum.data();
-      if (ks.recv_count == 0) {
-        std::memcpy(accum, ks.scratch.data(),
-                    ks.comp.n * sizeof(float));
-      } else {
-        kernels_.f32(accum, ks.scratch.data(), ks.comp.n);
+      if (!fused_decoded) {
+        DebugPrint("DECOMPRESS", m.key, ks.scratch.data(),
+                   ks.comp.n * 4, F32);
+        // defensive resize: accum can be moved-out empty after a dense
+        // round on this key (ALL_RECV publish-by-move); the first recv
+        // of a compressed round writes the full dense length
+        if (ks.recv_count == 0 && ks.accum.size() != ks.len)
+          ks.accum.assign(ks.len, 0);
+        float* accum = (float*)ks.accum.data();
+        if (ks.recv_count == 0) {
+          std::memcpy(accum, ks.scratch.data(),
+                      ks.comp.n * sizeof(float));
+        } else {
+          kernels_.f32(accum, ks.scratch.data(), ks.comp.n);
+        }
       }
       RecordFold(t_fold, m.size());
       ks.recv_count++;
@@ -4175,7 +4872,7 @@ class Server {
   ack:
     if (!fused) {
       MsgHeader r = ReplyHeader(ACK, 0, 0, m.rid, m.key);
-      m.conn->send_msg(r, nullptr);
+      QueueReply(m.conn, r, nullptr);
     }
     for (auto& p : flush) AnswerPull(ks, p);
     // fused: the compressed-wire aggregate IS the reply — parked (or
@@ -4462,7 +5159,7 @@ class Server {
     if (!fused) {
       // ack the push (ZPush completion callback)
       MsgHeader r = ReplyHeader(ACK, 0, 0, m.rid, m.key);
-      m.conn->send_msg(r, nullptr);
+      QueueReply(m.conn, r, nullptr);
     }
     for (auto& p : flush) AnswerPull(ks, p);
     // fused: the aggregate IS the reply — park or answer instead of ACK
@@ -4590,11 +5287,13 @@ class Server {
     }
     MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, p.rid, 0, 0,
                               (uint32_t)snap->size());
-    // reply stage: header + shared aggregate leave in one gathered
-    // sendmsg (TCP) or land once in the shm arena (descriptor tier) —
-    // no assembly copy on either transport
+    // reply stage: on an engine thread the header + shared aggregate
+    // become a tx-ring entry (the snap shared_ptr pins the published
+    // buffer until the batch flushes) and leave with the rest of the
+    // round's replies in one gathered sendmsg; elsewhere — and on shm —
+    // the legacy single gathered send / arena write
     uint64_t t0 = now_ns();
-    p.conn->send_msg(r, snap->data());
+    QueueReply(p.conn, r, snap);
     stats_.reply_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
     stats_.reply_count.fetch_add(1, std::memory_order_relaxed);
     TraceReply(p);
@@ -4704,6 +5403,39 @@ class Server {
   std::atomic<uint64_t> window_deferred_{0};
   std::atomic<uint64_t> window_rejected_{0};
   BufPool pool_;         // recycled payload/fold-scratch buffers
+  // decompress-on-the-fabric flag (BYTEPS_FUSED_DECODE; per instance)
+  bool fused_decode_;
+  // RDMA-shaped registration of pool blocks (see TransportReg)
+  TransportReg reg_;
+
+  // ---- stripe reassembly plane (kFlagSeg) ------------------------- //
+  // A striped message of one (sender, key, seq) arrives as nseg
+  // segments spread over the sender's data connections. Each conn loop
+  // receives its segment's chunk straight into the shared assembly
+  // buffer (disjoint [off, off+chunk) ranges, written OUTSIDE the
+  // lock); the loop that lands the last segment dispatches the
+  // reassembled message. The per-(sender,key) seq gate re-establishes
+  // the sender's send order across conns — without it two rounds of one
+  // key racing different stripes could reach the engine inverted.
+  struct StripeAsm {
+    MsgHeader base;                 // header with kFlagSeg cleared later
+    uint32_t seq = 0;
+    Buf buf;                        // pooled; becomes EngineMsg payload
+    uint32_t nseg = 0;
+    uint32_t got = 0;               // guarded-by: stripe_mu_
+    std::vector<uint8_t> seen;      // per-segment dup guard
+    std::shared_ptr<Conn> reply_conn;  // segment 0's conn = home conn
+  };
+  struct StripeGate {
+    uint32_t next = 0;     // next seq to dispatch for this (sender,key)
+    bool resync = false;   // a stripe conn died: adopt the next
+                           // completed seq instead of waiting forever
+    std::map<uint32_t, EngineMsg> held;  // completed but out-of-order
+  };
+  Mu stripe_mu_;
+  std::map<std::tuple<uint16_t, uint64_t, uint32_t>,
+           std::shared_ptr<StripeAsm>> stripe_asm_;
+  std::map<std::pair<uint16_t, uint64_t>, StripeGate> stripe_gates_;
 
   std::unordered_map<uint64_t, KeyStore> stores_;
   Mu stores_mu_;  // guards only the map itself; data ops take the
@@ -5078,6 +5810,104 @@ class ServerConn {
     return sent;
   }
 
+  // ---- connection striping (kFlagSeg) ------------------------------
+  // One striped message spreads over the group's data conns as
+  // [MsgHeader|SegHdr|chunk] segments; THIS conn's share leaves as one
+  // gathered sendmsg under send_mu_ (the client half of the batched
+  // submission ring). The reply rides segment 0's conn — the home conn,
+  // where the waiter was registered.
+  struct SegPart {
+    uint32_t idx;
+    uint64_t off;
+    uint32_t len;
+    const uint8_t* ptr;
+  };
+
+  bool SendSegments(MsgHeader base, uint32_t seq, uint32_t nseg,
+                    uint64_t total, const SegPart* parts, int np) {
+    if (np <= 0) return true;
+    if (sticky_err_.load() || chan_) return false;  // TCP-only framing
+    std::vector<MsgHeader> hs((size_t)np);
+    std::vector<SegHdr> ss((size_t)np);
+    std::vector<iovec> iov(3 * (size_t)np);
+    uint64_t payload = 0;
+    int n = 0;
+    for (int i = 0; i < np; ++i) {
+      hs[i] = base;
+      hs[i].flags |= kFlagSeg;
+      hs[i].len = (uint32_t)(sizeof(SegHdr) + parts[i].len);
+      ss[i] = SegHdr{seq, parts[i].idx, nseg, 0, parts[i].off, total};
+      iov[n].iov_base = &hs[i];
+      iov[n++].iov_len = sizeof(MsgHeader);
+      iov[n].iov_base = &ss[i];
+      iov[n++].iov_len = sizeof(SegHdr);
+      iov[n].iov_base = (void*)parts[i].ptr;
+      iov[n++].iov_len = parts[i].len;
+      payload += parts[i].len;
+    }
+    std::lock_guard<Mu> lk(send_mu_);
+    if (!send_iovs(fd_, iov.data(), n)) return false;
+    tx_bytes_.fetch_add(
+        payload + (uint64_t)np * (sizeof(MsgHeader) + sizeof(SegHdr)),
+        std::memory_order_relaxed);
+    return true;
+  }
+
+  // Register a fused waiter WITHOUT sending — striped requests
+  // transmit their payload themselves via SendSegments across several
+  // conns; the waiter (and the reply) live on this, the home conn.
+  bool RegisterFused(uint64_t ticket, void* out, uint32_t out_len,
+                     uint32_t* rid_out) {
+    if (sticky_err_.load()) return false;
+    auto w = AcquireWaiter();
+    pthread_mutex_lock(&w->mu);
+    w->fused = true;
+    w->ticket = ticket;
+    w->out = out;
+    w->out_len = out_len;
+    w->sent_at = std::chrono::steady_clock::now();
+    pthread_mutex_unlock(&w->mu);
+    uint32_t rid = g_next_rid.fetch_add(1);
+    {
+      std::lock_guard<Mu> lk(waiters_mu_);
+      if (sticky_err_.load()) return false;
+      waiters_[rid] = w;
+    }
+    *rid_out = rid;
+    return true;
+  }
+
+  // Abandon a registered-but-unsent fused waiter. Returns true when
+  // THIS call claimed it (caller may fail over to another conn);
+  // false means the conn-death sweep already failed the ticket
+  // through the completion queue — the caller must NOT double-fail.
+  bool UnregisterFused(uint32_t rid) {
+    std::shared_ptr<Waiter> w;
+    {
+      std::lock_guard<Mu> lk(waiters_mu_);
+      auto it = waiters_.find(rid);
+      if (it == waiters_.end()) return false;
+      w = std::move(it->second);
+      waiters_.erase(it);
+    }
+    RecycleWaiter(std::move(w));
+    return true;
+  }
+
+  // striped-payload bytes this conn carried (headers included) — the
+  // bench's per-stripe byte-conservation proof reads these per conn
+  uint64_t tx_bytes() const {
+    return tx_bytes_.load(std::memory_order_relaxed);
+  }
+
+  // fault-injection hook (tests): kill the transport under the group.
+  // shutdown() makes every later send fail fast and pops the server's
+  // conn loop, without closing an fd the recv thread still owns.
+  void KillForTest() {
+    if (chan_) chan_->mark_broken();
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  }
+
   // Expire fused waiters older than `timeout_s` (called from the
   // reactor's poll loop): each expired waiter is REMOVED first (the
   // recv loop's claim point is the waiters_ erasure, so a late reply
@@ -5430,6 +6260,8 @@ class ServerConn {
   // every later Request fails fast instead of wedging on a round the
   // server will never complete
   std::atomic<bool> sticky_err_{false};
+  // striped bytes (payload + framing) sent on this conn (SendSegments)
+  std::atomic<uint64_t> tx_bytes_{0};
 };
 
 class Client {
@@ -5456,6 +6288,14 @@ class Client {
     if (const char* e = ::getenv("BYTEPS_CLIENT_CONNS")) {
       conns_per_server_ = std::atoi(e);
       if (conns_per_server_ < 1) conns_per_server_ = 1;
+      if (conns_per_server_ > 16) conns_per_server_ = 16;
+    }
+    if (int ws = wire_stripes()) {
+      // BYTEPS_WIRE_STRIPES=N -> N data conns plus the conn-0 control
+      // lane; N=1 pins the group to one data conn and PushPullStriped
+      // never engages (the stripes-off A/B arm)
+      conns_per_server_ = ws + 1;
+      if (conns_per_server_ < 2) conns_per_server_ = 2;
       if (conns_per_server_ > 16) conns_per_server_ = 16;
     }
     if ((int)servers.size() > kMaxServers) return false;
@@ -5495,10 +6335,16 @@ class Client {
   // fused PUSHPULL over the key-affine conn (same FIFO stream as the
   // two-op push->pull pair, so server-side ordering is unchanged).
   // `codec`: adaptive-plan wire tag, 0 = untagged (MsgHeader::codec).
+  // Large TCP payloads stripe across the group's data conns instead
+  // (PushPullStriped) — one partition no longer head-of-line-blocks
+  // everything behind it on a single kernel flow.
   int PushPull(int server, uint64_t key, const void* data, uint32_t len,
                uint32_t cmd, void* out, uint32_t out_len,
                uint64_t ticket, uint64_t epoch, uint32_t codec = 0,
                uint32_t* rid_out = nullptr) {
+    int rc = PushPullStriped(server, key, data, len, cmd, out, out_len,
+                             ticket, epoch, codec, rid_out);
+    if (rc != kNotStriped) return rc;
     return pick(server, key)->RequestFused(key, cmd, worker_id_, data,
                                            len, out, out_len, ticket,
                                            epoch, codec, rid_out)
@@ -5682,6 +6528,40 @@ class Client {
     return n;
   }
 
+  // cumulative striped-send accounting (bench byte-conservation proof:
+  // sum of per-conn tx_bytes == bytes + 72 * segs, exactly)
+  void StripeStats(uint64_t* segs, uint64_t* bytes) const {
+    *segs = stripe_segs_sent_.load(std::memory_order_relaxed);
+    *bytes = stripe_bytes_sent_.load(std::memory_order_relaxed);
+  }
+
+  // per-conn striped byte counters for one server's group (slot 0 =
+  // the control-lane conn, always 0)
+  int StripeBytes(int server, uint64_t* out, int max_n) {
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return -1;
+    ConnGroup& g = *groups_[server];
+    int n = 0;
+    for (auto& c : g.conns) {
+      if (n >= max_n) break;
+      out[n++] = c ? c->tx_bytes() : 0;
+    }
+    return n;
+  }
+
+  // fault-injection hook (tests): kill one conn of a server's group
+  int KillStripe(int server, int idx) {
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return -1;
+    ConnGroup& g = *groups_[server];
+    if (idx < 0 || idx >= (int)g.conns.size() || !g.conns[idx])
+      return -1;
+    g.conns[idx]->KillForTest();
+    return 0;
+  }
+
   int Shutdown() {
     // exactly ONE shutdown per server per worker: the server counts
     // SHUTDOWN messages against num_workers, so the stripe conns must
@@ -5702,7 +6582,93 @@ class Client {
   struct ConnGroup {
     std::vector<std::unique_ptr<ServerConn>> conns;
     std::atomic<uint32_t> rr{0};
+    // per-key striped-send ordinal: the server's (sender, key) seq
+    // gate re-establishes this order across the group's conn loops
+    Mu seq_mu;
+    std::unordered_map<uint64_t, uint32_t> seqs;
   };
+
+  // sentinel: the message was not eligible for striping — caller
+  // routes it down the legacy single-conn path
+  static constexpr int kNotStriped = -2;
+
+  // Striped fused PUSHPULL (tentpole move 2): eligibility is decided
+  // per message — a TCP group with >= 2 LIVE data conns (conn 0 stays
+  // the control lane: STATS_PULL/CLOCK_PROBE/JOIN_PROBE/HEALTH_PULL
+  // never queue behind a multi-MB partition) and a payload of at least
+  // two stripe chunks. A dead stripe just drops out of the live set —
+  // single-stripe death degrades width, never the request — and an
+  // shm-upgraded conn never stripes (the arena tier already beats it).
+  int PushPullStriped(int server, uint64_t key, const void* data,
+                      uint32_t len, uint32_t cmd, void* out,
+                      uint32_t out_len, uint64_t ticket, uint64_t epoch,
+                      uint32_t codec, uint32_t* rid_out) {
+    if (server < 0 ||
+        server >= n_groups_.load(std::memory_order_acquire))
+      return kNotStriped;
+    ConnGroup& g = *groups_[server];
+    int nd = (int)g.conns.size() - 1;
+    uint32_t csz = stripe_chunk_bytes();
+    if (nd < 2 || (uint64_t)len < 2ull * csz) return kNotStriped;
+    std::vector<int> live;
+    live.reserve((size_t)nd);
+    for (int j = 1; j <= nd; ++j)
+      if (!g.conns[j]->dead() && !g.conns[j]->ipc_active())
+        live.push_back(j);
+    if ((int)live.size() < 2) return kNotStriped;
+    uint64_t nseg64 = ((uint64_t)len + csz - 1) / csz;
+    if (nseg64 > kMaxSegs) {
+      csz = (uint32_t)(((uint64_t)len + kMaxSegs - 1) / kMaxSegs);
+      nseg64 = ((uint64_t)len + csz - 1) / csz;
+    }
+    uint32_t nseg = (uint32_t)nseg64;
+    size_t hbase = (size_t)((key ^ (key >> 16)) % live.size());
+    ServerConn* home = g.conns[live[hbase]].get();
+    uint32_t rid = 0;
+    if (!home->RegisterFused(ticket, out, out_len, &rid))
+      return kNotStriped;  // home poisoned: legacy path picks another
+    if (rid_out) *rid_out = rid;
+    uint32_t seq;
+    {
+      std::lock_guard<Mu> lk(g.seq_mu);
+      seq = g.seqs[key]++;
+    }
+    MsgHeader base{kMagic, PUSHPULL, 0, worker_id_, rid, key, cmd, 0,
+                   epoch, codec};
+    // segment s -> live[(hbase + s) % live]; segment 0 lands on the
+    // home conn, where the reply waiter is registered
+    std::vector<std::vector<ServerConn::SegPart>> parts(live.size());
+    const uint8_t* p = (const uint8_t*)data;
+    for (uint32_t s = 0; s < nseg; ++s) {
+      uint64_t off = (uint64_t)s * csz;
+      uint32_t clen = (uint32_t)(off + csz <= len ? csz : len - off);
+      parts[(hbase + s) % live.size()].push_back({s, off, clen, p + off});
+    }
+    std::vector<ServerConn::SegPart> failed;
+    for (size_t j = 0; j < live.size(); ++j) {
+      if (j == hbase || parts[j].empty()) continue;
+      if (!g.conns[live[j]]->SendSegments(base, seq, nseg, len,
+                                          parts[j].data(),
+                                          (int)parts[j].size()))
+        failed.insert(failed.end(), parts[j].begin(), parts[j].end());
+    }
+    // home's own share — plus any segments whose stripe died mid-send
+    // (failover: the message completes on the home conn; the server's
+    // StripeReset dropped nothing we still need on the live conns)
+    std::vector<ServerConn::SegPart> homeparts = std::move(parts[hbase]);
+    homeparts.insert(homeparts.end(), failed.begin(), failed.end());
+    if (!home->SendSegments(base, seq, nseg, len, homeparts.data(),
+                            (int)homeparts.size())) {
+      // home transport failed: reclaim the waiter unless the death
+      // sweep already failed the ticket through the completion queue —
+      // mirrors RequestFused's fail-exactly-once contract
+      if (home->UnregisterFused(rid)) return -1;
+      return 0;
+    }
+    stripe_segs_sent_.fetch_add(nseg, std::memory_order_relaxed);
+    stripe_bytes_sent_.fetch_add(len, std::memory_order_relaxed);
+    return 0;
+  }
 
   // Build one server's fully-connected striped group (recv loops
   // running); nullptr on any connect failure.
@@ -5743,6 +6709,10 @@ class Client {
   std::atomic<int> n_groups_{0};
   Mu grow_mu_;  // serializes AddServer calls (readers stay lock-free)
   CompletionQueue cq_;  // fused-request completions, all conns
+  // wire-plane ledger: byte conservation for the stripe_ab bench —
+  // sum(per-conn tx_bytes) == stripe_bytes_sent + 72 * stripe_segs_sent
+  std::atomic<uint64_t> stripe_segs_sent_{0};
+  std::atomic<uint64_t> stripe_bytes_sent_{0};
 };
 
 }  // namespace bps
@@ -5974,16 +6944,33 @@ int bps_client_barrier(void* c) { return ((bps::Client*)c)->Barrier(); }
 int bps_client_ipc_conns(void* c) { return ((bps::Client*)c)->IpcConns(); }
 
 // Client transport counters: out[0]=ipc conns, out[1]=total conns,
-// out[2]=oob descriptor messages sent, out[3]=oob received. Returns
-// how many slots were filled (layout is append-only).
+// out[2]=oob descriptor messages sent, out[3]=oob received,
+// out[4]=striped segments sent, out[5]=striped payload bytes sent.
+// Returns how many slots were filled (layout is append-only).
 int bps_client_transport_stats(void* c, uint64_t* out, int max_n) {
   auto* cl = (bps::Client*)c;
-  uint64_t v[4] = {(uint64_t)cl->IpcConns(), (uint64_t)cl->TotalConns(),
-                   0, 0};
+  uint64_t v[6] = {(uint64_t)cl->IpcConns(), (uint64_t)cl->TotalConns(),
+                   0, 0, 0, 0};
   cl->TransportStats(&v[2], &v[3]);
-  int n = max_n < 4 ? max_n : 4;
+  cl->StripeStats(&v[4], &v[5]);
+  int n = max_n < 6 ? max_n : 6;
   for (int i = 0; i < n; ++i) out[i] = v[i];
   return n;
+}
+
+// Per-conn cumulative TX bytes (payload + stripe framing) for one
+// server's conn group; slot 0 is the control lane. Returns slots
+// filled, or -1 for a bad server index. Bench-side byte-conservation
+// proof: sum over data slots == transport_stats[5] + 72*[4].
+int bps_client_stripe_bytes(void* c, int server, uint64_t* out,
+                            int max_n) {
+  return ((bps::Client*)c)->StripeBytes(server, out, max_n);
+}
+
+// Test hook: hard-kill one conn of a server's group (shutdown(2) the
+// socket) to exercise single-stripe death failover.
+int bps_client_kill_stripe(void* c, int server, int idx) {
+  return ((bps::Client*)c)->KillStripe(server, idx);
 }
 
 int bps_client_total_conns(void* c) {

@@ -46,6 +46,12 @@ _STAT_SLOTS = (
     "trace_records", "trace_dropped", "flight_records",
     "flight_dropped", "draining", "health_rounds", "health_nonfinite",
     "window_deferred", "window_rejected",
+    # PR 17 wire plane: reply-batch ring (tx_batches/tx_msgs: msgs per
+    # batch > 1 proves per-message sends retired), staged recv buffer
+    # (rx_batches/rx_msgs), stripe reassembly (segments/payload bytes),
+    # fused lossless decode-into-fold, and transport block registration
+    "tx_batches", "tx_msgs", "rx_batches", "rx_msgs", "stripe_segs",
+    "stripe_bytes", "fused_decode_folds", "reg_blocks", "reg_miss",
 )
 
 # Wire-sampled trace record (native/ps.cc TraceRec, drained over the
@@ -195,6 +201,15 @@ def derive_stage_section(raw: Dict[str, int]) -> Dict[str, float]:
         "health_nonfinite": raw["health_nonfinite"],
         "window_deferred": raw["window_deferred"],
         "window_rejected": raw["window_rejected"],
+        "tx_batches": raw["tx_batches"],
+        "tx_msgs": raw["tx_msgs"],
+        "rx_batches": raw["rx_batches"],
+        "rx_msgs": raw["rx_msgs"],
+        "stripe_segs": raw["stripe_segs"],
+        "stripe_bytes": raw["stripe_bytes"],
+        "fused_decode_folds": raw["fused_decode_folds"],
+        "reg_blocks": raw["reg_blocks"],
+        "reg_miss": raw["reg_miss"],
     }
 
 

@@ -40,8 +40,11 @@ from ..utils.logging import log
 # header or magic change that lands on only one side fails the lint
 # (the 36B->40B / 0xB17E5001->0xB17E5002 drift class). Keep field
 # order identical to the struct: magic, op, flags, sender, rid, key,
-# cmd, len, epoch, codec — little-endian, packed.
-WIRE_MAGIC = 0xB17E5002
+# cmd, len, epoch, codec — little-endian, packed. 0xB17E5003 added the
+# kFlagSeg striped-segment frame (MsgHeader + 32B SegHdr + chunk): a
+# peer speaking the pre-stripe magic must be rejected at accept, not
+# fed reassembly frames it would misparse as oversized payloads.
+WIRE_MAGIC = 0xB17E5003
 WIRE_HEADER_FMT = "<IBBHIQIIQI"
 WIRE_HEADER_BYTES = 40
 assert struct.calcsize(WIRE_HEADER_FMT) == WIRE_HEADER_BYTES
@@ -153,6 +156,17 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint32,
             ctypes.c_int]
+    if hasattr(lib, "bps_client_stripe_bytes"):
+        # striped wire plane (BYTEPS_WIRE_STRIPES): per-conn TX byte
+        # ledger + the stripe-death test hook; guarded — a stale .so
+        # reports no stripe instruments and never stripes
+        lib.bps_client_stripe_bytes.restype = ctypes.c_int
+        lib.bps_client_stripe_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.bps_client_kill_stripe.restype = ctypes.c_int
+        lib.bps_client_kill_stripe.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     if hasattr(lib, "bps_client_add_server"):
         # runtime scale-up (elastic fleet); guarded — a stale .so simply
         # cannot grow its fleet and add_server() raises a clear error
@@ -283,6 +297,7 @@ class PSClient:
         self._m_pull_req = self._m_pull_bytes = None
         self._m_pushpull_req = self._m_errors = None
         self._m_inflight = self._m_inflight_peak = self._m_cq_depth = None
+        self._m_stripe_segs = self._m_stripe_bytes = None
         # fused PUSHPULL completion reactor: ticket -> (callback,
         # reply-buffer ref). The buffer ref is load-bearing — the native
         # recv loop writes through its pointer until the ticket's
@@ -319,6 +334,13 @@ class PSClient:
         self._m_inflight = metrics.gauge("wire/inflight")
         self._m_inflight_peak = metrics.gauge("wire/inflight_peak")
         self._m_cq_depth = metrics.gauge("wire/cq_depth")
+        # striped-wire ledger (BYTEPS_WIRE_STRIPES): cumulative segments
+        # and payload bytes fanned across the data conns, refreshed by
+        # the completion reactor each poll batch — zeros mean the
+        # striper never engaged (payloads under 2 chunks, shm transport,
+        # or stripes pinned to 1)
+        self._m_stripe_segs = metrics.gauge("wire/stripe_segs")
+        self._m_stripe_bytes = metrics.gauge("wire/stripe_bytes")
 
     def _inflight_add(self, d: int) -> None:
         # gauge writes INSIDE the lock: set() calls from two threads must
@@ -367,20 +389,56 @@ class PSClient:
         replies copied once from the arena straight into the caller's
         buffer. Zeros (with conns populated) when the transport is TCP
         or the payloads are below the descriptor threshold; all zeros
-        on a stale native lib predating the ABI."""
+        on a stale native lib predating the ABI. ``stripe_segs`` /
+        ``stripe_bytes`` count fused PUSHPULL traffic the client split
+        across the BYTEPS_WIRE_STRIPES data connections (segments and
+        payload bytes; framing overhead is 72B per segment — the
+        byte-conservation identity the stripe_ab bench asserts is
+        ``sum(stripe_conn_bytes()) == stripe_bytes + 72*stripe_segs``)."""
         if self._closed:
             raise RuntimeError("transport_stats on a closed PSClient")
         out = {"ipc_conns": 0, "total_conns": 0, "oob_sent": 0,
-               "oob_recvd": 0}
+               "oob_recvd": 0, "stripe_segs": 0, "stripe_bytes": 0}
         if not hasattr(self._lib, "bps_client_transport_stats"):
             return out
-        buf = (ctypes.c_uint64 * 4)()
-        n = self._lib.bps_client_transport_stats(self._handle, buf, 4)
+        buf = (ctypes.c_uint64 * 6)()
+        n = self._lib.bps_client_transport_stats(self._handle, buf, 6)
         for i, k in enumerate(("ipc_conns", "total_conns", "oob_sent",
-                               "oob_recvd")):
+                               "oob_recvd", "stripe_segs",
+                               "stripe_bytes")):
             if i < n:
                 out[k] = int(buf[i])
         return out
+
+    def stripe_conn_bytes(self, server: int) -> List[int]:
+        """Cumulative TX bytes per connection of one server's group
+        (slot 0 is the control lane — always 0 stripe traffic). Sums
+        to ``stripe_bytes + 72*stripe_segs`` when only striped traffic
+        has flowed: the per-stripe half of the conservation proof.
+        Empty list on a stale native lib."""
+        self._check_server(server)
+        if self._closed:
+            raise RuntimeError("stripe_conn_bytes on a closed PSClient")
+        if not hasattr(self._lib, "bps_client_stripe_bytes"):
+            return []
+        buf = (ctypes.c_uint64 * 16)()
+        n = self._lib.bps_client_stripe_bytes(self._handle, server,
+                                              buf, 16)
+        if n < 0:
+            return []
+        return [int(buf[i]) for i in range(n)]
+
+    def kill_stripe(self, server: int, idx: int) -> bool:
+        """TEST HOOK: hard-kill one connection of a server's group
+        (socket shutdown) to exercise single-stripe-death failover —
+        the striper drops the dead conn from its live set and the
+        request completes on the surviving stripes. False on a stale
+        native lib or bad index."""
+        self._check_server(server)
+        if not hasattr(self._lib, "bps_client_kill_stripe"):
+            return False
+        return self._lib.bps_client_kill_stripe(
+            self._handle, server, idx) == 0
 
     # ------------------------------------------------------------ #
     # fleet observability control plane (docs/observability.md):
@@ -834,6 +892,15 @@ class PSClient:
             if self._m_cq_depth is not None:
                 self._m_cq_depth.set(
                     self._lib.bps_client_cq_depth(self._handle))
+            if (self._m_stripe_segs is not None
+                    and hasattr(self._lib,
+                                "bps_client_transport_stats")):
+                tbuf = (ctypes.c_uint64 * 6)()
+                tn = self._lib.bps_client_transport_stats(
+                    self._handle, tbuf, 6)
+                if tn >= 6:
+                    self._m_stripe_segs.set(int(tbuf[4]))
+                    self._m_stripe_bytes.set(int(tbuf[5]))
             for i in range(n):
                 with self._fused_mu:
                     entry = self._fused.pop(int(tickets[i]), None)

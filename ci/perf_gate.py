@@ -80,6 +80,17 @@ DIRECTION_OVERRIDES = {
     "barrier_carried_leaves": "higher",
     "barrier_carry_drained": "higher",
     "barrier_sync_carried_leaves": "lower",
+    # cross-host wire plane (bench.py stripe_ab): the five *_gbps keys
+    # ride the suffix rule; the ratios and engaged-proof counters are
+    # directional — stripe_ab_segs dropping to zero means the striper
+    # silently disengaged, msgs_per_batch falling to 1.0 means the
+    # reply ring stopped coalescing (the syscall win evaporates), and
+    # lossless_gain under 1.0 means decompress-on-the-fabric no longer
+    # beats raw bytes under the same wire cap.
+    "stripe_ab_speedup": "higher",
+    "stripe_ab_segs": "higher",
+    "stripe_ab_msgs_per_batch": "higher",
+    "stripe_ab_lossless_gain": "higher",
 }
 # (suffix, direction) checked in order after the overrides; the first
 # match wins. "_ms" covers every step-wall key; "_pct" the overhead

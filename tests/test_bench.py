@@ -161,6 +161,18 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "codec_lossless_bitwise": True,
                     "codec_tag_mismatch_rejected": True,
                     "codec_adapt_proof": True}, None
+        if name == "stripe_ab":
+            return {"stripe_ab_legacy_gbps": 1.87,
+                    "stripe_ab_ring_gbps": 1.89,
+                    "stripe_ab_striped_gbps": 1.83,
+                    "stripe_ab_speedup": 0.98,
+                    "stripe_ab_segs": 4096,
+                    "stripe_ab_msgs_per_batch": 1.23,
+                    "stripe_ab_conservation": True,
+                    "stripe_ab_throttled_dense_gbps": 0.02,
+                    "stripe_ab_throttled_lossless_gbps": 0.042,
+                    "stripe_ab_lossless_gain": 2.09,
+                    "stripe_ab_throttle_mbps": 20.0}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -172,9 +184,12 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:8] == ["pushpull_throttled", "scaling", "churn_ab",
-                             "scaleup_ab", "codec_adapt_ab", "fold_ab",
-                             "ledger_ab", "health_ab"]
+    assert cpu_calls[:9] == ["pushpull_throttled", "scaling", "churn_ab",
+                             "scaleup_ab", "codec_adapt_ab", "stripe_ab",
+                             "fold_ab", "ledger_ab", "health_ab"]
+    assert out["stripe_ab_conservation"] is True
+    assert out["stripe_ab_lossless_gain"] == 2.09
+    assert out["stripe_ab_segs"] == 4096
     assert out["scaleup_proof"] is True
     assert out["scaleup_joins"] == 1
     assert out["scaleup_newcomer_bytes"] == 16777216
@@ -298,6 +313,10 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
                     "codec_adapt_unthrottled_switches": 0,
                     "codec_adapt_wire_reduction": 0.5,
                     "codec_adapt_proof": True}, None
+        if name == "stripe_ab":
+            return {"stripe_ab_striped_gbps": 1.83,
+                    "stripe_ab_conservation": True,
+                    "stripe_ab_lossless_gain": 2.09}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -313,12 +332,13 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 17 CPU phases + finals
-    assert calls.count("probe") == 18 + n_final
+    # start + one attempt after each of the 18 CPU phases + finals
+    assert calls.count("probe") == 19 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
         "after_churn_ab", "after_scaleup_ab", "after_codec_adapt_ab",
+        "after_stripe_ab",
         "after_fold_ab", "after_ledger_ab", "after_health_ab",
         "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_trace_ab",
@@ -475,11 +495,11 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                if v == "skipped-budget"}
     assert set(skipped) == {"pushpull", "pushpull_2srv",
                             "pushpull_throttled", "churn_ab",
-                            "scaleup_ab", "codec_adapt_ab", "fold_ab",
-                            "ledger_ab", "health_ab", "arena_ab",
-                            "metrics_ab", "trace_ab", "stream_ab",
-                            "barrier_ab", "wire_ab", "shard_ab",
-                            "scaling"}
+                            "scaleup_ab", "codec_adapt_ab", "stripe_ab",
+                            "fold_ab", "ledger_ab", "health_ab",
+                            "arena_ab", "metrics_ab", "trace_ab",
+                            "stream_ab", "barrier_ab", "wire_ab",
+                            "shard_ab", "scaling"}
 
 
 def test_multichip_envelope_bounded():

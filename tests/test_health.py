@@ -240,8 +240,12 @@ def test_key_health_none_when_off(monkeypatch):
 def test_stat_slots_appended():
     names = native_stat_slot_names()
     assert names == list(_STAT_SLOTS)
-    assert names[-4:] == ["health_rounds", "health_nonfinite",
-                          "window_deferred", "window_rejected"]
+    assert names[-9:] == ["tx_batches", "tx_msgs", "rx_batches",
+                          "rx_msgs", "stripe_segs", "stripe_bytes",
+                          "fused_decode_folds", "reg_blocks",
+                          "reg_miss"]
+    assert names[-13:-9] == ["health_rounds", "health_nonfinite",
+                             "window_deferred", "window_rejected"]
 
 
 def _bf16(x: np.ndarray) -> np.ndarray:

@@ -258,10 +258,147 @@ print("SMOKE_OK")
 """
 
 
+# Striped-wire stress (PR 17): forced-TCP clients (BYTEPS_ENABLE_IPC=0)
+# with 4 data stripes and an 8 KB chunk race multi-segment fused
+# PUSHPULL reassembly + the reply tx rings against inline control ops
+# (STATS_PULL / JOIN_PROBE / HEALTH_PULL on the never-queued conn-0
+# lane), a mid-run single-stripe kill (server-side StripeReset + seq
+# gate resync racing live segment writes), and an elastic join/drain.
+_STRIPE_STRESS = r"""
+import threading, time, numpy as np
+import os, sys
+sys.path.insert(0, os.environ["BPS_REPO"])
+from byteps_tpu.config import Config
+from byteps_tpu.core.types import DataType, RequestType, get_command_type
+from byteps_tpu.server import run_server
+from byteps_tpu.server.client import PSClient
+from byteps_tpu.utils.net import wait_port
+
+PORT = int(os.environ["BPS_STRESS_PORT"])
+cfg = Config(num_workers=2, num_servers=1)
+server = threading.Thread(target=run_server, args=(PORT, cfg), daemon=True)
+server.start()
+wait_port(PORT)
+CMD = get_command_type(RequestType.DEFAULT_PUSH_PULL, DataType.FLOAT32)
+addr = [f"127.0.0.1:{PORT}"]
+clients = [PSClient(addr, worker_id=w) for w in range(2)]
+
+N = 48 * 1024  # 192 KB -> ~24 segments per push at the 8 KB chunk
+zero = np.zeros(N, np.float32)
+its = []
+for key in (300, 301, 302):
+    t = threading.Thread(target=clients[1].init_key,
+                         args=(0, key, zero, CMD), daemon=True)
+    t.start()
+    clients[0].init_key(0, key, zero, CMD)
+    its.append(t)
+for t in its:
+    t.join(timeout=30)
+    assert not t.is_alive(), "init barrier wedged"
+
+def fused(c, key, x, out, epoch):
+    done = threading.Event(); err = [None]
+    def cb(n, e):
+        err[0] = e; done.set()
+    c.zpushpull_async(0, key, x, out, CMD, cb, epoch=epoch)
+    assert done.wait(120), "fused pushpull timed out"
+    if err[0]:
+        raise err[0]
+
+def worker(w):
+    c = clients[w]
+    out = np.empty(N, np.float32)
+    for step in range(1, 11):
+        ep = step << 16
+        # sync mode: a round completes only when BOTH workers folded,
+        # so both workers push every key; worker w contributes
+        # (w+1)*step -> aggregate 3*step, asserted bitwise (multi-
+        # segment reassembly from two senders interleaves on the same
+        # engine threads)
+        for key in (300, 301, 302):
+            fused(c, key,
+                  np.full(N, float(w + 1) * step, np.float32), out, ep)
+            assert (out == 3.0 * step).all(), (w, step, key)
+        # control ops race the striped data plane on the conn-0 lane
+        st = c.server_stats(0)
+        assert st is not None and st["stripe_segs"] > 0
+        c.join_probe(0)
+        c.health_pull(0, 300, timeout_s=5)
+        if step == 5 and w == 0:
+            # kill one of our data conns mid-run: the server's conn
+            # loop races StripeReset/gate-resync with worker 1's live
+            # segments; our next rounds stripe over the survivors
+            assert c.kill_stripe(0, 2)
+            time.sleep(0.2)
+
+ths = [threading.Thread(target=worker, args=(w,)) for w in range(2)]
+for t in ths: t.start()
+
+# elastic leg, CONCURRENT with the striped stress: a second server
+# joins at runtime, both clients build a striped conn group to it and
+# run a striped round there, then a drain — the group publish and the
+# JOIN_PROBE/DRAIN_REQ control ops race live stripe reassembly
+PORT2 = int(os.environ["BPS_STRESS_PORT2"])
+server2 = threading.Thread(target=run_server,
+                           args=(PORT2, Config(num_workers=2,
+                                               num_servers=1)),
+                           daemon=True)
+server2.start()
+wait_port(PORT2)
+assert clients[0].add_server(f"127.0.0.1:{PORT2}") == 1
+assert clients[1].add_server(f"127.0.0.1:{PORT2}") == 1
+ez = np.zeros(N, np.float32)
+it = threading.Thread(target=clients[0].init_key,
+                      args=(1, 400, ez, CMD), daemon=True)
+it.start()
+clients[1].init_key(1, 400, ez, CMD)
+it.join(timeout=30)
+assert not it.is_alive()
+
+def efused(c, x, out):
+    done = threading.Event(); err = [None]
+    def cb(n, e):
+        err[0] = e; done.set()
+    c.zpushpull_async(1, 400, x, out, CMD, cb, epoch=(1 << 16))
+    assert done.wait(120)
+    if err[0]:
+        raise err[0]
+
+eo0 = np.empty(N, np.float32)
+eo1 = np.empty(N, np.float32)
+et = threading.Thread(target=efused,
+                      args=(clients[1], np.full(N, 2.0, np.float32), eo1))
+et.start()
+efused(clients[0], np.full(N, 1.0, np.float32), eo0)
+et.join(timeout=120)
+assert (eo0 == 3.0).all() and (eo1 == 3.0).all(), "elastic striped sum"
+ack = clients[0].drain_req(1)
+assert ack and ack["draining"]
+
+for t in ths: t.join()
+
+for c in clients:
+    ts = c.transport_stats()
+    assert ts["stripe_segs"] > 0, "striper never engaged under stress"
+clients[0].close()
+clients[1].close()
+server.join(timeout=20)
+server2.join(timeout=20)
+print("STRIPE_STRESS_OK")
+"""
+
+
+# glibc's dynamic-TLS teardown (_dl_deallocate_tls freeing a joined
+# thread's DTV block) is a known TSAN false positive for thread_local
+# in dlopen'd objects — see ci/tsan.supp for the full story
+_TSAN_SUPP = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ci", "tsan.supp")
+
 _TIERS = {
     # mode -> (runtime lib, options env var, options, error marker)
     "thread": ("libtsan.so", "TSAN_OPTIONS",
-               "halt_on_error=1 exitcode=66",
+               f"halt_on_error=1 exitcode=66 suppressions={_TSAN_SUPP}",
                "WARNING: ThreadSanitizer"),
     # leak detection would see the whole long-lived interpreter (numpy,
     # CPython arenas) — scope ASAN to memory-safety errors
@@ -331,6 +468,62 @@ def test_sanitized_loopback_stress(tmp_path, mode):
     assert marker not in out, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert "STRESS_OK" in out, out[-4000:]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", sorted(_TIERS))
+def test_sanitized_stripe_stress(tmp_path, mode):
+    """The striped cross-host wire plane under TSAN/ASAN: forced-TCP
+    multi-segment fused traffic from two workers (reassembly + seq
+    gates + reply tx rings + fused lossless decode paths all in the
+    loop's shadow) raced against inline control ops, a mid-run
+    single-stripe kill, and an elastic join/drain."""
+    from byteps_tpu.utils.net import free_port
+
+    lib_name, opts_var, opts, marker = _TIERS[mode]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runtime = subprocess.run(
+        ["g++", f"-print-file-name={lib_name}"], capture_output=True,
+        text=True).stdout.strip()
+    if not os.path.isabs(runtime) or not os.path.exists(runtime):
+        pytest.skip(f"{lib_name} not available")
+
+    subprocess.run(
+        [sys.executable, "-c",
+         "import sys, os; sys.path.insert(0, os.environ['BPS_REPO']); "
+         "from byteps_tpu.native.build import build; build(verbose=True)"],
+        env={**os.environ, "BPS_REPO": repo, "BYTEPS_SANITIZE": mode},
+        check=True, capture_output=True, timeout=300)
+
+    script = tmp_path / "stripe_stress.py"
+    script.write_text(_STRIPE_STRESS)
+    port1 = free_port()
+    port2 = free_port()
+    while port2 == port1:
+        port2 = free_port()
+    env = {
+        **os.environ,
+        "BPS_REPO": repo,
+        "BPS_STRESS_PORT": str(port1),
+        "BPS_STRESS_PORT2": str(port2),
+        "BYTEPS_SANITIZE": mode,
+        "LD_PRELOAD": runtime,
+        opts_var: opts,
+        # the striped plane needs the real TCP wire; 4 data stripes at
+        # an 8 KB chunk turn every 192 KB push into ~24 raced segments
+        "BYTEPS_ENABLE_IPC": "0",
+        "BYTEPS_WIRE_STRIPES": "4",
+        "BYTEPS_STRIPE_CHUNK_BYTES": "8192",
+        "BYTEPS_SOCK_BUF_BYTES": "65536",
+        "BYTEPS_HEALTH": "1",
+        "JAX_PLATFORMS": "cpu",
+    }
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=480)
+    out = proc.stdout + proc.stderr
+    assert marker not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "STRIPE_STRESS_OK" in out, out[-4000:]
 
 
 def test_tsan_waiter_pool_smoke(tmp_path):
