@@ -523,6 +523,28 @@ def _loopback_ps(num_servers: int):
             except Exception as e:  # noqa: BLE001 - aux artifact
                 sys.stderr.write(f"[bench] fused-trace dump failed: "
                                  f"{e!r}\n")
+        if trace_dir:
+            try:
+                # per-phase time-series artifact beside the trace: the
+                # same JSONL the SIGTERM hook dumps, renderable
+                # post-hoc with `python -m byteps_tpu.tools.top --file`
+                from byteps_tpu.core.state import get_state
+                ts = get_state().timeseries
+                if ts is not None:
+                    phase = os.environ.get("BENCH_PHASE", "phase")
+                    path = os.path.join(trace_dir,
+                                        f"{phase}.timeseries.jsonl")
+                    n = 1
+                    while os.path.exists(path):
+                        path = os.path.join(
+                            trace_dir, f"{phase}.{n}.timeseries.jsonl")
+                        n += 1
+                    out = ts.dump_jsonl(path=path, reason="bench")
+                    if out:
+                        sys.stderr.write(f"[bench] timeseries: {out}\n")
+            except Exception as e:  # noqa: BLE001 - aux artifact
+                sys.stderr.write(f"[bench] timeseries dump failed: "
+                                 f"{e!r}\n")
         bps.shutdown()
         for t in servers:
             t.join(timeout=20)
@@ -2184,6 +2206,141 @@ def phase_barrier_ab(steps: int = 8, reps: int = 4,
             "barrier_sync_carried_leaves": off["carried"]}
 
 
+def phase_ts_ab(steps: int = 6, reps: int = 4, slow_ms: int = 5) -> dict:
+    """A/B the time-series plane (core/timeseries.py,
+    BYTEPS_TIMESERIES) on the PS train step with BOTH de-aggregated
+    sources engaged in BOTH arms: BYTEPS_WIRE_STRIPES=2 (per-lane
+    stripe series from the STRIPE_PULL/in-process lane probe) and
+    cross-barrier staleness 1 under the slow-server chaos knob (the
+    staleness-lag series actually carries). ONE loopback process, the
+    recorder toggled per interleaved block (plane.enabled — the off
+    arm degrades the observer to its one-attribute early return, the
+    same cost class BYTEPS_TIMESERIES=0 buys): separate-process arms
+    measured 8% run-to-run drift in the SAME arm, an order of
+    magnitude above the recorder's real cost. Best-of step wall per
+    arm; the acceptance bar is overhead <= 2%. Engaged-proof: the on
+    arm must show nonzero per-stripe lane points AND nonzero
+    staleness-lag points — a recorder that pays 0% because it
+    recorded nothing is not a result. Host-CPU only.
+
+    Estimator: block order ALTERNATES per rep (on/off, off/on, ... —
+    process warm-up drift must not systematically favor the
+    second-run arm) and the overhead is PAIRED — each rep differences
+    its two adjacent block medians, the result is the median of those
+    per-rep deltas — so slow machine-load drift cancels pairwise. An
+    unpaired min over a chaos-jittered distribution is an extreme
+    statistic whose own variance (±5% measured) dwarfs the recorder's
+    ~0.1ms real cost."""
+    import gc
+
+    saved = {k: os.environ.get(k) for k in (
+        "BYTEPS_TIMESERIES", "BYTEPS_CROSS_BARRIER", "BYTEPS_STALENESS",
+        "BYTEPS_CHAOS_SLOW_SERVER", "BYTEPS_LOCAL_SHARD_EXPORT",
+        "BYTEPS_WIRE_STRIPES", "BYTEPS_ENABLE_IPC")}
+    # both arms identical except the recorder flag: stripes pinned to 2
+    # data lanes over REAL TCP (the shm loopback upgrade never stripes
+    # — the stripe_ab lesson), staleness 1 under the slow-server regime
+    # (the carry genuinely crosses the step boundary), shard export off
+    # so the tail keys stay whole-leaf (carry-eligible)
+    os.environ["BYTEPS_TIMESERIES"] = "1"
+    os.environ["BYTEPS_ENABLE_IPC"] = "0"
+    os.environ["BYTEPS_WIRE_STRIPES"] = "2"
+    os.environ["BYTEPS_CROSS_BARRIER"] = "1"
+    os.environ["BYTEPS_STALENESS"] = "1"
+    os.environ["BYTEPS_CHAOS_SLOW_SERVER"] = str(slow_ms)
+    os.environ["BYTEPS_LOCAL_SHARD_EXPORT"] = "0"
+    on_blocks: list = []   # one list of walls per on-block
+    off_blocks: list = []
+    stats = {"series_count": 0, "stripe_points": 0,
+             "staleness_points": 0}
+    try:
+        with _loopback_ps(1) as bps:
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+
+            from byteps_tpu.core.state import get_state
+            from byteps_tpu.jax.train import make_ps_train_step
+
+            rng = np.random.RandomState(0)
+            # the barrier_ab layout: whole-leaf weights above both the
+            # fusion threshold AND two stripe chunks (768*768*4 =
+            # 2.25MB >= 2MB), so the back half of the flatten order is
+            # carry-eligible and every w-leaf stripes across the 2
+            # data lanes; biases ride the fused bucket
+            params = {f"w{i}": _cpu_put(
+                rng.randn(768, 768).astype(np.float32))
+                for i in range(6)}
+            params.update({f"b{i}": _cpu_put(
+                rng.randn(768).astype(np.float32)) for i in range(6)})
+            batch = _cpu_put(rng.randn(32, 768).astype(np.float32))
+
+            def loss_fn(p, b):
+                h = b
+                for i in range(6):
+                    h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+                return jnp.mean(h * h)
+
+            tx = optax.adam(1e-3)
+            opt = tx.init(params)
+            step = make_ps_train_step(loss_fn, tx, get_state().mesh)
+            for _ in range(2):  # warmup: init-push, jit, slot allocs
+                params, opt, loss = step(params, opt, batch)
+            float(loss)
+            plane = get_state().timeseries
+            for rep in range(reps):  # INTERLEAVED blocks, same process
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                for enabled in order:
+                    plane.enabled = enabled
+                    walls: list = []
+                    (on_blocks if enabled else off_blocks).append(walls)
+                    for _ in range(steps):
+                        gc.collect()
+                        t0 = time.perf_counter()
+                        params, opt, loss = step(params, opt, batch)
+                        float(loss)
+                        walls.append(time.perf_counter() - t0)
+            plane.enabled = True
+            if hasattr(step, "flush"):  # fold the outstanding carry
+                params, opt = step.flush(params, opt)
+            ts = bps.get_timeseries()
+            series = ts.get("series") or {}
+            stats["series_count"] = len(series)
+            stats["stripe_points"] = sum(
+                len(s["values"]) for n, s in series.items()
+                if n.startswith("stripe/"))
+            stats["staleness_points"] = sum(
+                len(s["values"]) for n, s in series.items()
+                if n in ("step/staleness_lag", "step/carry_drain_ms"))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    def med(vals):
+        s = sorted(vals)
+        n = len(s)
+        return (s[n // 2] if n % 2 else
+                (s[n // 2 - 1] + s[n // 2]) / 2.0)
+
+    # paired per-rep deltas: each rep's on-block median minus its
+    # temporally adjacent off-block median, then the median delta
+    deltas = [med(a) - med(b) for a, b in zip(on_blocks, off_blocks)]
+    off_ms = med([w for blk in off_blocks for w in blk]) * 1e3
+    delta_ms = med(deltas) * 1e3
+    on_ms = off_ms + delta_ms
+    return {"ts_on_step_ms": round(on_ms, 2),
+            "ts_off_step_ms": round(off_ms, 2),
+            "ts_overhead_pct": round(
+                delta_ms / off_ms * 100.0, 2) if off_ms else None,
+            "ts_series_count": stats["series_count"],
+            "ts_stripe_lane_points": stats["stripe_points"],
+            "ts_staleness_points": stats["staleness_points"],
+            "ts_engaged_proof": bool(stats["stripe_points"] > 0
+                                     and stats["staleness_points"] > 0)}
+
+
 def phase_pushpull_tpu(total_bytes: int = 64 << 20, n_tensors: int = 16,
                        steps: int = 3) -> dict:
     """The PS-worker-on-a-TPU-host measurement the CPU-forced phase
@@ -2427,6 +2584,7 @@ _PHASES = {
     "health_ab": phase_health_ab,
     "stream_ab": phase_stream_ab,
     "barrier_ab": phase_barrier_ab,
+    "ts_ab": phase_ts_ab,
     "wire_ab": phase_wire_ab,
     "stripe_ab": phase_stripe_ab,
     "fold_ab": phase_fold_ab,
@@ -2611,6 +2769,13 @@ def main() -> None:
         "barrier_overlap_off_frac": None,
         "barrier_carried_leaves": None,
         "barrier_carry_drained": None,
+        "ts_on_step_ms": None,
+        "ts_off_step_ms": None,
+        "ts_overhead_pct": None,
+        "ts_series_count": None,
+        "ts_stripe_lane_points": None,
+        "ts_staleness_points": None,
+        "ts_engaged_proof": None,
         "wire_fused_step_ms": None,
         "wire_twoop_step_ms": None,
         "wire_request_ratio": None,
@@ -2846,6 +3011,13 @@ def main() -> None:
                             # in-fold health_rounds slot) — in the
                             # runs-first group (new driver key)
                             ("health_ab", 240.0),
+                            # time-series-plane A/B: per-step recorder
+                            # + stripe-lane/staleness series on vs
+                            # BYTEPS_TIMESERIES=0, <=2% overhead bar
+                            # with the engaged-proof (nonzero per-lane
+                            # + staleness points) — in the runs-first
+                            # group (new driver key)
+                            ("ts_ab", 240.0),
                             ("pushpull", 420.0),
                             ("pushpull_2srv", 240.0),
                             # staging-arena A/B: two short loopback

@@ -40,6 +40,7 @@ __all__ = [
     "declare_tensor", "profiler_step",
     "get_pushpull_speed", "get_metrics", "get_step_reports",
     "get_arena_stats", "get_fleet_metrics", "get_ledger",
+    "get_timeseries",
     "dump_flight_record", "dump_fused_trace",
     "Config", "DataType", "QueueType", "Status",
 ]
@@ -186,6 +187,25 @@ def get_ledger() -> dict:
     if state.ledger is None:
         return {"enabled": False}
     return state.ledger.snapshot()
+
+
+def get_timeseries(prefix: str = "", tail: Optional[int] = None) -> dict:
+    """The time-series plane's full rings (core/timeseries.py;
+    docs/observability.md "Time-series plane"): every per-step series
+    — ``step/<field>`` StepReport scalars, ``stripe/s<i>/lane<j>/
+    seg_bytes`` per-connection wire bytes, ``counter/<name>`` deltas
+    and ``gauge/<name>`` values — as ``{name: {"steps": [...],
+    "values": [...]}}``, oldest first, ``BYTEPS_TS_POINTS`` deep.
+    ``prefix`` filters by series name, ``tail`` bounds the points per
+    series. The bounded-tail variant of the same data is the
+    ``timeseries`` section of ``get_metrics()`` — what ``python -m
+    byteps_tpu.tools.top`` renders. ``{"enabled": False}`` before
+    ``init()`` or with BYTEPS_TIMESERIES=0."""
+    state = get_state()
+    if state.timeseries is None or not state.timeseries.enabled:
+        return {"enabled": False}
+    return {"enabled": True,
+            "series": state.timeseries.series(prefix=prefix, tail=tail)}
 
 
 def dump_flight_record(path: Optional[str] = None) -> Optional[str]:

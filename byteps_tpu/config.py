@@ -263,6 +263,15 @@ class Config:
     metrics_port: int = 0                 # BYTEPS_METRICS_PORT (0 = off)
     stall_diag: bool = False              # BYTEPS_STALL_DIAG
     step_report_window: int = 64          # BYTEPS_STEP_REPORTS
+    # --- time-series plane (rebuild addition; core/timeseries.py,
+    # docs/observability.md "Time-series plane"). timeseries=1 arms the
+    # fixed-ring per-step recorder riding the StepProfiler observer
+    # hook (counter deltas / gauges / StepReport + ledger fields +
+    # per-stripe wire and per-leaf staleness series); ts_points bounds
+    # every series ring. bps.get_timeseries() / `byteps_tpu.tools.top`
+    # read it; a JSONL artifact rides SIGTERM/shutdown + bench runs. ---
+    timeseries: bool = True               # BYTEPS_TIMESERIES
+    ts_points: int = 512                  # BYTEPS_TS_POINTS
 
     # --- step efficiency ledger (rebuild addition; core/ledger.py,
     # docs/observability.md "Step efficiency ledger"). On: the train
@@ -371,6 +380,8 @@ class Config:
             metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),
             stall_diag=_env_bool("BYTEPS_STALL_DIAG"),
             step_report_window=_env_int("BYTEPS_STEP_REPORTS", 64),
+            timeseries=_env_bool("BYTEPS_TIMESERIES", True),
+            ts_points=max(16, _env_int("BYTEPS_TS_POINTS", 512)),
             health=_env_bool("BYTEPS_HEALTH"),
             nan_guard=_env_bool("BYTEPS_NAN_GUARD"),
             health_window=_env_int("BYTEPS_HEALTH_WINDOW", 16),

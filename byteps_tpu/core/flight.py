@@ -31,7 +31,8 @@ from typing import Callable, Dict, List, Optional
 __all__ = [
     "FlightRecorder", "configure", "get_recorder", "record",
     "set_server_collector", "dump", "install_signal_handler",
-    "add_term_hook",
+    "add_term_hook", "run_term_hooks",
+    "TERM_ORDER_TIMESERIES", "TERM_ORDER_ARCHIVE",
 ]
 
 
@@ -88,10 +89,19 @@ _recorder = FlightRecorder(enabled=False)
 # core/state.py when a PS client with the control ops is connected;
 # best-effort (a dead fleet dumps worker events alone)
 _server_collector: Optional[Callable[[], list]] = None
-# best-effort extra work on the SIGTERM path (e.g. the perf archive's
-# flush, core/ledger.py), run BEFORE the flight dump; reset per
-# configure() so a re-init never accumulates stale hooks
-_term_hooks: List[Callable[[], None]] = []
+# best-effort extra work on the SIGTERM path (timeseries JSONL dump,
+# perf-archive flush, core/ledger.py), run BEFORE the flight dump in
+# PINNED (order, registration-seq) order — registration order alone
+# raced: whichever module wired up first dumped first, so the flight
+# dump could observe a half-flushed archive or the archive could miss
+# the timeseries tail. Reset per configure() so a re-init never
+# accumulates stale hooks.
+_term_hooks: List[tuple] = []  # [(order, seq, fn)]
+_term_seq = 0
+# canonical orders (timeseries → archive → flight dump last, which is
+# hardcoded in _on_term after every hook)
+TERM_ORDER_TIMESERIES = 10
+TERM_ORDER_ARCHIVE = 50
 _dump_dir = "./flight"
 _prev_sigterm = None
 _handler_installed = False
@@ -109,10 +119,26 @@ def configure(capacity: int = 2048, enabled: bool = True,
     return _recorder
 
 
-def add_term_hook(fn: Callable[[], None]) -> None:
-    """Register extra SIGTERM-path work (perf-archive flush): runs in
-    registration order before the flight dump, each hook best-effort."""
-    _term_hooks.append(fn)
+def add_term_hook(fn: Callable[[], None],
+                  order: int = TERM_ORDER_ARCHIVE) -> None:
+    """Register extra SIGTERM-path work (timeseries dump, perf-archive
+    flush): hooks run sorted by ``(order, registration seq)`` before
+    the flight dump — timeseries (TERM_ORDER_TIMESERIES) → archive
+    (TERM_ORDER_ARCHIVE, default) → flight, regardless of which module
+    registered first. Each hook is best-effort."""
+    global _term_seq
+    _term_hooks.append((int(order), _term_seq, fn))
+    _term_seq += 1
+
+
+def run_term_hooks() -> None:
+    """Run the SIGTERM hook chain in pinned order (shared by _on_term
+    and the combined-dump test path); each hook best-effort."""
+    for _, _, hook in sorted(_term_hooks, key=lambda t: (t[0], t[1])):
+        try:
+            hook()
+        except Exception:  # noqa: BLE001 - hooks must not block dump
+            pass
 
 
 def get_recorder() -> FlightRecorder:
@@ -197,11 +223,7 @@ def install_signal_handler() -> None:
         return
 
     def _on_term(signum, frame):
-        for hook in list(_term_hooks):
-            try:
-                hook()
-            except Exception:  # noqa: BLE001 - hooks must not block dump
-                pass
+        run_term_hooks()
         path = dump(reason="SIGTERM")
         if path:
             import sys

@@ -248,6 +248,14 @@ class MetricsRegistry:
         with self._mu:
             self._sections[name] = collect
 
+    def instruments(self) -> tuple:
+        """(counters, gauges) instrument-table copies — the time-series
+        recorder's lightweight per-step sample surface: unlike
+        ``snapshot()`` it runs NO section collectors (the fleet section
+        does wire RPCs; a per-step sweep must never pay that)."""
+        with self._mu:
+            return dict(self._counters), dict(self._gauges)
+
     # -- exposition ---------------------------------------------------- #
 
     def snapshot(self) -> dict:
@@ -353,6 +361,35 @@ class StepReport:
     nonfinite_leaves: Optional[int] = None
     fidelity_drift: Optional[float] = None
     health_flags: Optional[tuple] = None
+    # Per-stripe lane attribution (time-series plane): the striped wire
+    # plane's per-conn seg-byte counters (STRIPE_PULL / the in-process
+    # mirror) DELTA'd over this step and reduced to data-lane byte
+    # shares per server. lane_bytes carries the raw per-lane deltas —
+    # ((server, lane_id, seg_byte_delta), ...) — for the time-series
+    # recorder; the share scalars feed classify_step's lane-imbalance
+    # verdict (max share > 2× median names the slowest = min-share
+    # lane). All None when striping moved no segment this step (lane
+    # probe absent, BYTEPS_WIRE_STRIPES off, or an idle step) — the
+    # control lanes' tiny traffic never fabricates an imbalance.
+    lane_count: Optional[int] = None
+    lane_share_max: Optional[float] = None
+    lane_share_min: Optional[float] = None
+    lane_share_median: Optional[float] = None
+    lane_max_id: Optional[int] = None
+    lane_min_id: Optional[int] = None
+    lane_server: Optional[int] = None
+    lane_bytes: Optional[tuple] = None
+    # Bounded-staleness carry attribution (PR 16 cross-barrier window,
+    # tapped by jax/train.py): carried_leaves = stale leaves drained
+    # from earlier rounds this step, carry_drain_ms = wall spent
+    # draining that carried tail, staleness_lag = max effective
+    # staleness (in steps) among the drained carries, and window_depth
+    # = leaves still deferred in the window when the step closed. None
+    # when the cross-barrier window is off — never a silent 0.
+    carried_leaves: Optional[int] = None
+    carry_drain_ms: Optional[float] = None
+    staleness_lag: Optional[int] = None
+    window_depth: Optional[int] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -481,6 +518,21 @@ def classify_step(r: StepReport) -> str:
                     + ", ".join(hp))
         else:
             msg += "; health: " + ", ".join(hp)
+    # per-stripe lane-imbalance verdict (time-series plane): when one
+    # data lane's stripe byte share skews past 2× the median, name the
+    # SLOWEST (min-share) lane — under round-robin striping a slow lane
+    # shows up as the one moving the fewest segment bytes. e.g.
+    # "; LANE-IMBALANCE server 0 lane 3 slowest: share 4% (median 23%,
+    # max 51% on lane 1)"
+    if (r.lane_count and r.lane_count >= 2
+            and r.lane_share_max is not None
+            and r.lane_share_median is not None
+            and r.lane_share_max > 2.0 * r.lane_share_median):
+        msg += (f"; LANE-IMBALANCE server {r.lane_server} lane "
+                f"{r.lane_min_id} slowest: share "
+                f"{(r.lane_share_min or 0.0) * 100:.0f}% (median "
+                f"{r.lane_share_median * 100:.0f}%, max "
+                f"{r.lane_share_max * 100:.0f}% on lane {r.lane_max_id})")
     return msg
 
 
@@ -492,7 +544,7 @@ class _StepBuilder:
 
     __slots__ = ("step", "t0", "_mu", "stage_samples", "queue_peak",
                  "credit_stalls", "marks", "pull_wait_s", "fleet_base",
-                 "wire_spans", "wire_base", "monolithic")
+                 "wire_spans", "wire_base", "monolithic", "lane_base")
 
     def __init__(self, step: int):
         self.step = step
@@ -500,6 +552,10 @@ class _StepBuilder:
         # fleet per-stage counter snapshot at step start (train-thread
         # only, set by StepProfiler.begin_step); None = no probe
         self.fleet_base: Optional[Dict[str, int]] = None
+        # per-lane cumulative seg-byte snapshot at step start
+        # ({(server, lane_id): seg_bytes}, train-thread only, set by
+        # StepProfiler.begin_step); None = no lane probe
+        self.lane_base: Optional[Dict[tuple, int]] = None
         # wire byte-counter snapshot at step start (train-thread only,
         # set by StepProfiler.begin_step); None = no ledger
         self.wire_base: Optional[int] = None
@@ -560,7 +616,7 @@ class StepProfiler:
 
     def __init__(self, window: int = 64, enabled: bool = True,
                  stall_diag: bool = False, tracer=None,
-                 fleet_probe=None, ledger=None):
+                 fleet_probe=None, ledger=None, lane_probe=None):
         import collections
         self.enabled = enabled
         self.stall_diag = stall_diag
@@ -578,10 +634,17 @@ class StepProfiler:
         # the StepReport's server-attribution fields. Wired by
         # core/state.py; None = no attribution (fields stay None).
         self._fleet_probe = fleet_probe
+        # () -> {(server, lane_id): cumulative seg_bytes} over the
+        # reachable fleet's data lanes (per_conn_stripe_stats mirror or
+        # the STRIPE_PULL wire op), or None. Same one-sweep-per-step
+        # discipline as the fleet probe; deltas become the StepReport's
+        # lane-share fields. Wired by core/state.py.
+        self._lane_probe = lane_probe
         # end_step's probe doubles as the NEXT step's baseline (steps
         # are contiguous), so a remote fleet pays ONE probe sweep per
         # step, not two; train-thread only, like the builder marks
         self._probe_cache: Optional[dict] = None
+        self._lane_cache: Optional[dict] = None  # train-thread only
         self._mu = threading.Lock()
         self._reports = collections.deque(maxlen=max(1, window))  # guarded-by: _mu
         self._current: Optional[_StepBuilder] = None  # guarded-by: _mu
@@ -601,6 +664,14 @@ class StepProfiler:
         except Exception:  # noqa: BLE001 - attribution is best-effort
             return None
 
+    def _probe_lanes(self) -> Optional[dict]:
+        if self._lane_probe is None:
+            return None
+        try:
+            return self._lane_probe()
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            return None
+
     def begin_step(self) -> Optional[_StepBuilder]:
         if not self.enabled:
             return None
@@ -614,6 +685,10 @@ class StepProfiler:
         self._probe_cache = None
         if cur.fleet_base is None:
             cur.fleet_base = self._probe_fleet()
+        cur.lane_base = self._lane_cache
+        self._lane_cache = None
+        if cur.lane_base is None:
+            cur.lane_base = self._probe_lanes()
         if self._ledger is not None:
             try:
                 cur.wire_base = self._ledger.wire_bytes_total()
@@ -628,9 +703,54 @@ class StepProfiler:
         # put it on every stage completion for no correctness gain
         return self._current  # bps-lint: disable=guarded-by
 
+    @staticmethod
+    def _lane_fields(base: Optional[dict],
+                     end: Optional[dict]) -> dict:
+        """Delta the per-lane cumulative seg-byte snapshots into the
+        StepReport's lane-share fields. Shares are computed WITHIN each
+        server's active data lanes (a lane is active when it moved
+        segment bytes this step — the control lanes' zero-seg traffic
+        never participates); the server with the worst max/median skew
+        is the one reported. ``lane_share_median`` is the lower median,
+        so a 2-lane stripe pair can still trip the 2× bar."""
+        if base is None or end is None:
+            return {}
+        per_srv: Dict[int, List[tuple]] = {}
+        lane_bytes = []
+        for (srv, lid), v in end.items():
+            d = int(v) - int(base.get((srv, lid), 0))
+            if d > 0:
+                per_srv.setdefault(srv, []).append((lid, d))
+                lane_bytes.append((srv, lid, d))
+        best = None
+        for srv, lanes in per_srv.items():
+            if len(lanes) < 2:
+                continue
+            total = sum(d for _, d in lanes)
+            shares = sorted((d / total, lid) for lid, d in lanes)
+            med = shares[(len(shares) - 1) // 2][0]
+            ratio = shares[-1][0] / med if med > 0 else float("inf")
+            if best is None or ratio > best[0]:
+                best = (ratio, srv, shares, med)
+        if best is None:
+            return {"lane_bytes": tuple(lane_bytes)} if lane_bytes \
+                else {}
+        _, srv, shares, med = best
+        return {
+            "lane_count": len(shares),
+            "lane_share_max": shares[-1][0],
+            "lane_share_min": shares[0][0],
+            "lane_share_median": med,
+            "lane_max_id": shares[-1][1],
+            "lane_min_id": shares[0][1],
+            "lane_server": srv,
+            "lane_bytes": tuple(lane_bytes),
+        }
+
     def end_step(self, b: Optional[_StepBuilder], ttfp_ms=None,
                  streamed: int = 0, fallback: int = 0,
-                 health: Optional[dict] = None) -> Optional[StepReport]:
+                 health: Optional[dict] = None,
+                 xb: Optional[dict] = None) -> Optional[StepReport]:
         if b is None:
             return None
         wall = (time.perf_counter() - b.t0) * 1e3
@@ -650,6 +770,14 @@ class StepProfiler:
                        for k in ("recv_ns", "queue_ns", "fold_ns",
                                  "reply_ns")}
         pull_total = sum(samples.get("PULL", [])) if srv else None
+        # per-stripe lane attribution: delta the per-lane seg-byte
+        # snapshots (one sweep per step, like the fleet probe: this
+        # reading is the next begin_step's baseline)
+        lane: dict = {}
+        if b.lane_base is not None:
+            lane_end = self._probe_lanes()
+            self._lane_cache = lane_end
+            lane = self._lane_fields(b.lane_base, lane_end)
         # step efficiency ledger: price the step from the registered
         # cost model + this step's wire spans and wire byte delta
         eff: dict = {}
@@ -698,6 +826,18 @@ class StepProfiler:
             update_ratio_p95=(health or {}).get("update_ratio_p95"),
             nonfinite_leaves=(health or {}).get("nonfinite_leaves"),
             fidelity_drift=(health or {}).get("fidelity_drift"),
+            lane_count=lane.get("lane_count"),
+            lane_share_max=lane.get("lane_share_max"),
+            lane_share_min=lane.get("lane_share_min"),
+            lane_share_median=lane.get("lane_share_median"),
+            lane_max_id=lane.get("lane_max_id"),
+            lane_min_id=lane.get("lane_min_id"),
+            lane_server=lane.get("lane_server"),
+            lane_bytes=lane.get("lane_bytes"),
+            carried_leaves=(xb or {}).get("carried_leaves"),
+            carry_drain_ms=(xb or {}).get("carry_drain_ms"),
+            staleness_lag=(xb or {}).get("staleness_lag"),
+            window_depth=(xb or {}).get("window_depth"),
         )
         with self._mu:
             self._reports.append(r)

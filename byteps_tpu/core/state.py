@@ -164,6 +164,7 @@ class GlobalState:
         self.autoscaler = None       # autoscaler plane (autoscaler.py)
         self.ledger = None           # step efficiency ledger (ledger.py)
         self.health = None           # training-health plane (health.py)
+        self.timeseries = None       # time-series plane (timeseries.py)
         # server spawn hook for the autoscaler's acting "add" path:
         # fn(index) -> "host:port" of a freshly-started server (or None
         # to decline); survives re-init (operator wiring, not lifecycle
@@ -227,9 +228,11 @@ class GlobalState:
             # .md "fleet"); bps.get_fleet_metrics() and the Prometheus
             # endpoint both read this one section
             self.metrics.section("fleet", self._fleet_section)
-            # fresh breakers per init (per-step probe + snapshot sweep)
+            # fresh breakers per init (per-step probe + snapshot sweep
+            # + the per-lane stripe probe)
             self._fleet_probe_tripped = False
             self._fleet_section_tripped = False
+            self._lane_probe_tripped = False
             # crash flight recorder (core/flight.py): bounded event
             # ring armed per lifecycle; events flow in from the fault
             # paths module-level (no plumbing), the dump merges every
@@ -250,8 +253,26 @@ class GlobalState:
             register_ledger_metrics(self.metrics)
             self.ledger = EfficiencyLedger(self.config, self.metrics)
             self.metrics.section("ledger", self.ledger.snapshot)
-            if self.config.flight_recorder or self.ledger.archive_enabled:
+            # time-series plane (core/timeseries.py): bounded per-step
+            # history rings riding the profiler observer chain; its
+            # snapshot is the `timeseries` section (what byteps-top and
+            # the HTTP endpoint render), its JSONL dump rides the
+            # SIGTERM hook chain pinned FIRST (timeseries → archive →
+            # flight dump)
+            from .timeseries import TimeSeriesPlane
+            self.timeseries = TimeSeriesPlane(
+                points=self.config.ts_points,
+                enabled=self.config.timeseries and self.config.metrics_on,
+                registry=self.metrics,
+                dump_dir=self.config.flight_dir)
+            self.metrics.section("timeseries", self.timeseries.snapshot)
+            if (self.config.flight_recorder or self.ledger.archive_enabled
+                    or self.timeseries.enabled):
                 flight_mod.install_signal_handler()
+            if self.timeseries.enabled:
+                flight_mod.add_term_hook(
+                    self.timeseries.term_dump,
+                    order=flight_mod.TERM_ORDER_TIMESERIES)
             if self.ledger.archive_enabled:
                 # the archive flushes on SIGTERM alongside the flight
                 # dump (one handler, hooks run first; term_flush uses a
@@ -334,6 +355,7 @@ class GlobalState:
                 stall_diag=self.config.stall_diag,
                 tracer=self.tracer,
                 fleet_probe=self._fleet_stage_probe,
+                lane_probe=self._lane_probe,
                 ledger=self.ledger)
             self.metrics.section("steps", self.profiler.snapshot)
             if self.health is not None and self.health.enabled:
@@ -347,6 +369,12 @@ class GlobalState:
                 # finished step, on the train thread like the
                 # autoscaler's sensor tap
                 self.profiler.add_observer(self.ledger.on_step)
+            if self.timeseries is not None and self.timeseries.enabled:
+                # LAST of the init-time observer trio: the recorder
+                # samples the report AFTER the health plane stamped
+                # health_flags and the ledger priced it, so archived
+                # fields land in the series final
+                self.profiler.add_observer(self.timeseries.observe)
             if self.tracer is not None:
                 # fused-timeline hook: Tracer.dump() drains every
                 # server's wire-sampled span ring + clock offset
@@ -467,6 +495,14 @@ class GlobalState:
                     self.ledger.close()  # flush the perf archive tail
                 except Exception:  # noqa: BLE001 - best-effort teardown
                     pass
+            if self.timeseries is not None:
+                try:
+                    # the shutdown half of the SIGTERM artifact (empty
+                    # planes write nothing)
+                    self.timeseries.dump_jsonl(reason="shutdown",
+                                               lock_timeout=1.0)
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
             # free the pinned staging bytes (slots are rebuilt lazily
             # by the next init's first submissions)
             self.arena.reset()
@@ -579,6 +615,40 @@ class GlobalState:
                 "server attribution for this lifecycle (fleet metrics "
                 "snapshots are unaffected)", elapsed * 1e3)
         return tot if seen else None
+
+    def _lane_probe(self):
+        """Per-step stripe-lane probe (StepProfiler): cumulative
+        seg bytes per data connection, ``{(server, lane_id): bytes}``,
+        or None when no server is reachable. Same two-tier shape as
+        the stage probe: the in-process mirror is a ctypes sweep
+        (cheap every step), the STRIPE_PULL wire op runs on the train
+        thread only until its own 250ms one-way breaker trips."""
+        from ..server import per_conn_stripe_stats
+        local = per_conn_stripe_stats()
+        if any(local):
+            return {(i, rec["conn"]): rec["seg_bytes"]
+                    for i, recs in enumerate(local) for rec in recs}
+        if getattr(self, "_lane_probe_tripped", False):
+            return None
+        client = self._fleet_client()
+        if client is None:
+            return None
+        t0 = time.monotonic()
+        out = {}
+        for s in range(self.config.num_servers):
+            try:
+                recs = client.stripe_stats(s, timeout_s=1)
+            except Exception:  # noqa: BLE001 - dead server: skip
+                continue
+            for rec in recs:
+                out[(s, rec["conn"])] = rec["seg_bytes"]
+        elapsed = time.monotonic() - t0
+        if elapsed > 0.25:
+            self._lane_probe_tripped = True
+            log.warning(
+                "stripe lane probe took %.0fms — disabling per-lane "
+                "wire attribution for this lifecycle", elapsed * 1e3)
+        return out or None
 
     def _sweep_fleet(self, drain_name: str, payload_key: str,
                      probes: int) -> list:

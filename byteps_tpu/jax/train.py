@@ -692,7 +692,7 @@ def make_ps_train_step(
     # that leaf's NEXT apply (or folded in by ``flush``); "par" is the
     # step parity that keeps two live rounds of one key on disjoint
     # arena slots. All touched from the step thread only.
-    xb_state: dict = {"carry": None, "over": {}, "par": 0}
+    xb_state: dict = {"carry": None, "over": {}, "par": 0, "seq": 0}
 
     def local_grads(params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -1337,6 +1337,10 @@ def make_ps_train_step(
             if state.scheduler is not None else 0
         xb_on = bool(xb_window > 0 and sa is not None and reg is None)
         xb_over = xb_state["over"]
+        # step ordinal for staleness-lag attribution: the carry records
+        # the seq it was created at, the drain reports how many step
+        # boundaries the tail actually crossed (1 at steady state)
+        xb_state["seq"] += 1
         xb_carry_set: set = set()
         if xb_on:
             xb_state["par"] ^= 1
@@ -1521,7 +1525,10 @@ def make_ps_train_step(
             # the per-round detectors see divergence within one step.
             prev_carry = xb_state["carry"]
             xb_state["carry"] = None
+            xb_drained = 0
+            xb_drain_ms = xb_lag = None
             if prev_carry is not None:
+                t_xb = _time.perf_counter()
                 try:
                     for (s, fin, _nt, bp, bpp, bsh) in \
                             prev_carry["entries"]:
@@ -1562,6 +1569,10 @@ def make_ps_train_step(
                 _release_pool().submit(_xb_release)
                 metrics.counter("barrier/carry_drained").inc(
                     len(prev_carry["entries"]))
+                xb_drained = len(prev_carry["entries"])
+                xb_drain_ms = (_time.perf_counter() - t_xb) * 1e3
+                xb_lag = xb_state["seq"] - prev_carry.get(
+                    "step", xb_state["seq"] - 1)
             # param shapes, not gradient-output shapes: a shard-planned
             # leaf's program output is the flat padded sharded layout,
             # but everything imported/applied below is leaf-shaped
@@ -1729,7 +1740,8 @@ def make_ps_train_step(
                 leases[:] = [lz for lz in leases if lz.key not in ckeys]
                 xb_state["carry"] = {"entries": centries,
                                      "leases": cleases,
-                                     "imported": [], "sa": sa}
+                                     "imported": [], "sa": sa,
+                                     "step": xb_state["seq"]}
                 metrics.counter("barrier/carried_leaves").inc(
                     len(centries))
             if sa is None:
@@ -1836,12 +1848,25 @@ def make_ps_train_step(
                 health_fields = hplane.finalize(hc, names, state)
             except Exception:  # noqa: BLE001 - diagnostics never kill
                 health_fields = None          # the step
+        # cross-barrier staleness fields for the StepReport and its
+        # time-series: drained-tail size/wall, effective staleness and
+        # the depth still deferred into the NEXT step (None when the
+        # cross-barrier plane is off — the series simply skip)
+        xb_fields = None
+        if xb_on:
+            _c = xb_state["carry"]
+            xb_fields = {
+                "carried_leaves": xb_drained,
+                "carry_drain_ms": xb_drain_ms,
+                "staleness_lag": xb_lag,
+                "window_depth": len(_c["entries"]) if _c else 0,
+            }
         state.profiler.end_step(
             prof,
             ttfp_ms=first_push[0] * 1e3 if first_push[0] is not None
             else None,
             streamed=n_streamed, fallback=len(names) - n_streamed,
-            health=health_fields)
+            health=health_fields, xb=xb_fields)
         if hplane is not None:
             hplane.raise_if_fatal()
         return params, opt_state, loss

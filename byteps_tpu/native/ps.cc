@@ -328,6 +328,12 @@ enum Op : uint8_t {
   // reply: one packed HealthRec for the key's last PUBLISHED round, or
   // an error ACK when the key is unknown / the health pass is off.
   HEALTH_PULL = 18,
+  // Time-series plane (docs/observability.md "Time-series plane"):
+  // per-conn / per-data-lane wire counters — the PR 17 stripe plane
+  // DE-aggregated so a dead-slow lane stops hiding inside fleet
+  // totals. Header-only request; reply: packed StripeRec[] (snapshot,
+  // kept), one record per live connection, kCtrlStripeMax cap.
+  STRIPE_PULL = 19,
 };
 
 enum ReqType : uint32_t {
@@ -2417,6 +2423,21 @@ struct Conn {
   Throttle* thr = nullptr;  // server's bucket; null on the client side
   StageStats* stats = nullptr;  // server's counters; null client side
 
+  // ---- per-lane wire counters (time-series plane) ------------------
+  // The stripe plane's fleet totals (tx_batches / stripe_bytes) can't
+  // show a dead-slow data lane; these de-aggregate them per connection.
+  // lane_id is assigned monotonically at accept and is stable for the
+  // conn's life; counters are relaxed atomics (tx side may be touched
+  // by several engine threads through send_msg). Snapshot-read by
+  // StripeSlots() answering STRIPE_PULL / bps_server_stripe_stats.
+  uint64_t lane_id = 0;
+  std::atomic<uint64_t> lane_tx_bytes{0};
+  std::atomic<uint64_t> lane_tx_msgs{0};
+  std::atomic<uint64_t> lane_rx_bytes{0};   // conn-loop thread only
+  std::atomic<uint64_t> lane_rx_msgs{0};    // conn-loop thread only
+  std::atomic<uint64_t> lane_seg_count{0};  // stripe segments reassembled
+  std::atomic<uint64_t> lane_seg_bytes{0};
+
   // ---- tx submission ring (BYTEPS_WIRE_RING) -----------------------
   // Replies staged under write_mu, flushed kTxBatch at a time through
   // one gathered sendmsg each (send_iovs). Engine threads stage with
@@ -2457,11 +2478,13 @@ struct Conn {
       size_t take = std::min(tx_q.size(), kTxBatch);
       iovec iov[2 * kTxBatch];
       int n = 0;
+      uint64_t batch_bytes = 0;
       for (size_t i = 0; i < take; ++i) {
         TxEntry& e = tx_q[i];
         iov[n].iov_base = (void*)&e.h;
         iov[n].iov_len = sizeof(MsgHeader);
         n++;
+        batch_bytes += sizeof(MsgHeader) + e.h.len;
         if (e.pin && e.h.len) {
           iov[n].iov_base = (void*)e.pin->data();
           iov[n].iov_len = e.h.len;
@@ -2477,6 +2500,8 @@ struct Conn {
         stats->tx_batches.fetch_add(1, std::memory_order_relaxed);
         stats->tx_msgs.fetch_add(take, std::memory_order_relaxed);
       }
+      lane_tx_bytes.fetch_add(batch_bytes, std::memory_order_relaxed);
+      lane_tx_msgs.fetch_add(take, std::memory_order_relaxed);
       tx_q.erase(tx_q.begin(), tx_q.begin() + (long)take);
     }
     return true;
@@ -2489,7 +2514,11 @@ struct Conn {
     std::lock_guard<Mu> lk(write_mu);
     if (ipc) return ipc->send_msg(h, payload);
     if (!tx_q.empty() && !flush_locked()) return false;
-    return send_msg_iov(fd, h, payload);
+    if (!send_msg_iov(fd, h, payload)) return false;
+    lane_tx_bytes.fetch_add(sizeof(MsgHeader) + h.len,
+                            std::memory_order_relaxed);
+    lane_tx_msgs.fetch_add(1, std::memory_order_relaxed);
+    return true;
   }
   bool recv_bytes(void* p, size_t n) {  // conn-loop thread only
     if (ipc) return ipc->recv(p, n);
@@ -2653,6 +2682,32 @@ static_assert(sizeof(HealthRec) == 48, "health record layout");
 static const char* const kHealthRecFields[] = {
     "key", "round", "sumsq_bits", "absmax_bits", "nonfinite", "elems"};
 
+// One connection's (data lane's) cumulative wire counters — the
+// STRIPE_PULL reply, one record per live conn. sender is ~0 until the
+// lane's first data message identifies its worker. Counters are
+// CUMULATIVE since accept; readers (the time-series plane's per-step
+// sweep) difference them. Layout is wire contract, mirrored by
+// server/__init__.py STRIPE_REC_FMT / _STRIPE_REC_FIELDS (byteps-lint
+// slot-layout diffs kStripeRecFields against the mirror).
+#pragma pack(push, 1)
+struct StripeRec {
+  uint64_t conn;      // lane id (monotone per accept, stable for life)
+  uint64_t sender;    // worker id; ~0 until first message
+  uint64_t tx_bytes;  // header+payload bytes sent on this lane
+  uint64_t tx_msgs;
+  uint64_t rx_bytes;  // header+payload bytes received on this lane
+  uint64_t rx_msgs;
+  uint64_t seg_count;  // stripe segments reassembled from this lane
+  uint64_t seg_bytes;
+};
+#pragma pack(pop)
+static_assert(sizeof(StripeRec) == 64, "stripe record layout");
+static const char* const kStripeRecFields[] = {
+    "conn", "sender", "tx_bytes", "tx_msgs", "rx_bytes", "rx_msgs",
+    "seg_count", "seg_bytes"};
+static constexpr size_t kNumStripeRecFields =
+    sizeof(kStripeRecFields) / sizeof(kStripeRecFields[0]);
+
 // bps_server_stats / STATS_PULL slot layout — the append-only contract
 // with server/__init__.py _STAT_SLOTS, enforced until PR 10 only by a
 // comment and now machine-checked: byteps-lint's slot-layout check
@@ -2700,6 +2755,9 @@ enum FlightKind : uint8_t {
 enum CtrlLimits : uint32_t {
   kCtrlDrainBatch = 1024,
   kCtrlFlightDrainMax = 4096,
+  // STRIPE_PULL reply cap: one StripeRec per live conn; a fleet's
+  // worker*stripe fan-in stays far under this.
+  kCtrlStripeMax = 64,
 };
 
 // Fixed-capacity drop-oldest ring, preallocated at construction — the
@@ -3135,6 +3193,37 @@ class Server {
                : 0;
   }
 
+  // THE one per-lane record vector, shared by bps_server_stripe_stats
+  // (in-process mirror) and the STRIPE_PULL wire reply. One StripeRec
+  // per live conn, kStripeRecFields order; expired registry entries
+  // (conn thread and every parked pull gone) are pruned in passing.
+  int StripeSlots(StripeRec* out, int max_n) {
+    std::lock_guard<Mu> lk(conns_mu_);
+    int n = 0;
+    for (size_t i = 0; i < all_conns_.size();) {
+      std::shared_ptr<Conn> c = all_conns_[i].lock();
+      if (!c) {
+        all_conns_[i] = std::move(all_conns_.back());
+        all_conns_.pop_back();
+        continue;
+      }
+      if (!c->dead.load(std::memory_order_relaxed) && n < max_n) {
+        StripeRec& r = out[n++];
+        int snd = c->sender.load(std::memory_order_relaxed);
+        r.conn = c->lane_id;
+        r.sender = snd < 0 ? ~0ull : (uint64_t)snd;
+        r.tx_bytes = c->lane_tx_bytes.load(std::memory_order_relaxed);
+        r.tx_msgs = c->lane_tx_msgs.load(std::memory_order_relaxed);
+        r.rx_bytes = c->lane_rx_bytes.load(std::memory_order_relaxed);
+        r.rx_msgs = c->lane_rx_msgs.load(std::memory_order_relaxed);
+        r.seg_count = c->lane_seg_count.load(std::memory_order_relaxed);
+        r.seg_bytes = c->lane_seg_bytes.load(std::memory_order_relaxed);
+      }
+      ++i;
+    }
+    return n;
+  }
+
   // In-process mirror of the HEALTH_PULL reply (bps_server_key_health):
   // fills {round, sumsq_bits, absmax_bits, nonfinite, elems}. Returns
   // false when the key is unknown or the health pass is off. The map
@@ -3187,6 +3276,13 @@ class Server {
       auto conn = std::make_shared<Conn>();
       conn->fd = fd;
       conn->thr = &throttle_;
+      conn->lane_id = lane_seq_.fetch_add(1, std::memory_order_relaxed);
+      {
+        // per-lane registry (STRIPE_PULL): weak refs — lifetime stays
+        // with the conn thread / parked pulls; StripeSlots prunes
+        std::lock_guard<Mu> lk(conns_mu_);
+        all_conns_.emplace_back(conn);
+      }
       // Conn threads self-reap: detached, with a shared tracker Join()
       // waits on. A worker that suspends (elastic close without SHUTDOWN,
       // client.py close(shutdown_servers=False)) ends its conn thread while
@@ -3315,6 +3411,11 @@ class Server {
         std::fprintf(stderr, "[bps-server] bad magic %08x\n", h.magic);
         break;
       }
+      // per-lane rx accounting (time-series plane): conn-loop thread
+      // only, so plain relaxed adds; covers segment messages too
+      conn->lane_rx_msgs.fetch_add(1, std::memory_order_relaxed);
+      conn->lane_rx_bytes.fetch_add(sizeof(MsgHeader) + h.len,
+                                    std::memory_order_relaxed);
       if (conn->sender.load() < 0) {
         conn->sender.store((int)h.sender);
         std::lock_guard<Mu> lk(worker_conns_mu_);
@@ -3419,7 +3520,8 @@ class Server {
       }
       if (h.op == STATS_PULL || h.op == TRACE_DRAIN ||
           h.op == FLIGHT_DRAIN || h.op == JOIN_PROBE ||
-          h.op == DRAIN_REQ || h.op == HEALTH_PULL) {
+          h.op == DRAIN_REQ || h.op == HEALTH_PULL ||
+          h.op == STRIPE_PULL) {
         HandleControlPull(conn, h.rid, h.op, h.sender, h.key);
         continue;
       }
@@ -3536,6 +3638,8 @@ class Server {
     stats_.recv_count.fetch_add(1, std::memory_order_relaxed);
     stats_.stripe_segs.fetch_add(1, std::memory_order_relaxed);
     stats_.stripe_bytes.fetch_add(chunk, std::memory_order_relaxed);
+    conn->lane_seg_count.fetch_add(1, std::memory_order_relaxed);
+    conn->lane_seg_bytes.fetch_add(chunk, std::memory_order_relaxed);
     bool complete = false;
     {
       std::lock_guard<Mu> lk(stripe_mu_);
@@ -3864,6 +3968,17 @@ class Server {
       MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
                                 (uint32_t)(n * sizeof(uint64_t)));
       conn->send_msg(r, v);
+      return;
+    }
+    if (op == STRIPE_PULL) {
+      // per-lane wire counters (time-series plane): one StripeRec per
+      // live conn, snapshot — cumulative counters the worker's sweep
+      // differences into per-stripe series
+      std::vector<StripeRec> recs(kCtrlStripeMax);
+      int n = StripeSlots(recs.data(), (int)kCtrlStripeMax);
+      MsgHeader r = ReplyHeader(PULL_REPLY, 0, 0, rid, 0, 0,
+                                (uint32_t)(n * sizeof(StripeRec)));
+      conn->send_msg(r, recs.data());
       return;
     }
     if (op == TRACE_DRAIN) {
@@ -5450,6 +5565,14 @@ class Server {
   std::shared_ptr<ConnTracker> conn_tracker_ =
       std::make_shared<ConnTracker>();
 
+  // per-lane registry (time-series plane): weak refs so conn lifetime
+  // stays with the conn thread / parked pulls; StripeSlots prunes
+  // expired entries in passing. lane_seq_ hands each accepted conn a
+  // stable monotone lane id.
+  Mu conns_mu_;
+  std::vector<std::weak_ptr<Conn>> all_conns_;  // guarded-by: conns_mu_
+  std::atomic<uint64_t> lane_seq_{0};
+
   Mu barrier_mu_;
   std::vector<ParkedPull> barrier_waiters_;
 
@@ -6765,6 +6888,26 @@ int bps_server_stat_count() { return (int)bps::kNumStatSlots; }
 // the loopback test surface for the in-fold statistics pass.
 int bps_server_key_health(void* s, uint64_t key, uint64_t* out5) {
   return ((bps::Server*)s)->KeyHealth(key, out5) ? 0 : -1;
+}
+
+// In-process mirror of the STRIPE_PULL reply: per-conn / per-data-lane
+// wire counters (time-series plane). `out` receives up to max_recs
+// packed StripeRec records (8 u64 each, kStripeRecFields order);
+// returns records filled. Same StripeSlots vector as the wire reply,
+// so the two surfaces cannot drift.
+int bps_server_stripe_stats(void* s, uint64_t* out, int max_recs) {
+  return ((bps::Server*)s)->StripeSlots((bps::StripeRec*)out, max_recs);
+}
+
+// Runtime view of the stripe-record manifest (like
+// bps_server_stat_name): field name of column i, and the field count.
+const char* bps_server_stripe_field(int i) {
+  if (i < 0 || (size_t)i >= bps::kNumStripeRecFields) return nullptr;
+  return bps::kStripeRecFields[i];
+}
+
+int bps_server_stripe_field_count() {
+  return (int)bps::kNumStripeRecFields;
 }
 
 // Cumulative queued payload bytes per engine thread — the balance

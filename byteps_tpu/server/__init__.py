@@ -90,6 +90,31 @@ _HEALTH_REC_FIELDS = (
 )
 assert struct.calcsize(HEALTH_REC_FMT) == HEALTH_REC_BYTES
 
+# Per-conn / per-data-lane wire-counter record (native/ps.cc StripeRec,
+# answered over the STRIPE_PULL control op and mirrored in-process by
+# ``bps_server_stripe_stats``) — the time-series plane's de-aggregated
+# stripe source: one record per live connection, counters CUMULATIVE
+# since accept (readers difference them into per-stripe series).
+# sender is ~0 (2**64-1) until the lane's first message identifies its
+# worker. Same lint discipline as the trace record.
+STRIPE_REC_FMT = "<QQQQQQQQ"
+STRIPE_REC_BYTES = 64
+_STRIPE_REC_FIELDS = (
+    "conn", "sender", "tx_bytes", "tx_msgs", "rx_bytes", "rx_msgs",
+    "seg_count", "seg_bytes",
+)
+assert struct.calcsize(STRIPE_REC_FMT) == STRIPE_REC_BYTES
+
+
+def parse_stripe_recs(raw: bytes) -> List[Dict[str, int]]:
+    """Packed StripeRec[] -> list of per-lane dicts — THE one parser
+    for the STRIPE_PULL wire reply and the in-process mirror. Returns
+    [] on a length mismatch (oversized/truncated reply)."""
+    if not raw or len(raw) % STRIPE_REC_BYTES:
+        return []
+    return [dict(zip(_STRIPE_REC_FIELDS, vals))
+            for vals in struct.iter_unpack(STRIPE_REC_FMT, raw)]
+
 
 def parse_health_rec(raw: bytes) -> Optional[Dict[str, float]]:
     """One packed HealthRec -> dict with the doubles reassembled
@@ -145,6 +170,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bps_server_key_health.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64)]
+    if hasattr(lib, "bps_server_stripe_stats"):
+        # per-lane wire counters, in-process mirror (guarded: stale .so)
+        lib.bps_server_stripe_stats.restype = ctypes.c_int
+        lib.bps_server_stripe_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int]
+        lib.bps_server_stripe_field.restype = ctypes.c_char_p
+        lib.bps_server_stripe_field.argtypes = [ctypes.c_int]
+        lib.bps_server_stripe_field_count.restype = ctypes.c_int
+        lib.bps_server_stripe_field_count.argtypes = []
     return lib
 
 
@@ -157,6 +192,39 @@ def native_stat_slot_names() -> List[str]:
         return []
     return [lib.bps_server_stat_name(i).decode()
             for i in range(lib.bps_server_stat_count())]
+
+
+def native_stripe_field_names() -> List[str]:
+    """The LOADED .so's stripe-record field manifest (empty on a stale
+    .so) — the runtime half of the ``_STRIPE_REC_FIELDS`` lint check."""
+    lib = _bind(ctypes.CDLL(build()))
+    if not hasattr(lib, "bps_server_stripe_field"):
+        return []
+    return [lib.bps_server_stripe_field(i).decode()
+            for i in range(lib.bps_server_stripe_field_count())]
+
+
+def per_conn_stripe_stats() -> List[List[Dict[str, int]]]:
+    """Per-conn / per-data-lane wire counters from the live IN-PROCESS
+    servers: one list of lane record dicts (``_STRIPE_REC_FIELDS``
+    keys) per server, registration order — the local half of the
+    time-series plane's stripe source (remote fleets answer the same
+    records over STRIPE_PULL, ``PSClient.stripe_stats``)."""
+    out: List[List[Dict[str, int]]] = []
+    n_fields = len(_STRIPE_REC_FIELDS)
+    max_recs = 64  # native kCtrlStripeMax
+    buf = (ctypes.c_uint64 * (max_recs * n_fields))()
+    with _live_mu:  # see stage_stats: excludes a concurrent destroy
+        for lib, ptr in _live:
+            if not hasattr(lib, "bps_server_stripe_stats"):
+                continue
+            n = lib.bps_server_stripe_stats(ptr, buf, max_recs)
+            out.append([
+                dict(zip(_STRIPE_REC_FIELDS,
+                         [int(buf[r * n_fields + f])
+                          for f in range(n_fields)]))
+                for r in range(n)])
+    return out
 
 
 def parse_stat_slots(raw) -> Dict[str, int]:

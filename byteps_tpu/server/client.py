@@ -61,6 +61,7 @@ WIRE_CTRL_OPS = {
     "JOIN_PROBE": 16,
     "DRAIN_REQ": 17,
     "HEALTH_PULL": 18,
+    "STRIPE_PULL": 19,
 }
 
 # Control-pull reply size limits (native/ps.cc enum CtrlLimits, also
@@ -71,6 +72,7 @@ WIRE_CTRL_OPS = {
 WIRE_CTRL_LIMITS = {
     "kCtrlDrainBatch": 1024,
     "kCtrlFlightDrainMax": 4096,
+    "kCtrlStripeMax": 64,
 }
 
 
@@ -523,6 +525,24 @@ class PSClient:
             d["kind"] = FLIGHT_KIND_NAMES.get(d["kind"], str(d["kind"]))
             out.append(d)
         return out
+
+    def stripe_stats(self, server: int,
+                     timeout_s: int = 5) -> List[dict]:
+        """One remote server's per-conn / per-data-lane wire counters
+        (the time-series plane's de-aggregated stripe source): a list
+        of ``_STRIPE_REC_FIELDS`` dicts, one per live connection there,
+        counters cumulative since accept. Empty when the server is
+        unreachable or the ABI is stale. The in-process mirror
+        (``server.per_conn_stripe_stats``) answers from the same
+        StripeSlots vector, by construction."""
+        from . import STRIPE_REC_BYTES, parse_stripe_recs
+        raw = self._ctrl(
+            server, "STRIPE_PULL",
+            WIRE_CTRL_LIMITS["kCtrlStripeMax"] * STRIPE_REC_BYTES,
+            timeout_s)
+        if raw is None:
+            return []
+        return parse_stripe_recs(raw)
 
     def health_pull(self, server: int, key: int,
                     timeout_s: int = 5) -> Optional[dict]:

@@ -108,6 +108,11 @@ class WireLayoutRule(Rule):
             project, text, rel_cc, "kHealthRecFields",
             "_HEALTH_REC_FIELDS", struct_name="HealthRec",
             fmt_const="HEALTH_REC_FMT")
+        findings += self._check_slot_manifest(
+            project, text, rel_cc, "kStripeRecFields",
+            "_STRIPE_REC_FIELDS", struct_name="StripeRec",
+            fmt_const="STRIPE_REC_FMT")
+        findings += self._check_ts_fields(project)
         findings += self._check_dict_enum(
             project, text, rel_cc, "WIRE_CTRL_OPS", "Op",
             "a skewed control op id reaches the server as an unknown op")
@@ -220,6 +225,53 @@ class WireLayoutRule(Rule):
                     self.name, rel, line,
                     f"native {struct_name} exists but no {fmt_const} "
                     f"struct-format mirror was found"))
+        return findings
+
+    # -- time-series field manifest <-> StepReport dataclass ----------- #
+
+    def _check_ts_fields(self, project: Project) -> List[Finding]:
+        """Every name in ``_TS_STEP_FIELDS`` (core/timeseries.py) must
+        be a ``StepReport`` dataclass field — the drift class where a
+        field rename silently kills its per-step series (the recorder
+        samples via getattr with a None default, so nothing raises)."""
+        findings: List[Finding] = []
+        path, line, vals = self._find_tuple_const(
+            project, "_TS_STEP_FIELDS")
+        if path is None:
+            return findings  # tree predates the time-series plane
+        rel = project.rel(path)
+        if vals is None:
+            findings.append(Finding(
+                self.name, rel, line,
+                "_TS_STEP_FIELDS is not a tuple/list of str literals"))
+            return findings
+        fields: set = set()
+        for p in project.py_files():
+            tree = project.tree(p)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef) \
+                        and node.name == "StepReport":
+                    for st in node.body:
+                        if isinstance(st, ast.AnnAssign) and isinstance(
+                                st.target, ast.Name):
+                            fields.add(st.target.id)
+            if fields:
+                break
+        if not fields:
+            findings.append(Finding(
+                self.name, rel, line,
+                "_TS_STEP_FIELDS exists but no StepReport dataclass "
+                "was found — the series manifest is unverifiable"))
+            return findings
+        for name_ in vals:
+            if name_ not in fields:
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"_TS_STEP_FIELDS names {name_!r} which is not a "
+                    f"StepReport field — its series would silently "
+                    f"never record"))
         return findings
 
     # -- Python dict mirror <-> native enum (WIRE_CTRL_OPS <-> enum Op,

@@ -35,6 +35,29 @@ run_stage() {
 
 run_stage "byteps-lint" python -m byteps_tpu.tools.lint
 
+# byteps-top CI smoke: one --once frame over a synthetic timeseries
+# JSONL artifact must print schema byteps-top/1 with live series — the
+# console's whole read path (artifact detect → rehydrate → frame)
+run_stage "top-smoke" env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, subprocess, sys, tempfile
+art = os.path.join(tempfile.mkdtemp(prefix="bps-top-smoke-"),
+                   "timeseries-1.jsonl")
+with open(art, "w") as f:
+    f.write(json.dumps({"kind": "timeseries", "reason": "smoke",
+                        "pid": 1, "points": 512, "steps": 3,
+                        "series_count": 1, "dropped_series": 0}) + "\n")
+    f.write(json.dumps({"name": "step/wall_ms", "steps": [1, 2, 3],
+                        "values": [10.0, 11.0, 9.5]}) + "\n")
+out = subprocess.run(
+    [sys.executable, "-m", "byteps_tpu.tools.top", "--once",
+     "--file", art], capture_output=True, text=True, timeout=120)
+frame = json.loads(out.stdout)
+assert out.returncode == 0, out.stderr
+assert frame["schema"] == "byteps-top/1", frame
+assert frame["series"]["step/wall_ms"]["points"] == 3, frame
+print("[top-smoke] ok:", json.dumps(frame)[:120], "...")
+PY
+
 # advisory (never fails the gate): curated clang-tidy over ps.cc when
 # the tool is installed — this is the ONLY place it runs, so the lazy
 # import-time native build stays a pure -Werror compile

@@ -173,6 +173,14 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
                     "stripe_ab_throttled_lossless_gbps": 0.042,
                     "stripe_ab_lossless_gain": 2.09,
                     "stripe_ab_throttle_mbps": 20.0}, None
+        if name == "ts_ab":
+            return {"ts_on_step_ms": 5.02,
+                    "ts_off_step_ms": 5.0,
+                    "ts_overhead_pct": 0.4,
+                    "ts_series_count": 72,
+                    "ts_stripe_lane_points": 48,
+                    "ts_staleness_points": 20,
+                    "ts_engaged_proof": True}, None
         raise AssertionError(name)
 
     out, calls = run_main(bench, monkeypatch, capsys, script)
@@ -184,9 +192,10 @@ def test_healthy_tunnel_lands_everything(bench, monkeypatch, capsys):
     # pushpull phases that used to starve them out of overrun rounds
     cpu_calls = [c for c in calls
                  if c not in ("probe", "train", "pushpull_tpu")]
-    assert cpu_calls[:9] == ["pushpull_throttled", "scaling", "churn_ab",
-                             "scaleup_ab", "codec_adapt_ab", "stripe_ab",
-                             "fold_ab", "ledger_ab", "health_ab"]
+    assert cpu_calls[:10] == ["pushpull_throttled", "scaling", "churn_ab",
+                              "scaleup_ab", "codec_adapt_ab", "stripe_ab",
+                              "fold_ab", "ledger_ab", "health_ab",
+                              "ts_ab"]
     assert out["stripe_ab_conservation"] is True
     assert out["stripe_ab_lossless_gain"] == 2.09
     assert out["stripe_ab_segs"] == 4096
@@ -297,6 +306,11 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
             return {"shard_on_step_ms": 3.9,
                     "shard_off_step_ms": 4.2,
                     "shard_reduction_ratio": 8.0}, None
+        if name == "ts_ab":
+            return {"ts_on_step_ms": 5.02,
+                    "ts_off_step_ms": 5.0,
+                    "ts_overhead_pct": 0.4,
+                    "ts_engaged_proof": True}, None
         if name == "scaling":
             return {"scaling_efficiency_2w": 0.45}, None
         if name == "churn_ab":
@@ -332,14 +346,15 @@ def test_wedged_tunnel_emits_nulls_and_diag(bench, monkeypatch, capsys):
     # LITERAL, not the implementation's formula: if bench.py's cap
     # derivation drifts (e.g. //15 spinning 140 probes), this catches it
     n_final = 18
-    # start + one attempt after each of the 18 CPU phases + finals
-    assert calls.count("probe") == 19 + n_final
+    # start + one attempt after each of the 19 CPU phases + finals
+    assert calls.count("probe") == 20 + n_final
     probes = [d for d in out["tunnel_diag"] if "probe_wall_s" in d]
     assert [d["at"] for d in probes] == [
         "start", "after_pushpull_throttled", "after_scaling",
         "after_churn_ab", "after_scaleup_ab", "after_codec_adapt_ab",
         "after_stripe_ab",
         "after_fold_ab", "after_ledger_ab", "after_health_ab",
+        "after_ts_ab",
         "after_pushpull", "after_pushpull_2srv",
         "after_arena_ab", "after_metrics_ab", "after_trace_ab",
         "after_stream_ab", "after_barrier_ab", "after_wire_ab",
@@ -497,9 +512,9 @@ def test_budget_gate_skips_everything_when_spent(bench, monkeypatch,
                             "pushpull_throttled", "churn_ab",
                             "scaleup_ab", "codec_adapt_ab", "stripe_ab",
                             "fold_ab", "ledger_ab", "health_ab",
-                            "arena_ab", "metrics_ab", "trace_ab",
-                            "stream_ab", "barrier_ab", "wire_ab",
-                            "shard_ab", "scaling"}
+                            "ts_ab", "arena_ab", "metrics_ab",
+                            "trace_ab", "stream_ab", "barrier_ab",
+                            "wire_ab", "shard_ab", "scaling"}
 
 
 def test_multichip_envelope_bounded():
